@@ -1,0 +1,17 @@
+"""dlrover-tpu: a TPU-native elastic training framework.
+
+A ground-up JAX/XLA re-design of the capabilities of DLRover (elastic job
+master, per-host elastic agent, flash checkpoint, dynamic data sharding,
+network health checks) and ATorch (auto_accelerate strategy search, TP/SP/EP
+modules, AGD/WSAM optimizers, flash-attention kernels) for TPU pods:
+
+- parallelism is expressed as a ``jax.sharding.Mesh`` plus named sharding
+  rules compiled by GSPMD, not explicit process groups;
+- collectives ride ICI/DCN via XLA (``psum``/``all_gather``/``ppermute``),
+  not NCCL;
+- hot kernels (flash attention, quantized optimizer math) are Pallas;
+- the elastic control plane (master, agent, rendezvous, checkpoints) is the
+  part XLA does not give you, and is built here natively.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,439 @@
+"""Typed message catalog + codec for master<->agent RPC.
+
+Parity: dlrover/python/common/grpc.py:30-445 — the reference carries pickled
+dataclasses through a 2-RPC proto (``report``/``get``). We keep that minimal
+protocol surface (it makes rolling upgrades trivial) but harden the codec:
+messages are dataclasses registered in a catalog, and deserialization uses a
+restricted unpickler that only resolves classes from this module.
+
+TPU deltas vs the reference catalog:
+- ``CommWorld`` carries the JAX-distributed coordinator address (our analog
+  of the torch rendezvous store endpoints) plus the slice/node-unit layout;
+- resource stats describe TPU hosts (chips, HBM) instead of GPUs.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import socket
+from contextlib import closing
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    with closing(socket.socket(socket.AF_INET, socket.SOCK_STREAM)) as s:
+        s.bind((host, 0))
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        return s.getsockname()[1]
+
+
+def addr_connected(addr: str, timeout: float = 1.0) -> bool:
+    try:
+        host, port = addr.rsplit(":", 1)
+        with closing(socket.create_connection((host, int(port)), timeout)):
+            return True
+    except OSError:
+        return False
+
+
+class Message:
+    """Base class; every RPC payload subclasses this."""
+
+
+# ---------------------------------------------------------------------------
+# codec — restricted pickle
+# ---------------------------------------------------------------------------
+
+_SAFE_MODULES = ("dlrover_tpu.common.comm", "builtins", "collections")
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if module.startswith("dlrover_tpu.common.comm") or module in _SAFE_MODULES:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"forbidden class in message: {module}.{name}"
+        )
+
+
+def serialize_message(msg) -> bytes:
+    return pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_message(data: bytes):
+    if not data:
+        return None
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+# ---------------------------------------------------------------------------
+# envelopes
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BaseRequest(Message):
+    node_id: int = -1
+    node_type: str = ""
+    data: bytes = b""
+
+
+@dataclass
+class BaseResponse(Message):
+    success: bool = True
+    message: str = ""
+    data: bytes = b""
+
+
+# ---------------------------------------------------------------------------
+# task / data sharding messages (parity: grpc.py Task/TaskRequest/ShardConfig)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Shard(Message):
+    name: str = ""
+    start: int = 0
+    end: int = 0
+    record_indices: List[int] = field(default_factory=list)
+
+
+@dataclass
+class Task(Message):
+    task_id: int = -1
+    task_type: str = ""
+    shard: Shard = field(default_factory=Shard)
+
+    @property
+    def is_empty(self) -> bool:
+        return self.task_id < 0
+
+
+@dataclass
+class TaskRequest(Message):
+    dataset_name: str = ""
+
+
+@dataclass
+class TaskResult(Message):
+    dataset_name: str = ""
+    task_id: int = -1
+
+
+@dataclass
+class DatasetShardParams(Message):
+    batch_size: int = 0
+    num_epochs: int = 1
+    dataset_size: int = 0
+    shuffle: bool = False
+    num_minibatches_per_shard: int = 2
+    dataset_name: str = ""
+    task_type: str = ""
+    storage_type: str = "text"
+
+
+@dataclass
+class ShardCheckpointRequest(Message):
+    dataset_name: str = ""
+
+
+@dataclass
+class ShardCheckpoint(Message):
+    content: str = ""
+
+
+@dataclass
+class DatasetEpochRequest(Message):
+    dataset_name: str = ""
+
+
+@dataclass
+class DatasetEpoch(Message):
+    epoch: int = 0
+
+
+# ---------------------------------------------------------------------------
+# rendezvous messages (parity: grpc.py JoinRendezvousRequest/CommWorld etc.)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class JoinRendezvousRequest(Message):
+    node_id: int = 0
+    node_rank: int = 0
+    local_world_size: int = 1
+    rdzv_name: str = ""
+    node_unit: int = 1
+    node_group: int = -1
+
+
+@dataclass
+class WaitingNodeNumRequest(Message):
+    node_id: int = 0
+    local_world_size: int = 1
+    rdzv_name: str = ""
+
+
+@dataclass
+class WaitingNodeNum(Message):
+    waiting_num: int = 0
+
+
+@dataclass
+class CommWorldRequest(Message):
+    node_id: int = 0
+    rdzv_name: str = ""
+
+
+@dataclass
+class CommWorld(Message):
+    rdzv_name: str = ""
+    round: int = 0
+    group: int = 0
+    # node_rank -> local_world_size for every participant of this round
+    world: Dict[int, int] = field(default_factory=dict)
+    # JAX bootstrap: coordinator address chosen by master (host:port of the
+    # lowest-rank node in the world) — the TPU analog of the torch rdzv store.
+    coordinator_addr: str = ""
+
+
+@dataclass
+class NetworkReadyRequest(Message):
+    node_id: int = 0
+
+
+@dataclass
+class NetworkCheckResultRequest(Message):
+    node_id: int = 0
+    elapsed_time: float = 0.0
+    succeeded: bool = True
+
+
+@dataclass
+class NetworkCheckStatus(Message):
+    nodes: List[int] = field(default_factory=list)
+    reason: str = ""
+
+
+@dataclass
+class StragglerExistRequest(Message):
+    node_id: int = 0
+
+
+# ---------------------------------------------------------------------------
+# node / job lifecycle messages
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeMeta(Message):
+    node_type: str = ""
+    node_id: int = 0
+    rank_index: int = 0
+    addr: str = ""
+    cpu: float = 0.0
+    memory_mb: int = 0
+    tpu_chips: int = 0
+    tpu_type: str = ""
+
+
+@dataclass
+class NodeEventReport(Message):
+    event_type: str = ""
+    node_type: str = ""
+    node_id: int = 0
+    exit_reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class NodeFailureReport(Message):
+    node_id: int = 0
+    node_rank: int = 0
+    error_data: str = ""
+    level: str = ""
+    restart_count: int = 0
+
+
+@dataclass
+class HeartbeatReport(Message):
+    node_id: int = 0
+    timestamp: float = 0.0
+
+
+@dataclass
+class HeartbeatResponse(Message):
+    action: str = ""  # "" | "restart" | "stop"
+
+
+@dataclass
+class ResourceStats(Message):
+    node_id: int = 0
+    cpu_percent: float = 0.0
+    used_memory_mb: int = 0
+    tpu_duty_cycle: float = 0.0
+    tpu_hbm_used_mb: int = 0
+
+
+@dataclass
+class GlobalStepReport(Message):
+    node_id: int = 0
+    step: int = 0
+    timestamp: float = 0.0
+
+
+@dataclass
+class TrainingStatusReport(Message):
+    node_id: int = 0
+    status: int = 0  # TrainingLoopStatus
+    timestamp: float = 0.0
+
+
+@dataclass
+class NodeAddressRequest(Message):
+    node_type: str = ""
+
+
+@dataclass
+class NodeAddresses(Message):
+    # rank_index -> addr
+    addrs: Dict[int, str] = field(default_factory=dict)
+
+
+@dataclass
+class ClusterVersionRequest(Message):
+    node_type: str = ""
+    node_id: int = 0
+    version_type: str = "global"
+
+
+@dataclass
+class ClusterVersion(Message):
+    version: int = 0
+
+
+@dataclass
+class UpdateClusterVersionRequest(Message):
+    node_type: str = ""
+    node_id: int = 0
+    version_type: str = "global"
+    version: int = 0
+
+
+# ---------------------------------------------------------------------------
+# kv store (rendezvous store backing; parity: grpc.py KeyValuePair)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class KeyValuePair(Message):
+    key: str = ""
+    value: bytes = b""
+
+
+@dataclass
+class KeyValueQuery(Message):
+    key: str = ""
+
+
+@dataclass
+class KeyValueAdd(Message):
+    key: str = ""
+    amount: int = 0
+
+
+@dataclass
+class KeyValueWait(Message):
+    keys: List[str] = field(default_factory=list)
+    timeout: float = 60.0
+
+
+# ---------------------------------------------------------------------------
+# sync / barrier service (parity: sync_service.py messages)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SyncJoinRequest(Message):
+    sync_name: str = ""
+    node_id: int = 0
+    node_type: str = ""
+
+
+@dataclass
+class SyncFinishRequest(Message):
+    sync_name: str = ""
+
+
+@dataclass
+class SyncResult(Message):
+    done: bool = False
+
+
+@dataclass
+class BarrierRequest(Message):
+    barrier_name: str = ""
+    notify: bool = False
+
+
+# ---------------------------------------------------------------------------
+# auto-paral config (parity: grpc.py ParallelConfig family)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DataLoaderConfig(Message):
+    dataloader_name: str = ""
+    batch_size: int = 0
+    num_workers: int = 0
+    version: int = 0
+
+
+@dataclass
+class OptimizerConfig(Message):
+    optimizer_name: str = ""
+    learning_rate: float = 0.0
+    version: int = 0
+
+
+@dataclass
+class ParallelConfig(Message):
+    dataloader: DataLoaderConfig = field(default_factory=DataLoaderConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    # TPU strategy knobs the master can retune at runtime:
+    mesh_shape: Dict[str, int] = field(default_factory=dict)
+    remat_policy: str = ""
+    restart: bool = False
+
+
+@dataclass
+class ParallelConfigRequest(Message):
+    node_id: int = 0
+
+
+@dataclass
+class CheckpointReadyRequest(Message):
+    """Agent tells master the latest in-memory checkpoint step per node."""
+
+    node_id: int = 0
+    step: int = 0
+
+
+@dataclass
+class ElasticRunConfigRequest(Message):
+    pass
+
+
+@dataclass
+class ElasticRunConfig(Message):
+    configs: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ScaleRequest(Message):
+    """Ask master to scale node group(s) — used by tests/tools."""
+
+    node_type: str = ""
+    count: int = 0

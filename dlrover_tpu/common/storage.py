@@ -1,0 +1,181 @@
+"""Checkpoint storage abstraction + POSIX impl + deletion strategies.
+
+Parity: dlrover/python/common/storage.py:23,127,202. The writer side stays
+byte-oriented (the flash-ckpt saver hands us raw shm slices), so the same
+interface backs POSIX disk, and later GCS via a fuse mount.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+from abc import ABC, abstractmethod
+from typing import Any, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class CheckpointDeletionStrategy(ABC):
+    @abstractmethod
+    def clean_up(self, step: int, delete_func):
+        """Given a newly-committed step, remove stale checkpoint dirs."""
+
+
+class KeepLatestStepStrategy(CheckpointDeletionStrategy):
+    """Keep only the newest ``max_to_keep`` step dirs.
+
+    Parity: storage.py KeepLatestStepStrategy.
+    """
+
+    def __init__(self, max_to_keep: int = 1, checkpoint_dir: str = ""):
+        self._max_to_keep = max(1, max_to_keep)
+        self._checkpoint_dir = checkpoint_dir
+        self._steps: List[int] = []
+
+    def clean_up(self, step: int, delete_func):
+        if step in self._steps:
+            return
+        self._steps.append(step)
+        self._steps.sort()
+        while len(self._steps) > self._max_to_keep:
+            stale = self._steps.pop(0)
+            path = os.path.join(self._checkpoint_dir, str(stale))
+            try:
+                delete_func(path)
+            except Exception as e:
+                logger.warning(f"fail to clean ckpt {path}: {e!r}")
+
+
+class KeepStepIntervalStrategy(CheckpointDeletionStrategy):
+    """Keep steps that are multiples of ``keep_interval``; drop the rest.
+
+    Parity: storage.py:202 KeepStepIntervalStrategy.
+    """
+
+    def __init__(self, keep_interval: int, checkpoint_dir: str = ""):
+        self._keep_interval = max(1, keep_interval)
+        self._checkpoint_dir = checkpoint_dir
+
+    def clean_up(self, step: int, delete_func):
+        if step % self._keep_interval == 0:
+            return
+        path = os.path.join(self._checkpoint_dir, str(step))
+        try:
+            delete_func(path)
+        except Exception as e:
+            logger.warning(f"fail to clean ckpt {path}: {e!r}")
+
+
+class CheckpointStorage(ABC):
+    """Byte/object storage seam used by the flash-checkpoint saver."""
+
+    @abstractmethod
+    def write(self, content: bytes | str, path: str):
+        ...
+
+    @abstractmethod
+    def write_state_dict(self, state_dict: Any, path: str):
+        ...
+
+    @abstractmethod
+    def read(self, path: str) -> Optional[bytes]:
+        ...
+
+    @abstractmethod
+    def read_state_dict(self, path: str) -> Any:
+        ...
+
+    @abstractmethod
+    def safe_rmtree(self, dir_path: str):
+        ...
+
+    @abstractmethod
+    def safe_remove(self, path: str):
+        ...
+
+    @abstractmethod
+    def safe_makedirs(self, dir_path: str):
+        ...
+
+    @abstractmethod
+    def exists(self, path: str) -> bool:
+        ...
+
+    @abstractmethod
+    def listdir(self, path: str) -> List[str]:
+        ...
+
+    def commit(self, step: int, success: bool):
+        """Hook run after a step is fully persisted."""
+
+
+class PosixDiskStorage(CheckpointStorage):
+    """Local/NFS/FUSE-mounted filesystem storage (parity: storage.py:127).
+
+    Writes are atomic: tmp file in the target dir + ``os.replace``.
+    """
+
+    def __init__(self, deletion_strategy: Optional[CheckpointDeletionStrategy] = None):
+        self._deletion_strategy = deletion_strategy
+
+    def write(self, content: bytes | str, path: str):
+        mode = "wb" if isinstance(content, bytes) else "w"
+        self.safe_makedirs(os.path.dirname(path))
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
+        try:
+            with os.fdopen(fd, mode) as f:
+                f.write(content)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except Exception:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def write_state_dict(self, state_dict: Any, path: str):
+        self.write(pickle.dumps(state_dict, protocol=pickle.HIGHEST_PROTOCOL), path)
+
+    def read(self, path: str) -> Optional[bytes]:
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def read_state_dict(self, path: str) -> Any:
+        data = self.read(path)
+        return pickle.loads(data) if data is not None else None
+
+    def safe_rmtree(self, dir_path: str):
+        shutil.rmtree(dir_path, ignore_errors=True)
+
+    def safe_remove(self, path: str):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def safe_makedirs(self, dir_path: str):
+        if dir_path:
+            os.makedirs(dir_path, exist_ok=True)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def listdir(self, path: str) -> List[str]:
+        try:
+            return sorted(os.listdir(path))
+        except OSError:
+            return []
+
+    def commit(self, step: int, success: bool):
+        if success and self._deletion_strategy is not None:
+            self._deletion_strategy.clean_up(step, self.safe_rmtree)
+
+
+def get_checkpoint_storage(
+    deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
+) -> CheckpointStorage:
+    return PosixDiskStorage(deletion_strategy)

@@ -1,0 +1,178 @@
+"""Tests for common: node state machine, messages, IPC, storage."""
+
+import multiprocessing as mp
+import os
+import queue
+import time
+
+import pytest
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus
+from dlrover_tpu.common.multi_process import (
+    SharedDict,
+    SharedLock,
+    SharedMemory,
+    SharedQueue,
+    attach_shared_memory,
+    create_shared_memory,
+)
+from dlrover_tpu.common.node import Node, NodeResource, is_allowed_transition
+from dlrover_tpu.common.storage import (
+    KeepLatestStepStrategy,
+    KeepStepIntervalStrategy,
+    PosixDiskStorage,
+)
+
+
+class TestNode:
+    def test_status_flow(self):
+        node = Node(node_id=0)
+        assert node.update_status(NodeStatus.PENDING)
+        assert node.update_status(NodeStatus.RUNNING)
+        assert node.start_time is not None
+        # illegal: RUNNING -> PENDING
+        assert not node.update_status(NodeStatus.PENDING)
+        assert node.update_status(NodeStatus.FAILED)
+        assert node.finish_time is not None
+        assert not is_allowed_transition(NodeStatus.DELETED, NodeStatus.RUNNING)
+
+    def test_relaunch(self):
+        node = Node(node_id=3, max_relaunch_count=2)
+        node.exit_reason = NodeExitReason.KILLED
+        assert not node.is_unrecoverable_failure()
+        node.relaunch_count = 2
+        assert node.is_unrecoverable_failure()
+        node.relaunch_count = 0
+        node.exit_reason = NodeExitReason.FATAL_ERROR
+        assert node.is_unrecoverable_failure()
+
+        node.exit_reason = NodeExitReason.KILLED
+        new = node.get_relaunch_node_info(new_id=7)
+        assert new.id == 7
+        assert new.rank_index == node.rank_index
+        assert new.relaunch_count == 1
+        assert new.status == NodeStatus.INITIAL
+
+    def test_resource(self):
+        r = NodeResource(cpu=4, memory_mb=8192, tpu_chips=4, tpu_type="v5p")
+        r2 = NodeResource.from_dict(r.to_dict())
+        assert r2 == r
+
+
+class TestComm:
+    def test_roundtrip(self):
+        msg = comm.CommWorld(
+            rdzv_name="elastic-training",
+            round=2,
+            world={0: 4, 1: 4},
+            coordinator_addr="10.0.0.1:8899",
+        )
+        data = comm.serialize_message(msg)
+        out = comm.deserialize_message(data)
+        assert out == msg
+
+    def test_restricted_unpickle(self):
+        import pickle
+
+        class Evil:
+            def __reduce__(self):
+                return (os.system, ("true",))
+
+        payload = pickle.dumps(Evil())
+        with pytest.raises(Exception):
+            comm.deserialize_message(payload)
+
+    def test_find_free_port(self):
+        p = comm.find_free_port()
+        assert 0 < p < 65536
+
+
+class TestIPC:
+    def test_shared_queue(self):
+        q = SharedQueue("test-q", create=True)
+        client = SharedQueue("test-q", create=False)
+        client.put({"step": 5})
+        assert q.qsize() == 1
+        assert client.get(timeout=5) == {"step": 5}
+        with pytest.raises(queue.Empty):
+            client.get(timeout=0.2)
+        q.close()
+
+    def test_shared_dict(self):
+        d = SharedDict("test-d", create=True)
+        client = SharedDict("test-d", create=False)
+        client.set("rank0", {"step": 1})
+        d.set("rank1", {"step": 2})
+        assert client.as_dict() == {"rank0": {"step": 1}, "rank1": {"step": 2}}
+        assert client.pop("rank0") == {"step": 1}
+        assert client.get("rank0", "gone") == "gone"
+        d.close()
+
+    def test_shared_lock(self):
+        lock = SharedLock("test-l", create=True)
+        client = SharedLock("test-l", create=False)
+        assert client.acquire(blocking=False)
+        assert lock.locked()
+        # a different thread (different owner id) cannot release it
+        import threading
+
+        results = []
+        t = threading.Thread(target=lambda: results.append(lock.release()))
+        t.start()
+        t.join()
+        assert results == [False]
+        assert client.release()
+        assert not lock.locked()
+        assert not client.release()  # releasing an unlocked lock is a no-op
+        lock.close()
+
+    def test_shared_memory_survives_process(self):
+        name = f"dlrover-tpu-test-{os.getpid()}"
+        p = mp.get_context("spawn").Process(target=_shm_child, args=(name,))
+        p.start()
+        p.join()
+        assert p.exitcode == 0
+        shm = attach_shared_memory(name)
+        assert shm is not None
+        assert bytes(shm.buf[:5]) == b"hello"
+        shm.close()
+        shm.unlink()
+        assert attach_shared_memory(name) is None
+
+
+def _shm_child(n):
+    shm = create_shared_memory(n, 1024)
+    shm.buf[:5] = b"hello"
+    shm.close()  # close mapping but do NOT unlink
+
+
+class TestStorage:
+    def test_atomic_write_read(self, tmp_path):
+        storage = PosixDiskStorage()
+        path = str(tmp_path / "ckpt" / "model.bin")
+        storage.write(b"\x00\x01payload", path)
+        assert storage.read(path) == b"\x00\x01payload"
+        storage.write_state_dict({"w": [1, 2, 3]}, path)
+        assert storage.read_state_dict(path) == {"w": [1, 2, 3]}
+        assert storage.read(str(tmp_path / "missing")) is None
+
+    def test_keep_latest_strategy(self, tmp_path):
+        strat = KeepLatestStepStrategy(max_to_keep=2, checkpoint_dir=str(tmp_path))
+        storage = PosixDiskStorage(strat)
+        for step in (10, 20, 30):
+            d = tmp_path / str(step)
+            d.mkdir()
+            storage.commit(step, success=True)
+        assert not (tmp_path / "10").exists()
+        assert (tmp_path / "20").exists()
+        assert (tmp_path / "30").exists()
+
+    def test_keep_interval_strategy(self, tmp_path):
+        strat = KeepStepIntervalStrategy(keep_interval=100, checkpoint_dir=str(tmp_path))
+        storage = PosixDiskStorage(strat)
+        for step in (100, 150):
+            (tmp_path / str(step)).mkdir()
+            storage.commit(step, success=True)
+        assert (tmp_path / "100").exists()
+        assert not (tmp_path / "150").exists()

@@ -1,0 +1,347 @@
+"""Agent-side client of the master's 2-RPC service.
+
+Parity: dlrover/python/elastic_agent/master_client.py:50 (MasterClient) —
+every control-plane interaction of agents and training processes goes
+through this: rendezvous, data shards, failure reports, heartbeats, kv
+store, paral config.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import grpc
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.servicer import SERVICE_NAME
+
+
+class MasterClient:
+    _instance: Optional["MasterClient"] = None
+
+    def __init__(
+        self,
+        master_addr: str,
+        node_id: int = 0,
+        node_type: str = "worker",
+        timeout: float = 30.0,
+    ):
+        self._master_addr = master_addr
+        self._node_id = node_id
+        self._node_type = node_type
+        self._timeout = timeout
+        self._channel = grpc.insecure_channel(
+            master_addr,
+            options=[
+                ("grpc.max_send_message_length", 256 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+            ],
+        )
+        self._get_rpc = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/get"
+        )
+        self._report_rpc = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/report"
+        )
+
+    @property
+    def node_id(self) -> int:
+        return self._node_id
+
+    def close(self):
+        self._channel.close()
+
+    # -- plumbing ------------------------------------------------------
+    def _wrap(self, message) -> bytes:
+        req = comm.BaseRequest(
+            node_id=self._node_id,
+            node_type=self._node_type,
+            data=comm.serialize_message(message),
+        )
+        return comm.serialize_message(req)
+
+    def _call(
+        self,
+        rpc,
+        message,
+        retries: int = 3,
+        rpc_timeout: Optional[float] = None,
+    ):
+        err: Optional[Exception] = None
+        for i in range(retries):
+            try:
+                resp_bytes = rpc(
+                    self._wrap(message),
+                    timeout=rpc_timeout or self._timeout,
+                )
+                resp: comm.BaseResponse = comm.deserialize_message(resp_bytes)
+                if not resp.success:
+                    raise RuntimeError(
+                        f"master rejected {type(message).__name__}: "
+                        f"{resp.message}"
+                    )
+                return comm.deserialize_message(resp.data)
+            except grpc.RpcError as e:
+                err = e
+                if i < retries - 1:
+                    time.sleep(min(2**i, 8))
+        raise ConnectionError(
+            f"master {self._master_addr} unreachable: {err!r}"
+        )
+
+    def get(self, message, retries: int = 3, rpc_timeout: Optional[float] = None):
+        return self._call(
+            self._get_rpc, message, retries=retries, rpc_timeout=rpc_timeout
+        )
+
+    def report(self, message, retries: int = 3):
+        return self._call(self._report_rpc, message, retries=retries)
+
+    # -- data sharding -------------------------------------------------
+    def report_dataset_shard_params(self, params: comm.DatasetShardParams):
+        return self.report(params)
+
+    def get_task(self, dataset_name: str) -> comm.Task:
+        task = self.get(comm.TaskRequest(dataset_name=dataset_name))
+        return task if task is not None else comm.Task()
+
+    def report_task_result(self, dataset_name: str, task_id: int):
+        return self.report(
+            comm.TaskResult(dataset_name=dataset_name, task_id=task_id)
+        )
+
+    def get_shard_checkpoint(self) -> str:
+        ckpt = self.get(comm.ShardCheckpointRequest())
+        return ckpt.content if ckpt else ""
+
+    def report_shard_checkpoint(self, content: str):
+        return self.report(comm.ShardCheckpoint(content=content))
+
+    def get_dataset_epoch(self, dataset_name: str) -> int:
+        resp = self.get(comm.DatasetEpochRequest(dataset_name=dataset_name))
+        return resp.epoch if resp else 0
+
+    # -- rendezvous ----------------------------------------------------
+    def register_node_addr(self, rank_index: int, addr: str):
+        return self.report(
+            comm.NodeMeta(
+                node_type=self._node_type,
+                node_id=self._node_id,
+                rank_index=rank_index,
+                addr=addr,
+            )
+        )
+
+    def join_rendezvous(
+        self,
+        node_rank: int,
+        local_world_size: int,
+        rdzv_name: str = RendezvousName.ELASTIC_TRAINING,
+        node_group: int = -1,
+    ) -> int:
+        resp = self.report(
+            comm.JoinRendezvousRequest(
+                node_id=self._node_id,
+                node_rank=node_rank,
+                local_world_size=local_world_size,
+                rdzv_name=rdzv_name,
+                node_group=node_group,
+            )
+        )
+        return resp.version if isinstance(resp, comm.ClusterVersion) else 0
+
+    def get_comm_world(
+        self, rdzv_name: str, node_rank: int
+    ) -> comm.CommWorld:
+        resp = self.get(
+            comm.CommWorldRequest(node_id=node_rank, rdzv_name=rdzv_name)
+        )
+        return resp if resp else comm.CommWorld(rdzv_name=rdzv_name)
+
+    def num_nodes_waiting(
+        self, rdzv_name: str = RendezvousName.ELASTIC_TRAINING
+    ) -> int:
+        resp = self.get(
+            comm.WaitingNodeNumRequest(
+                node_id=self._node_id, rdzv_name=rdzv_name
+            )
+        )
+        return resp.waiting_num if resp else 0
+
+    # -- network check -------------------------------------------------
+    def report_network_check_result(
+        self, node_rank: int, succeeded: bool, elapsed: float
+    ):
+        return self.report(
+            comm.NetworkCheckResultRequest(
+                node_id=node_rank,
+                succeeded=succeeded,
+                elapsed_time=elapsed,
+            )
+        )
+
+    def check_fault_node(self) -> Tuple[List[int], str]:
+        resp = self.get(comm.NetworkCheckStatus())
+        return (resp.nodes, resp.reason) if resp else ([], "no_response")
+
+    def check_straggler(self) -> Tuple[List[int], str]:
+        resp = self.get(comm.StragglerExistRequest(node_id=self._node_id))
+        return (resp.nodes, resp.reason) if resp else ([], "no_response")
+
+    def network_check_success(self) -> bool:
+        resp = self.get(comm.NetworkReadyRequest(node_id=self._node_id))
+        return bool(resp and resp.done)
+
+    # -- lifecycle reports ---------------------------------------------
+    def report_heartbeat(self) -> str:
+        resp = self.report(
+            comm.HeartbeatReport(node_id=self._node_id, timestamp=time.time())
+        )
+        return resp.action if isinstance(resp, comm.HeartbeatResponse) else ""
+
+    def report_failure(
+        self,
+        error_data: str,
+        level: str,
+        restart_count: int = 0,
+        node_rank: int = -1,
+    ):
+        return self.report(
+            comm.NodeFailureReport(
+                node_id=self._node_id,
+                node_rank=node_rank if node_rank >= 0 else self._node_id,
+                error_data=error_data,
+                level=level,
+                restart_count=restart_count,
+            )
+        )
+
+    def report_resource_stats(
+        self, cpu_percent: float, used_memory_mb: int, tpu_duty_cycle: float = 0.0
+    ):
+        return self.report(
+            comm.ResourceStats(
+                node_id=self._node_id,
+                cpu_percent=cpu_percent,
+                used_memory_mb=used_memory_mb,
+                tpu_duty_cycle=tpu_duty_cycle,
+            )
+        )
+
+    def report_global_step(self, step: int):
+        return self.report(
+            comm.GlobalStepReport(
+                node_id=self._node_id, step=step, timestamp=time.time()
+            )
+        )
+
+    def report_training_status(self, status: int):
+        return self.report(
+            comm.TrainingStatusReport(
+                node_id=self._node_id, status=status, timestamp=time.time()
+            )
+        )
+
+    def report_ckpt_step(self, step: int):
+        return self.report(
+            comm.CheckpointReadyRequest(node_id=self._node_id, step=step)
+        )
+
+    # -- kv store ------------------------------------------------------
+    def kv_store_set(self, key: str, value: bytes):
+        return self.report(comm.KeyValuePair(key=key, value=value))
+
+    def kv_store_get(self, key: str) -> bytes:
+        resp = self.get(comm.KeyValueQuery(key=key))
+        return resp.value if resp else b""
+
+    def kv_store_add(self, key: str, amount: int) -> int:
+        # not idempotent: never blind-retry, a lost response would re-add
+        resp = self.report(comm.KeyValueAdd(key=key, amount=amount), retries=1)
+        if isinstance(resp, comm.KeyValuePair):
+            return int(resp.value or b"0")
+        return 0
+
+    def kv_store_wait(self, keys: List[str], timeout: float = 60.0) -> bool:
+        # the RPC deadline must outlive the server-side wait
+        resp = self.get(
+            comm.KeyValueWait(keys=keys, timeout=timeout),
+            rpc_timeout=timeout + 10,
+        )
+        return bool(resp and resp.done)
+
+    # -- paral config / misc -------------------------------------------
+    def get_paral_config(self) -> comm.ParallelConfig:
+        resp = self.get(comm.ParallelConfigRequest(node_id=self._node_id))
+        return resp if resp else comm.ParallelConfig()
+
+    def get_node_addrs(self, node_type: str = "worker") -> Dict[int, str]:
+        resp = self.get(comm.NodeAddressRequest(node_type=node_type))
+        return resp.addrs if resp else {}
+
+    def get_cluster_version(self, version_type: str = "global") -> int:
+        resp = self.get(
+            comm.ClusterVersionRequest(
+                node_type=self._node_type,
+                node_id=self._node_id,
+                version_type=version_type,
+            )
+        )
+        return resp.version if resp else 0
+
+    def update_cluster_version(
+        self, version: int, version_type: str = "global"
+    ):
+        return self.report(
+            comm.UpdateClusterVersionRequest(
+                node_type=self._node_type,
+                node_id=self._node_id,
+                version_type=version_type,
+                version=version,
+            )
+        )
+
+    def join_sync(self, sync_name: str) -> bool:
+        resp = self.report(
+            comm.SyncJoinRequest(
+                sync_name=sync_name,
+                node_id=self._node_id,
+                node_type=self._node_type,
+            )
+        )
+        return bool(resp)
+
+    def sync_finished(self, sync_name: str) -> bool:
+        resp = self.get(comm.SyncJoinRequest(sync_name=sync_name))
+        return bool(resp and resp.done)
+
+    def barrier(self, barrier_name: str, notify: bool = False) -> bool:
+        if notify:
+            return bool(
+                self.report(
+                    comm.BarrierRequest(
+                        barrier_name=barrier_name, notify=True
+                    )
+                )
+            )
+        resp = self.get(comm.BarrierRequest(barrier_name=barrier_name))
+        return bool(resp and resp.done)
+
+    # -- singleton bootstrap -------------------------------------------
+    @classmethod
+    def singleton(cls) -> "MasterClient":
+        """Build from the env the agent exports (NodeEnv)."""
+        if cls._instance is None:
+            addr = os.getenv(NodeEnv.MASTER_ADDR, "")
+            if not addr:
+                raise RuntimeError(
+                    f"{NodeEnv.MASTER_ADDR} is not set; not inside a "
+                    "dlrover-tpu job?"
+                )
+            node_id = int(os.getenv(NodeEnv.NODE_ID, "0"))
+            cls._instance = cls(addr, node_id=node_id)
+        return cls._instance

@@ -244,6 +244,7 @@ class NodeEventReport(Message):
     event_type: str = ""
     node_type: str = ""
     node_id: int = 0
+    status: str = ""
     exit_reason: str = ""
     message: str = ""
 

@@ -1,0 +1,235 @@
+"""Job manager: authoritative node table, heartbeats, failure handling and
+relaunch policy.
+
+Parity: dlrover/python/master/node/dist_job_manager.py:88 (``_monitor_nodes``,
+``_should_relaunch:561``, ``_relaunch_node:605``) and local_job_manager.py:175.
+This module holds the platform-independent core; the k8s-backed manager
+(pod watcher + scaler) plugs a `scaler` and `watcher` into the same class.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.global_context import Context
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node, NodeResource
+
+_ctx = Context.singleton_instance()
+
+
+class NodeEvent:
+    def __init__(self, event_type: str, node: Node):
+        self.event_type = event_type
+        self.node = node
+
+
+class JobManager:
+    """Tracks every node of the job and decides relaunches."""
+
+    def __init__(
+        self,
+        speed_monitor=None,
+        scaler=None,
+        max_relaunch_count: int = 3,
+    ):
+        self._lock = threading.Lock()
+        self._job_nodes: Dict[str, Dict[int, Node]] = {}
+        self._speed_monitor = speed_monitor
+        self._scaler = scaler
+        self._max_relaunch_count = max_relaunch_count
+        self._next_node_id: Dict[str, int] = {}
+        self._stopped = False
+        self._relaunch_listeners: List[Callable[[Node, Node], None]] = []
+
+    # -- node table ----------------------------------------------------
+    def add_node(self, node: Node):
+        with self._lock:
+            self._job_nodes.setdefault(node.type, {})[node.id] = node
+            nxt = self._next_node_id.get(node.type, 0)
+            self._next_node_id[node.type] = max(nxt, node.id + 1)
+
+    def create_initial_nodes(
+        self,
+        node_num: int,
+        node_type: str = NodeType.WORKER,
+        resource: Optional[NodeResource] = None,
+        group_size: int = 1,
+    ):
+        for i in range(node_num):
+            self.add_node(
+                Node(
+                    node_type=node_type,
+                    node_id=i,
+                    rank_index=i,
+                    config_resource=resource or NodeResource(),
+                    max_relaunch_count=self._max_relaunch_count,
+                    group=i // group_size,
+                    group_size=group_size,
+                )
+            )
+
+    def get_node(self, node_type: str, node_id: int) -> Optional[Node]:
+        with self._lock:
+            return self._job_nodes.get(node_type, {}).get(node_id)
+
+    def get_nodes(self, node_type: str = "") -> List[Node]:
+        with self._lock:
+            if node_type:
+                return list(self._job_nodes.get(node_type, {}).values())
+            return [
+                n
+                for group in self._job_nodes.values()
+                for n in group.values()
+            ]
+
+    def get_running_nodes(self) -> List[Node]:
+        return [
+            n
+            for n in self.get_nodes()
+            if n.status == NodeStatus.RUNNING and not n.is_released
+        ]
+
+    # -- heartbeats / usage --------------------------------------------
+    def collect_node_heartbeat(self, node_type: str, node_id: int) -> str:
+        node = self.get_node(node_type, node_id)
+        if node is None:
+            return ""
+        node.heartbeat_time = time.time()
+        if node.restart_training:
+            node.restart_training = False
+            return "restart"
+        return ""
+
+    def update_node_resource_usage(
+        self, node_type: str, node_id: int, cpu: float, memory_mb: int
+    ):
+        node = self.get_node(node_type, node_id)
+        if node is not None:
+            node.used_resource.cpu = cpu
+            node.used_resource.memory_mb = memory_mb
+
+    def get_heartbeat_timeout_nodes(
+        self, timeout: Optional[float] = None
+    ) -> List[Node]:
+        timeout = timeout or _ctx.node_heartbeat_timeout_secs
+        return [
+            n
+            for n in self.get_running_nodes()
+            if n.timeout(timeout)
+        ]
+
+    # -- events & relaunch policy --------------------------------------
+    def process_event(self, event: NodeEvent):
+        """Apply a reported node event; may trigger relaunch."""
+        node = self.get_node(event.node.type, event.node.id)
+        if node is None:
+            self.add_node(event.node)
+            node = event.node
+        if event.event_type == NodeEventType.DELETED:
+            node.is_released = True
+            node.update_status(NodeStatus.DELETED)
+        else:
+            node.exit_reason = event.node.exit_reason or node.exit_reason
+            node.update_status(event.node.status)
+        if node.status in (NodeStatus.FAILED, NodeStatus.BREAKDOWN):
+            self._handle_node_failure(node)
+        elif node.status == NodeStatus.RUNNING and self._speed_monitor:
+            self._speed_monitor.add_running_worker(node.id)
+
+    def _should_relaunch(self, node: Node) -> bool:
+        """Parity: dist_job_manager.py:561 — relaunch unless the failure is
+        unrecoverable (fatal user error or out of relaunch budget)."""
+        if self._stopped or node.is_released:
+            return False
+        if _ctx.relaunch_always:
+            return True
+        if node.exit_reason == NodeExitReason.FATAL_ERROR:
+            return False
+        return node.relaunch_count < node.max_relaunch_count
+
+    def _handle_node_failure(self, node: Node):
+        if self._speed_monitor:
+            self._speed_monitor.remove_running_worker(node.id)
+        if node.exit_reason == NodeExitReason.OOM:
+            # give the replacement more memory (parity: reference doubles
+            # memory on OOM relaunch via the resource optimizer)
+            node.config_resource.memory_mb = int(
+                node.config_resource.memory_mb * 2
+            )
+        if self._should_relaunch(node):
+            self._relaunch_node(node)
+        else:
+            logger.warning(
+                f"node {node.name} failed unrecoverably: "
+                f"{node.exit_reason}"
+            )
+
+    def _relaunch_node(self, node: Node):
+        """Parity: dist_job_manager.py:605."""
+        node.is_released = True
+        with self._lock:
+            new_id = self._next_node_id.get(node.type, 0)
+            self._next_node_id[node.type] = new_id + 1
+        new_node = node.get_relaunch_node_info(new_id)
+        new_node.exit_reason = NodeExitReason.RELAUNCHED
+        self.add_node(new_node)
+        logger.info(
+            f"relaunch {node.name} -> {new_node.name} "
+            f"(attempt {new_node.relaunch_count}/{node.max_relaunch_count})"
+        )
+        if self._scaler is not None:
+            self._scaler.relaunch_node(node, new_node)
+        for cb in self._relaunch_listeners:
+            cb(node, new_node)
+
+    def add_relaunch_listener(self, cb: Callable[[Node, Node], None]):
+        self._relaunch_listeners.append(cb)
+
+    def handle_training_failure(
+        self,
+        node_type: str,
+        node_id: int,
+        restart_count: int = 0,
+        error_data: str = "",
+        level: str = TrainingExceptionLevel.PROCESS_ERROR,
+    ):
+        """A training process (not the whole node) failed.
+
+        Process errors are retried in place by the agent; node errors mark
+        the node failed so the relaunch policy runs.
+        """
+        node = self.get_node(node_type, node_id)
+        if node is None:
+            return
+        logger.warning(
+            f"training failure on {node.name}: level={level} "
+            f"restart={restart_count} err={error_data[:200]}"
+        )
+        if level == TrainingExceptionLevel.NODE_ERROR:
+            node.exit_reason = NodeExitReason.HARDWARE_ERROR
+            node.update_status(NodeStatus.BREAKDOWN)
+            self._handle_node_failure(node)
+
+    # -- hang detection -------------------------------------------------
+    def all_running_node_hanged(self) -> bool:
+        if self._speed_monitor is None:
+            return False
+        return self._speed_monitor.all_worker_hanged()
+
+    def stop(self):
+        self._stopped = True
+
+
+class LocalJobManager(JobManager):
+    """Single-host job manager (parity: local_job_manager.py:175) — nodes
+    are local agent processes; no external scheduler involved."""

@@ -1,0 +1,88 @@
+"""Windowed global-step throughput + hang signals on the master.
+
+Parity: dlrover/python/master/monitor/speed_monitor.py:43 — keeps a sliding
+window of (timestamp, global_step) samples, computes steps/sec used by the
+auto-scaler, and flags "all nodes running but no step progress" as a hang.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Deque, Optional, Set, Tuple
+
+from dlrover_tpu.common.global_context import Context
+
+_ctx = Context.singleton_instance()
+
+
+class SpeedMonitor:
+    def __init__(self, window: int = 0):
+        self._window = window or _ctx.train_speed_record_num
+        self._samples: Deque[Tuple[float, int]] = deque(maxlen=self._window)
+        self._global_step = 0
+        self._start_training_time: Optional[float] = None
+        self._sample_count_per_step: dict = {}
+        self._running_workers: Set[int] = set()
+        self._init_time = time.time()
+        self._last_reset_time = 0.0
+        self.first_step_time: Optional[float] = None
+
+    # -- reporting -----------------------------------------------------
+    def set_start_timestamp(self):
+        if self._start_training_time is None:
+            self._start_training_time = time.time()
+
+    def collect_global_step(self, step: int, timestamp: Optional[float] = None):
+        timestamp = timestamp or time.time()
+        if self.first_step_time is None:
+            self.first_step_time = timestamp
+        if step >= self._global_step:
+            self._global_step = step
+            self._samples.append((timestamp, step))
+
+    def add_running_worker(self, node_id: int):
+        self._running_workers.add(node_id)
+
+    def remove_running_worker(self, node_id: int):
+        self._running_workers.discard(node_id)
+
+    @property
+    def running_workers(self) -> Set[int]:
+        return set(self._running_workers)
+
+    @property
+    def completed_global_step(self) -> int:
+        return self._global_step
+
+    # -- queries -------------------------------------------------------
+    def running_speed(self) -> float:
+        """Steps per second over the sample window."""
+        if len(self._samples) < 2:
+            return 0.0
+        (t0, s0), (t1, s1) = self._samples[0], self._samples[-1]
+        if t1 <= t0:
+            return 0.0
+        return (s1 - s0) / (t1 - t0)
+
+    def all_worker_hanged(self, timeout: Optional[float] = None) -> bool:
+        """True if workers are running but the step has not advanced for
+        longer than ``timeout`` seconds (parity: all_running_node_hanged)."""
+        timeout = timeout if timeout is not None else _ctx.hang_detection_secs
+        if not self._running_workers:
+            return False
+        if not self._samples:
+            # No samples yet: count from the most recent of training start /
+            # window reset, so a rendezvous late in the job (which resets the
+            # window) doesn't instantly read as a hang.
+            base = max(
+                self._start_training_time or self._init_time,
+                self._last_reset_time,
+            )
+            return time.time() - base > timeout
+        last_time = self._samples[-1][0]
+        return time.time() - last_time > timeout
+
+    def reset_running_speed_monitor(self):
+        self._samples.clear()
+        self._last_reset_time = time.time()

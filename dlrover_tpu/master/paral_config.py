@@ -1,0 +1,44 @@
+"""Runtime parallel-config service: master-tuned dataloader/optimizer and
+mesh knobs delivered to agents.
+
+Parity: the ParallelConfig plumbing in dlrover/python/master/servicer.py +
+hyperparams/simple_strategy_generator.py:179 — the master suggests initial
+dataloader/optimizer configs from runtime stats and can retune them; the
+agent's ParalConfigTuner polls and writes them to a JSON file the
+ElasticDataLoader re-reads.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from dlrover_tpu.common import comm
+
+
+class ParalConfigService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._global_config = comm.ParallelConfig()
+        self._node_configs: Dict[int, comm.ParallelConfig] = {}
+
+    def get_config(self, node_id: int) -> comm.ParallelConfig:
+        with self._lock:
+            return self._node_configs.get(node_id, self._global_config)
+
+    def set_global_config(self, config: comm.ParallelConfig):
+        with self._lock:
+            config.dataloader.version = (
+                self._global_config.dataloader.version + 1
+            )
+            self._global_config = config
+
+    def suggest_initial_config(
+        self, batch_size: int, num_workers: int = 0
+    ) -> comm.ParallelConfig:
+        """Initial suggestion (parity: SimpleStrategyGenerator)."""
+        config = comm.ParallelConfig()
+        config.dataloader.batch_size = batch_size
+        config.dataloader.num_workers = num_workers
+        self.set_global_config(config)
+        return config

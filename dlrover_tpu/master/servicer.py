@@ -1,0 +1,372 @@
+"""Master gRPC servicer: a 2-RPC surface (``report``/``get``) dispatching
+typed messages to the master's subsystems.
+
+Parity: dlrover/python/master/servicer.py:62 (MasterServicer, dispatch in
+``get:88``/``report:285``) and ``create_master_service:570``. We register a
+generic bytes handler instead of protoc-generated stubs — same wire shape
+(length-delimited pickled dataclasses from the comm catalog) with no
+codegen step.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import default_logger as logger
+
+SERVICE_NAME = "dlrover_tpu.Master"
+
+
+def _event_status(report) -> str:
+    from dlrover_tpu.common.constants import NodeEventType, NodeStatus
+
+    if report.status:
+        return report.status
+    return {
+        NodeEventType.ADDED: NodeStatus.RUNNING,
+        NodeEventType.DELETED: NodeStatus.DELETED,
+    }.get(report.event_type, NodeStatus.FAILED)
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        task_manager=None,
+        job_manager=None,
+        rdzv_managers=None,
+        kv_store=None,
+        sync_service=None,
+        speed_monitor=None,
+        elastic_ps_service=None,
+        paral_config_service=None,
+    ):
+        self._task_manager = task_manager
+        self._job_manager = job_manager
+        self._rdzv_managers = rdzv_managers or {}
+        self._kv_store = kv_store
+        self._sync_service = sync_service
+        self._speed_monitor = speed_monitor
+        self._elastic_ps_service = elastic_ps_service
+        self._paral_config_service = paral_config_service
+        self._lock = threading.Lock()
+        self._node_addrs: dict = {}  # node_type -> {rank: addr}
+        self._ckpt_steps: dict = {}  # node_id -> latest in-memory ckpt step
+        self._run_configs: dict = {}
+
+    # ------------------------------------------------------------------
+    # RPC entrypoints (bytes in/out)
+    # ------------------------------------------------------------------
+    def get(self, request_bytes: bytes, context=None) -> bytes:
+        req: comm.BaseRequest = comm.deserialize_message(request_bytes)
+        message = comm.deserialize_message(req.data)
+        response = comm.BaseResponse()
+        try:
+            result = self._dispatch_get(req, message)
+            if result is not None:
+                response.data = comm.serialize_message(result)
+        except Exception as e:
+            logger.error(f"get({type(message).__name__}) failed: {e!r}")
+            response.success = False
+            response.message = repr(e)
+        return comm.serialize_message(response)
+
+    def report(self, request_bytes: bytes, context=None) -> bytes:
+        req: comm.BaseRequest = comm.deserialize_message(request_bytes)
+        message = comm.deserialize_message(req.data)
+        response = comm.BaseResponse()
+        try:
+            result = self._dispatch_report(req, message)
+            if result is False:
+                response.success = False
+            elif result is not None and result is not True:
+                response.data = comm.serialize_message(result)
+        except Exception as e:
+            logger.error(f"report({type(message).__name__}) failed: {e!r}")
+            response.success = False
+            response.message = repr(e)
+        return comm.serialize_message(response)
+
+    # ------------------------------------------------------------------
+    # GET dispatch
+    # ------------------------------------------------------------------
+    def _dispatch_get(self, req: comm.BaseRequest, message):
+        if isinstance(message, comm.TaskRequest):
+            return self._get_task(req.node_id, message)
+        if isinstance(message, comm.CommWorldRequest):
+            return self._get_comm_world(message)
+        if isinstance(message, comm.WaitingNodeNumRequest):
+            return self._get_waiting_node_num(message)
+        if isinstance(message, comm.KeyValueQuery):
+            value = self._kv_store.get(message.key) if self._kv_store else b""
+            return comm.KeyValuePair(key=message.key, value=value)
+        if isinstance(message, comm.KeyValueWait):
+            ok = (
+                self._kv_store.wait(message.keys, message.timeout)
+                if self._kv_store
+                else False
+            )
+            return comm.SyncResult(done=ok)
+        if isinstance(message, comm.NetworkReadyRequest):
+            mgr = self._rdzv_managers.get("network-check")
+            if mgr is None:
+                return comm.NetworkCheckStatus(reason="no_manager")
+            ok, reason = mgr.network_check_success()
+            return comm.SyncResult(done=ok)
+        if isinstance(message, comm.NetworkCheckStatus):
+            # query fault nodes
+            mgr = self._rdzv_managers.get("network-check")
+            if mgr is None:
+                return comm.NetworkCheckStatus(reason="no_manager")
+            nodes, reason = mgr.check_fault_node()
+            return comm.NetworkCheckStatus(nodes=nodes, reason=reason)
+        if isinstance(message, comm.StragglerExistRequest):
+            mgr = self._rdzv_managers.get("network-check")
+            if mgr is None:
+                return comm.NetworkCheckStatus(reason="no_manager")
+            nodes, reason = mgr.get_stragglers()
+            return comm.NetworkCheckStatus(nodes=nodes, reason=reason)
+        if isinstance(message, comm.ShardCheckpointRequest):
+            content = self._task_manager.checkpoint() if self._task_manager else ""
+            return comm.ShardCheckpoint(content=content)
+        if isinstance(message, comm.DatasetEpochRequest):
+            epoch = (
+                self._task_manager.get_epoch(message.dataset_name)
+                if self._task_manager
+                else 0
+            )
+            return comm.DatasetEpoch(epoch=epoch)
+        if isinstance(message, comm.ClusterVersionRequest):
+            version = 0
+            if self._elastic_ps_service:
+                version = self._elastic_ps_service.get_version(
+                    message.version_type, message.node_type, message.node_id
+                )
+            return comm.ClusterVersion(version=version)
+        if isinstance(message, comm.ParallelConfigRequest):
+            if self._paral_config_service:
+                return self._paral_config_service.get_config(req.node_id)
+            return comm.ParallelConfig()
+        if isinstance(message, comm.NodeAddressRequest):
+            with self._lock:
+                addrs = dict(self._node_addrs.get(message.node_type, {}))
+            return comm.NodeAddresses(addrs=addrs)
+        if isinstance(message, comm.ElasticRunConfigRequest):
+            return comm.ElasticRunConfig(configs=dict(self._run_configs))
+        if isinstance(message, comm.SyncJoinRequest):
+            # query join-sync completion
+            done = (
+                self._sync_service.sync_finished(message.sync_name)
+                if self._sync_service
+                else False
+            )
+            return comm.SyncResult(done=done)
+        if isinstance(message, comm.BarrierRequest):
+            done = (
+                self._sync_service.barrier(message.barrier_name)
+                if self._sync_service
+                else False
+            )
+            return comm.SyncResult(done=done)
+        raise ValueError(f"unknown get message: {type(message).__name__}")
+
+    def _get_task(self, node_id: int, message: comm.TaskRequest) -> comm.Task:
+        if self._task_manager is None:
+            return comm.Task()
+        return self._task_manager.get_dataset_task(
+            node_id, message.dataset_name
+        )
+
+    def _get_comm_world(self, message: comm.CommWorldRequest) -> comm.CommWorld:
+        mgr = self._rdzv_managers.get(message.rdzv_name)
+        if mgr is None:
+            return comm.CommWorld(rdzv_name=message.rdzv_name)
+        rnd, group, world, coord = mgr.get_comm_world(message.node_id)
+        return comm.CommWorld(
+            rdzv_name=message.rdzv_name,
+            round=rnd,
+            group=group,
+            world=world,
+            coordinator_addr=coord,
+        )
+
+    def _get_waiting_node_num(
+        self, message: comm.WaitingNodeNumRequest
+    ) -> comm.WaitingNodeNum:
+        mgr = self._rdzv_managers.get(message.rdzv_name)
+        num = mgr.num_nodes_waiting() if mgr else 0
+        return comm.WaitingNodeNum(waiting_num=num)
+
+    # ------------------------------------------------------------------
+    # REPORT dispatch
+    # ------------------------------------------------------------------
+    def _dispatch_report(self, req: comm.BaseRequest, message):
+        if isinstance(message, comm.DatasetShardParams):
+            if self._task_manager:
+                self._task_manager.new_dataset(message)
+            return True
+        if isinstance(message, comm.TaskResult):
+            if self._task_manager:
+                return self._task_manager.report_dataset_task(
+                    message.dataset_name, message.task_id
+                )
+            return True
+        if isinstance(message, comm.ShardCheckpoint):
+            if self._task_manager:
+                self._task_manager.restore_checkpoint(message.content)
+            return True
+        if isinstance(message, comm.JoinRendezvousRequest):
+            return self._join_rendezvous(req, message)
+        if isinstance(message, comm.NetworkCheckResultRequest):
+            mgr = self._rdzv_managers.get("network-check")
+            if mgr:
+                mgr.report_network_check_result(
+                    message.node_id, message.succeeded, message.elapsed_time
+                )
+            return True
+        if isinstance(message, comm.NodeFailureReport):
+            if self._job_manager:
+                self._job_manager.handle_training_failure(
+                    req.node_type or "worker",
+                    message.node_id,
+                    message.restart_count,
+                    message.error_data,
+                    message.level,
+                )
+            return True
+        if isinstance(message, comm.NodeEventReport):
+            if self._job_manager:
+                from dlrover_tpu.common.node import Node
+                from dlrover_tpu.master.job_manager import NodeEvent
+
+                node = Node(
+                    node_type=message.node_type or "worker",
+                    node_id=message.node_id,
+                )
+                node.status = _event_status(message)
+                node.exit_reason = message.exit_reason
+                self._job_manager.process_event(
+                    NodeEvent(message.event_type, node)
+                )
+            return True
+        if isinstance(message, comm.HeartbeatReport):
+            action = ""
+            if self._job_manager:
+                action = self._job_manager.collect_node_heartbeat(
+                    req.node_type or "worker", message.node_id
+                )
+            return comm.HeartbeatResponse(action=action)
+        if isinstance(message, comm.ResourceStats):
+            if self._job_manager:
+                self._job_manager.update_node_resource_usage(
+                    req.node_type or "worker",
+                    message.node_id,
+                    message.cpu_percent,
+                    message.used_memory_mb,
+                )
+            return True
+        if isinstance(message, comm.GlobalStepReport):
+            if self._speed_monitor:
+                self._speed_monitor.collect_global_step(
+                    message.step, message.timestamp or time.time()
+                )
+            return True
+        if isinstance(message, comm.TrainingStatusReport):
+            if self._speed_monitor and message.status == 1:
+                self._speed_monitor.set_start_timestamp()
+            return True
+        if isinstance(message, comm.KeyValuePair):
+            if self._kv_store:
+                self._kv_store.set(message.key, message.value)
+            return True
+        if isinstance(message, comm.KeyValueAdd):
+            if self._kv_store:
+                value = self._kv_store.add(message.key, message.amount)
+                return comm.KeyValuePair(
+                    key=message.key, value=str(value).encode()
+                )
+            return True
+        if isinstance(message, comm.UpdateClusterVersionRequest):
+            if self._elastic_ps_service:
+                self._elastic_ps_service.update_version(
+                    message.version_type,
+                    message.node_type,
+                    message.node_id,
+                    message.version,
+                )
+            return True
+        if isinstance(message, comm.SyncJoinRequest):
+            if self._sync_service:
+                return self._sync_service.join_sync(
+                    message.sync_name, message.node_type, message.node_id
+                )
+            return True
+        if isinstance(message, comm.SyncFinishRequest):
+            if self._sync_service:
+                self._sync_service.finish_sync(message.sync_name)
+            return True
+        if isinstance(message, comm.BarrierRequest):
+            if self._sync_service and message.notify:
+                return self._sync_service.notify_barrier(message.barrier_name)
+            return True
+        if isinstance(message, comm.NodeMeta):
+            with self._lock:
+                self._node_addrs.setdefault(message.node_type, {})[
+                    message.rank_index
+                ] = message.addr
+            return True
+        if isinstance(message, comm.CheckpointReadyRequest):
+            with self._lock:
+                self._ckpt_steps[message.node_id] = message.step
+            return True
+        raise ValueError(f"unknown report message: {type(message).__name__}")
+
+    def _join_rendezvous(
+        self, req: comm.BaseRequest, message: comm.JoinRendezvousRequest
+    ):
+        mgr = self._rdzv_managers.get(message.rdzv_name)
+        if mgr is None:
+            return False
+        with self._lock:
+            addr = self._node_addrs.get("worker", {}).get(
+                message.node_rank, ""
+            )
+        rnd = mgr.join_rendezvous(
+            message.node_rank,
+            message.local_world_size,
+            addr=addr,
+            node_group=message.node_group,
+        )
+        if self._speed_monitor:
+            self._speed_monitor.reset_running_speed_monitor()
+        return comm.ClusterVersion(version=rnd)
+
+
+def create_master_service(
+    port: int, servicer: MasterServicer, max_workers: int = 32
+) -> grpc.Server:
+    """Start the gRPC server with identity (bytes) codecs.
+
+    Parity: servicer.py:570 create_master_service.
+    """
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            ("grpc.max_send_message_length", 256 * 1024 * 1024),
+            ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+        ],
+    )
+    handlers = {
+        "get": grpc.unary_unary_rpc_method_handler(servicer.get),
+        "report": grpc.unary_unary_rpc_method_handler(servicer.report),
+    }
+    generic = grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+    server.add_generic_rpc_handlers((generic,))
+    server.add_insecure_port(f"0.0.0.0:{port}")
+    server.start()
+    return server

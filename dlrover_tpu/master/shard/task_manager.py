@@ -1,0 +1,228 @@
+"""Shard task dispatch: workers pull shard "tasks"; dead workers' shards
+are recovered and re-dispatched; shard progress is checkpointable so a
+restarted job resumes mid-epoch.
+
+Parity: dlrover/python/master/shard/task_manager.py:37 (TaskManager) and
+batch_dataset_manager.py:203 (todo/doing bookkeeping, ``recover_task``,
+``checkpoint``/``restore_checkpoint``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.comm import (
+    DatasetShardParams,
+    Shard,
+    Task,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.shard.dataset_splitter import (
+    DatasetSplitter,
+    new_dataset_splitter,
+)
+
+
+class _DoingTask:
+    def __init__(self, task: Task, node_id: int):
+        self.task = task
+        self.node_id = node_id
+        self.start_time = time.time()
+
+
+class BatchDatasetManager:
+    """Owns the todo queue + doing set of one dataset."""
+
+    def __init__(self, splitter: DatasetSplitter, task_type: str = "train"):
+        self._splitter = splitter
+        self._task_type = task_type
+        self.todo: List[Task] = []
+        self.doing: Dict[int, _DoingTask] = {}
+        self._task_id = 0
+        self._completed_step = 0
+
+    @property
+    def dataset_name(self) -> str:
+        return self._splitter.dataset_name
+
+    def _create_tasks_of_epoch(self) -> bool:
+        if self._splitter.epoch_finished():
+            return False
+        for shard in self._splitter.create_shards():
+            self.todo.append(
+                Task(
+                    task_id=self._task_id,
+                    task_type=self._task_type,
+                    shard=shard,
+                )
+            )
+            self._task_id += 1
+        return True
+
+    def get_task(self, node_id: int) -> Task:
+        if not self.todo and not self._create_tasks_of_epoch():
+            return Task()  # empty: dataset exhausted
+        if not self.todo:
+            return Task()
+        task = self.todo.pop(0)
+        self.doing[task.task_id] = _DoingTask(task, node_id)
+        return task
+
+    def report_task_done(self, task_id: int, success: bool = True) -> bool:
+        doing = self.doing.pop(task_id, None)
+        if doing is None:
+            # Duplicate/stale report (e.g. the task was recovered after the
+            # worker was presumed dead but it finished anyway) — ack it, the
+            # worker did nothing wrong.
+            logger.info(f"ignore stale task report: {task_id}")
+            return True
+        if not success:
+            self.todo.insert(0, doing.task)
+        return True
+
+    def recover_tasks_of_node(self, node_id: int):
+        """Re-queue shards a dead worker was processing."""
+        dead = [
+            tid for tid, d in self.doing.items() if d.node_id == node_id
+        ]
+        for tid in dead:
+            doing = self.doing.pop(tid)
+            logger.info(
+                f"recover task {tid} of dataset {self.dataset_name} "
+                f"from dead node {node_id}"
+            )
+            self.todo.insert(0, doing.task)
+
+    def completed(self) -> bool:
+        return (
+            self._splitter.epoch_finished()
+            and not self.todo
+            and not self.doing
+        )
+
+    @property
+    def epoch(self) -> int:
+        return self._splitter.epoch
+
+    # -- shard checkpoint ---------------------------------------------
+    def checkpoint(self) -> Dict:
+        shards = [
+            (t.shard.start, t.shard.end, t.shard.record_indices)
+            for t in self.todo
+        ] + [
+            (d.task.shard.start, d.task.shard.end, d.task.shard.record_indices)
+            for d in self.doing.values()
+        ]
+        return {
+            "dataset_name": self.dataset_name,
+            "todo": shards,
+            "epoch": self._splitter.epoch,
+        }
+
+    def restore_checkpoint(self, ckpt: Dict):
+        self.todo = []
+        self.doing = {}
+        self._splitter.epoch = ckpt.get("epoch", 0)
+        for start, end, indices in ckpt.get("todo", []):
+            self.todo.append(
+                Task(
+                    task_id=self._task_id,
+                    task_type=self._task_type,
+                    shard=Shard(
+                        name=self.dataset_name,
+                        start=start,
+                        end=end,
+                        record_indices=indices,
+                    ),
+                )
+            )
+            self._task_id += 1
+
+
+class TaskManager:
+    """All datasets of a job (parity: task_manager.py:37)."""
+
+    def __init__(self, speed_monitor=None):
+        self._lock = threading.Lock()
+        self._datasets: Dict[str, BatchDatasetManager] = {}
+        self._speed_monitor = speed_monitor
+        self._worker_start_task_time: Dict[int, float] = {}
+
+    def new_dataset(self, params: DatasetShardParams):
+        with self._lock:
+            if params.dataset_name in self._datasets:
+                return
+            shard_size = max(
+                1, params.batch_size * params.num_minibatches_per_shard
+            )
+            splitter = new_dataset_splitter(
+                shuffle=params.shuffle,
+                shard_size=shard_size,
+                dataset_size=params.dataset_size,
+                num_epochs=params.num_epochs,
+                dataset_name=params.dataset_name,
+                storage_type=params.storage_type,
+            )
+            self._datasets[params.dataset_name] = BatchDatasetManager(
+                splitter, params.task_type or "train"
+            )
+
+    def get_dataset_task(self, node_id: int, dataset_name: str) -> Task:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return Task()
+            self._worker_start_task_time[node_id] = time.time()
+            return ds.get_task(node_id)
+
+    def report_dataset_task(
+        self, dataset_name: str, task_id: int, success: bool = True
+    ) -> bool:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return False
+            return ds.report_task_done(task_id, success)
+
+    def recover_tasks(self, node_id: int):
+        with self._lock:
+            for ds in self._datasets.values():
+                ds.recover_tasks_of_node(node_id)
+
+    def finished(self) -> bool:
+        with self._lock:
+            if not self._datasets:
+                return False
+            return all(ds.completed() for ds in self._datasets.values())
+
+    def get_epoch(self, dataset_name: str) -> int:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            return ds.epoch if ds else 0
+
+    def reset_worker_start_task_time(self, node_id: int):
+        with self._lock:
+            self._worker_start_task_time.pop(node_id, None)
+
+    # -- shard checkpoint ---------------------------------------------
+    def checkpoint(self) -> str:
+        with self._lock:
+            return json.dumps(
+                {
+                    name: ds.checkpoint()
+                    for name, ds in self._datasets.items()
+                }
+            )
+
+    def restore_checkpoint(self, content: str):
+        if not content:
+            return
+        data = json.loads(content)
+        with self._lock:
+            for name, ckpt in data.items():
+                ds = self._datasets.get(name)
+                if ds is not None:
+                    ds.restore_checkpoint(ckpt)

@@ -1,0 +1,238 @@
+"""End-to-end master<->client tests: real LocalJobMaster + real gRPC
+MasterClient on localhost (the reference's key fixture pattern,
+SURVEY.md §4)."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import (
+    NodeStatus,
+    RendezvousName,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.master.local_master import start_local_master
+from dlrover_tpu.master.rdzv_manager import NetworkCheckRendezvousManager
+from dlrover_tpu.master.shard.dataset_splitter import (
+    TableDatasetSplitter,
+    TextDatasetSplitter,
+)
+
+
+@pytest.fixture(scope="module")
+def master():
+    m = start_local_master(node_num=2)
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def client(master):
+    c = MasterClient(master.addr, node_id=0)
+    yield c
+    c.close()
+
+
+class TestSharding:
+    def test_dispatch_and_recover(self, master, client):
+        client.report_dataset_shard_params(
+            comm.DatasetShardParams(
+                batch_size=4,
+                num_minibatches_per_shard=2,
+                dataset_size=64,
+                num_epochs=1,
+                dataset_name="ds1",
+            )
+        )
+        task = client.get_task("ds1")
+        assert task.task_id == 0
+        assert task.shard.end - task.shard.start == 8
+        client.report_task_result("ds1", task.task_id)
+        # worker 1 takes a task and dies -> shard is recovered
+        c1 = MasterClient(master.addr, node_id=1)
+        t1 = c1.get_task("ds1")
+        assert not t1.is_empty
+        master.task_manager.recover_tasks(1)
+        seen = {t1.task_id}
+        while True:
+            t = client.get_task("ds1")
+            if t.is_empty:
+                break
+            seen.add(t.task_id)
+            client.report_task_result("ds1", t.task_id)
+        # all 8 shards get done despite worker-1 death
+        assert master.task_manager.finished()
+        c1.close()
+
+    def test_shard_checkpoint_roundtrip(self, master, client):
+        client.report_dataset_shard_params(
+            comm.DatasetShardParams(
+                batch_size=2,
+                num_minibatches_per_shard=1,
+                dataset_size=8,
+                num_epochs=1,
+                dataset_name="ds2",
+            )
+        )
+        ckpt = client.get_shard_checkpoint()
+        assert "ds2" in ckpt
+        client.report_shard_checkpoint(ckpt)
+
+
+class TestSplitters:
+    def test_table_splitter(self):
+        s = TableDatasetSplitter("t", dataset_size=10, shard_size=4)
+        shards = s.create_shards()
+        assert [(x.start, x.end) for x in shards] == [(0, 4), (4, 8), (8, 10)]
+        assert s.epoch_finished()
+
+    def test_text_splitter_shuffle(self):
+        s = TextDatasetSplitter(
+            "t", dataset_size=10, shard_size=5, shuffle=True
+        )
+        shards = s.create_shards()
+        all_indices = sorted(
+            i for sh in shards for i in sh.record_indices
+        )
+        assert all_indices == list(range(10))
+
+
+class TestRendezvous:
+    def test_two_node_world(self, master):
+        rdzv = master.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+        rdzv.update_rdzv_params(
+            min_nodes=2, max_nodes=2, waiting_timeout=5
+        )
+        c0 = MasterClient(master.addr, node_id=0)
+        c1 = MasterClient(master.addr, node_id=1)
+        c0.register_node_addr(0, "127.0.0.1:7000")
+        c1.register_node_addr(1, "127.0.0.1:7001")
+        c0.join_rendezvous(0, local_world_size=4)
+        c1.join_rendezvous(1, local_world_size=4)
+        w0 = c0.get_comm_world(RendezvousName.ELASTIC_TRAINING, 0)
+        w1 = c1.get_comm_world(RendezvousName.ELASTIC_TRAINING, 1)
+        assert w0.world == {0: 4, 1: 4}
+        assert w1.world == {0: 4, 1: 4}
+        # coordinator = lowest rank's addr (JAX distributed bootstrap)
+        assert w0.coordinator_addr == "127.0.0.1:7000"
+        assert c0.num_nodes_waiting() == 0
+        # a third node shows up -> agents see waiting>0 and restart
+        c2 = MasterClient(master.addr, node_id=2)
+        c2.join_rendezvous(2, local_world_size=4)
+        assert c0.num_nodes_waiting() == 1
+        for c in (c0, c1, c2):
+            c.close()
+
+    def test_node_unit_gating(self):
+        from dlrover_tpu.master.rdzv_manager import (
+            ElasticTrainingRendezvousManager,
+        )
+
+        rdzv = ElasticTrainingRendezvousManager()
+        # 2 hosts per slice: a lone 3rd host must NOT enter the world
+        rdzv.update_rdzv_params(
+            min_nodes=2, max_nodes=4, waiting_timeout=0, node_unit=2
+        )
+        for r in (0, 1, 2):
+            rdzv.join_rendezvous(r, 1, addr=f"h{r}:1")
+        rnd, _, world, coord = rdzv.get_comm_world(0)
+        assert sorted(world) == [0, 1]
+        assert coord == "h0:1"
+        # host 2 stays waiting for a slice-mate
+        assert rdzv.num_nodes_waiting() == 1
+
+
+class TestNetworkCheck:
+    def _run_round(self, rdzv, n, fail_ranks=(), slow_ranks=()):
+        for r in range(n):
+            rdzv.join_rendezvous(r, 1, addr=f"h{r}:1")
+        worlds = {}
+        for r in range(n):
+            rnd, grp, world, _ = rdzv.get_comm_world(r)
+            worlds[r] = (rnd, grp, world)
+        for r in range(n):
+            t = 40.0 if r in slow_ranks else 10.0
+            rdzv.report_network_check_result(r, r not in fail_ranks, t)
+        rdzv.clear_waiting_nodes()
+        return worlds
+
+    def test_pairing_changes_between_rounds(self):
+        rdzv = NetworkCheckRendezvousManager()
+        rdzv.update_rdzv_params(min_nodes=4, max_nodes=4, waiting_timeout=0)
+        w_even = self._run_round(rdzv, 4)
+        w_odd = self._run_round(rdzv, 4)
+        # round 0 pairs (0,1),(2,3); round 1 pairs (3,0),(1,2)
+        assert w_even[0][2] == {0: 1, 1: 1}
+        assert sorted(w_odd[0][2]) == [0, 3]
+
+    def test_fault_bisect(self):
+        rdzv = NetworkCheckRendezvousManager()
+        rdzv.update_rdzv_params(min_nodes=4, max_nodes=4, waiting_timeout=0)
+        # node 2 is broken: in round 0 its group (2,3) fails; in round 1
+        # its group (1,2) fails -> intersection pins node 2 (and partners
+        # that failed twice, which is only node 2).
+        self._run_round(rdzv, 4, fail_ranks={2})
+        self._run_round(rdzv, 4, fail_ranks={2})
+        faults, reason = rdzv.check_fault_node()
+        assert faults == [2]
+        ok, why = rdzv.network_check_success()
+        assert not ok
+
+    def test_straggler_detection(self):
+        rdzv = NetworkCheckRendezvousManager()
+        rdzv.update_rdzv_params(min_nodes=4, max_nodes=4, waiting_timeout=0)
+        self._run_round(rdzv, 4, slow_ranks={1})
+        self._run_round(rdzv, 4, slow_ranks={1})
+        stragglers, _ = rdzv.get_stragglers()
+        assert stragglers == [1]
+        ok, _ = rdzv.network_check_success()
+        assert ok  # stragglers are slow, not failed
+
+
+class TestLifecycle:
+    def test_heartbeat_and_failure(self, master, client):
+        action = client.report_heartbeat()
+        assert action == ""
+        node = master.job_manager.get_node("worker", 0)
+        assert node.heartbeat_time > 0
+        # process-level failure: no relaunch
+        client.report_failure(
+            "oops", TrainingExceptionLevel.PROCESS_ERROR, restart_count=1
+        )
+        assert master.job_manager.get_node("worker", 0).status != NodeStatus.BREAKDOWN
+        # node-level failure triggers relaunch bookkeeping
+        n_before = len(master.job_manager.get_nodes("worker"))
+        client.report_failure("xla halt", TrainingExceptionLevel.NODE_ERROR)
+        nodes = master.job_manager.get_nodes("worker")
+        assert len(nodes) == n_before + 1
+
+    def test_resource_and_step_reports(self, master, client):
+        client.report_resource_stats(55.0, 2048)
+        node = master.job_manager.get_node("worker", 0)
+        assert node.used_resource.memory_mb == 2048
+        client.report_training_status(1)
+        client.report_global_step(10)
+        time.sleep(0.05)
+        client.report_global_step(20)
+        assert master.speed_monitor.completed_global_step == 20
+        assert master.speed_monitor.running_speed() > 0
+
+    def test_kv_store(self, client):
+        client.kv_store_set("k1", b"v1")
+        assert client.kv_store_get("k1") == b"v1"
+        assert client.kv_store_add("ctr", 5) == 5
+        assert client.kv_store_add("ctr", 3) == 8
+        assert client.kv_store_wait(["k1"], timeout=2)
+        assert not client.kv_store_wait(["missing"], timeout=0.3)
+
+    def test_paral_config(self, master, client):
+        master.paral_config_service.suggest_initial_config(batch_size=32)
+        cfg = client.get_paral_config()
+        assert cfg.dataloader.batch_size == 32
+
+    def test_sync_barrier(self, master, client):
+        assert client.barrier("b1") is False
+        client.barrier("b1", notify=True)
+        assert client.barrier("b1") is True

@@ -1,0 +1,102 @@
+"""Agent-side network check: run the paired health-check rendezvous twice,
+report timings, learn which hosts are faulty/straggling.
+
+Parity: dlrover/python/elastic_agent/torch/training.py:799
+(NetworkCheckElasticAgent) + :1014 (run_network_check) — two rounds with
+different partners (master pairs them, rdzv_manager.py:353) bisect a bad
+host with no healthy-host false positives.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import tempfile
+import time
+from typing import Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training_agent import ElasticTrainingAgent, WorkerSpec
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.log import default_logger as logger
+
+CHECK_TIMEOUT_SECS = 300
+
+
+class _NodeCheckAgent(ElasticTrainingAgent):
+    """Reuses the rendezvous + process plumbing to run one check round."""
+
+    def run_round(self, result_file: str) -> Tuple[bool, float]:
+        world = self._rendezvous(timeout=CHECK_TIMEOUT_SECS)
+        self._spec.env["DLROVER_TPU_CHECK_RESULT_FILE"] = result_file
+        self._start_workers(world)
+        try:
+            deadline = time.time() + CHECK_TIMEOUT_SECS
+            while time.time() < deadline:
+                state = self._monitor_workers()
+                if state.value != "HEALTHY":
+                    break
+                time.sleep(0.5)
+            else:
+                return False, CHECK_TIMEOUT_SECS
+            success = state.value == "SUCCEEDED"
+            elapsed = 0.0
+            for path in glob.glob(f"{result_file}.*"):
+                try:
+                    with open(path) as f:
+                        elapsed = max(elapsed, json.load(f)["elapsed"])
+                except (OSError, ValueError, KeyError):
+                    success = False
+            return success, elapsed
+        finally:
+            # always reap: a peer-failed round leaves survivors blocked in
+            # a collective; leaking them would poison the next round (and
+            # on real TPU they hold the chip lock)
+            self._stop_workers()
+
+
+def run_network_check(
+    node_rank: int,
+    nproc_per_node: int,
+    client: MasterClient,
+    device_spec: str = "",
+    rounds: int = 2,
+) -> bool:
+    """Returns True if THIS node passes the check."""
+    check_script = os.path.join(
+        os.path.dirname(__file__), "..", "trainer", "node_check", "tpu_check.py"
+    )
+    check_script = os.path.abspath(check_script)
+    tmpdir = tempfile.mkdtemp(prefix="dlrover_tpu_check_")
+    spec = WorkerSpec(
+        entrypoint=check_script,
+        nproc_per_node=nproc_per_node,
+        rdzv_name=RendezvousName.NETWORK_CHECK,
+        device_spec=device_spec,
+        env={},
+    )
+    agent = _NodeCheckAgent(node_rank=node_rank, spec=spec, client=client)
+    for rnd in range(rounds):
+        result_file = os.path.join(tmpdir, f"round{rnd}")
+        success, elapsed = agent.run_round(result_file)
+        logger.info(
+            f"node {node_rank} check round {rnd}: "
+            f"success={success} elapsed={elapsed:.3f}s"
+        )
+        client.report_network_check_result(node_rank, success, elapsed)
+        # wait until the master has everyone's report for this round
+        deadline = time.time() + CHECK_TIMEOUT_SECS
+        while time.time() < deadline:
+            _, reason = client.check_fault_node()
+            if reason != "not_all_reported":
+                break
+            time.sleep(0.5)
+    faults, _ = client.check_fault_node()
+    stragglers, _ = client.check_straggler()
+    if stragglers:
+        logger.warning(f"straggler hosts detected: {stragglers}")
+    if node_rank in faults:
+        logger.error(f"node {node_rank} is faulty (faults={faults})")
+        return False
+    return True

@@ -1,0 +1,144 @@
+"""Worker-side dynamic-sharding client.
+
+Parity: dlrover/python/elastic_agent/sharding/client.py:29
+(``ShardingClient``) and :231 (``IndexShardingClient`` feeding the
+sampler with per-sample indices). Workers pull shard tasks from the
+master's TaskManager; a dead worker's in-flight shards are re-dispatched,
+so the dataset is consumed exactly once per epoch regardless of failures.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import comm
+
+
+class ShardingClient:
+    def __init__(
+        self,
+        client: MasterClient,
+        dataset_name: str,
+        batch_size: int,
+        num_epochs: int = 1,
+        dataset_size: int = 0,
+        shuffle: bool = False,
+        task_type: str = "train",
+        num_minibatches_per_shard: int = 2,
+        storage_type: str = "text",
+    ):
+        self._client = client
+        self.dataset_name = dataset_name
+        self._client.report_dataset_shard_params(
+            comm.DatasetShardParams(
+                batch_size=batch_size,
+                num_epochs=num_epochs,
+                dataset_size=dataset_size,
+                shuffle=shuffle,
+                num_minibatches_per_shard=num_minibatches_per_shard,
+                dataset_name=dataset_name,
+                task_type=task_type,
+                storage_type=storage_type,
+            )
+        )
+        self._current_task: Optional[comm.Task] = None
+
+    def fetch_shard(self) -> Optional[comm.Shard]:
+        """Get the next shard; None when the dataset is exhausted."""
+        task = self._client.get_task(self.dataset_name)
+        if task.is_empty:
+            return None
+        self._current_task = task
+        return task.shard
+
+    def report_shard_done(self):
+        if self._current_task is not None:
+            self._client.report_task_result(
+                self.dataset_name, self._current_task.task_id
+            )
+            self._current_task = None
+
+    def get_shard_checkpoint(self) -> str:
+        return self._client.get_shard_checkpoint()
+
+    def restore_shard_checkpoint(self, content: str):
+        self._client.report_shard_checkpoint(content)
+
+    def get_current_epoch(self) -> int:
+        return self._client.get_dataset_epoch(self.dataset_name)
+
+
+class IndexShardingClient(ShardingClient):
+    """Streams per-sample indices out of master-assigned shards.
+
+    Parity: client.py:231 — backs a sampler/dataset with dynamic shards;
+    ``fetch_sample_index`` blocks for more shards and raises StopIteration
+    when the dataset is exhausted.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._index_queue: "queue.Queue[Optional[int]]" = queue.Queue()
+        self._pending_tasks: "queue.Queue[comm.Task]" = queue.Queue()
+        self._exhausted = False
+        self._lock = threading.Lock()
+        # records consumed but not yet credited against a pending shard
+        self._uncredited = 0
+
+    def _fill(self):
+        with self._lock:
+            if self._exhausted:
+                return
+            task = self._client.get_task(self.dataset_name)
+            if task.is_empty:
+                self._exhausted = True
+                self._index_queue.put(None)
+                return
+            shard = task.shard
+            indices = shard.record_indices or range(shard.start, shard.end)
+            for idx in indices:
+                self._index_queue.put(int(idx))
+            self._pending_tasks.put(task)
+
+    def fetch_sample_index(self) -> int:
+        while True:
+            try:
+                idx = self._index_queue.get_nowait()
+            except queue.Empty:
+                self._fill()
+                continue
+            if idx is None:
+                self._index_queue.put(None)  # keep the sentinel for peers
+                raise StopIteration
+            return idx
+
+    def report_batch_done(self, batch_size: int):
+        """Credit ``batch_size`` consumed records; ack a pending shard only
+        once it is *fully* consumed (parity: client.py report_batch_done
+        counts records — acking early would forfeit crash recovery for the
+        still-in-flight remainder)."""
+        with self._lock:
+            self._uncredited += batch_size
+            while True:
+                try:
+                    task = self._pending_tasks.queue[0]
+                except IndexError:
+                    return
+                size = task.shard.end - task.shard.start
+                if self._uncredited < size:
+                    return
+                self._uncredited -= size
+                self._pending_tasks.get_nowait()
+                self._client.report_task_result(
+                    self.dataset_name, task.task_id
+                )
+
+    def __iter__(self):
+        while True:
+            try:
+                yield self.fetch_sample_index()
+            except StopIteration:
+                return

@@ -170,6 +170,18 @@ class JoinRendezvousRequest(Message):
 
 
 @dataclass
+class RendezvousParamsReport(Message):
+    """Agent -> master: configure a rendezvous (parity: the rdzv params the
+    MasterRendezvousHandler reports at construction, training.py:732)."""
+
+    rdzv_name: str = ""
+    min_nodes: int = 1
+    max_nodes: int = 1
+    waiting_timeout: float = 30.0
+    node_unit: int = 1
+
+
+@dataclass
 class WaitingNodeNumRequest(Message):
     node_id: int = 0
     local_world_size: int = 1

@@ -69,6 +69,7 @@ class RendezvousManager:
         self._rdzv_round = 0
         self._latest_log_time = 0.0
         self._start_rdzv_time = 0.0
+        self._lastcall_time = 0.0
         self._coordinator_addr = ""
         self._node_groups: Dict[int, int] = {}
 
@@ -97,13 +98,23 @@ class RendezvousManager:
         addr: str = "",
         node_group: int = -1,
     ) -> int:
-        """Node announces readiness; returns the round it will join."""
+        """Node announces readiness; returns the round it will join.
+
+        A node re-joining after a restart leaves the frozen world — the old
+        world is defunct for it; it waits for the next round with everyone
+        else. ``waiting_timeout`` is a *lastcall* window counted from the
+        most recent join, giving laggard agents time to notice the
+        membership change and re-join before the world freezes.
+        """
         with self._lock:
+            now = time.time()
             if not self._waiting_nodes:
-                self._start_rdzv_time = time.time()
+                self._start_rdzv_time = now
+            self._lastcall_time = now
             self._waiting_nodes[node_rank] = _WaitingNode(
                 node_rank, local_world_size, addr
             )
+            self._latest_rdzv_nodes.pop(node_rank, None)
             if node_group >= 0:
                 self._node_groups[node_rank] = node_group
             return self._rdzv_round
@@ -117,18 +128,14 @@ class RendezvousManager:
         """Nonzero ⇒ agents should restart workers to admit new members.
 
         Parity: rdzv_manager num_nodes_waiting used at training.py:665.
+        Counts every waiting node once a first world has formed (so agents
+        of the running world notice both new joiners AND peers that already
+        re-joined); always 0 during the initial rendezvous.
         """
         with self._lock:
-            # Nodes already in the latest world don't count as "waiting" —
-            # only genuinely new (or re-joining extra) members do.
-            if not self._latest_rdzv_nodes:
+            if self._rdzv_round == 0:
                 return 0
-            new_nodes = [
-                r
-                for r in self._waiting_nodes
-                if r not in self._latest_rdzv_nodes
-            ]
-            return len(new_nodes)
+            return len(self._waiting_nodes)
 
     # -- world assembly ------------------------------------------------
     def _ready(self) -> bool:
@@ -137,7 +144,7 @@ class RendezvousManager:
         if n >= p.max_nodes:
             return True
         if n >= p.min_nodes:
-            waited = time.time() - self._start_rdzv_time
+            waited = time.time() - self._lastcall_time
             return waited >= p.waiting_timeout
         return False
 

@@ -222,6 +222,16 @@ class MasterServicer:
             return True
         if isinstance(message, comm.JoinRendezvousRequest):
             return self._join_rendezvous(req, message)
+        if isinstance(message, comm.RendezvousParamsReport):
+            mgr = self._rdzv_managers.get(message.rdzv_name)
+            if mgr:
+                mgr.update_rdzv_params(
+                    message.min_nodes,
+                    message.max_nodes,
+                    message.waiting_timeout,
+                    message.node_unit,
+                )
+            return True
         if isinstance(message, comm.NetworkCheckResultRequest):
             mgr = self._rdzv_managers.get("network-check")
             if mgr:

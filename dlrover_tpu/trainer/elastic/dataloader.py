@@ -1,0 +1,99 @@
+"""Elastic data loader: batch size retunable at runtime via the
+master-driven paral-config file.
+
+Parity: dlrover/trainer/torch/elastic/dataloader.py:26 (ElasticDataLoader
+``:97-143`` re-reads batch size from the config file the agent's
+ParalConfigTuner writes). Framework-free: yields stacked numpy batches
+ready for ``jax.device_put``/``make_array_from_process_local_data``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.constants import ConfigPath
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.trainer.elastic.sampler import ElasticDistributedSampler
+
+
+def read_paral_config(path: str = "") -> dict:
+    path = path or os.getenv(
+        ConfigPath.ENV_PARAL_CONFIG, ConfigPath.PARAL_CONFIG
+    )
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+class ElasticDataLoader:
+    def __init__(
+        self,
+        dataset,
+        batch_size: int = 1,
+        sampler: Optional[ElasticDistributedSampler] = None,
+        collate_fn: Optional[Callable] = None,
+        config_file: str = "",
+    ):
+        self.dataset = dataset
+        self._batch_size = batch_size
+        self.sampler = sampler or ElasticDistributedSampler(
+            len(dataset), shuffle=False
+        )
+        self._collate_fn = collate_fn or _default_collate
+        self._config_file = config_file
+        self.load_config()
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch_size
+
+    def set_batch_size(self, batch_size: int):
+        if batch_size > 0 and batch_size != self._batch_size:
+            logger.info(
+                f"dataloader batch size {self._batch_size} -> {batch_size}"
+            )
+            self._batch_size = batch_size
+
+    def load_config(self):
+        """Pick up a master-tuned batch size if present."""
+        config = read_paral_config(self._config_file)
+        dl = config.get("dataloader", {})
+        if dl.get("batch_size"):
+            self.set_batch_size(int(dl["batch_size"]))
+
+    def __iter__(self) -> Iterator:
+        batch = []
+        for idx in self.sampler:
+            batch.append(self.dataset[idx])
+            if len(batch) >= self._batch_size:
+                yield self._collate_fn(batch)
+                batch = []
+        if batch:
+            yield self._collate_fn(batch)
+
+    def __len__(self) -> int:
+        return -(-len(self.sampler) // self._batch_size)
+
+    # -- checkpoint ----------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"sampler": self.sampler.state_dict()}
+
+    def load_state_dict(self, state: dict):
+        self.sampler.load_state_dict(state.get("sampler", {}))
+
+
+def _default_collate(batch):
+    first = batch[0]
+    if isinstance(first, tuple):
+        return tuple(
+            np.stack([b[i] for b in batch]) for i in range(len(first))
+        )
+    if isinstance(first, dict):
+        return {k: np.stack([b[k] for b in batch]) for k in first}
+    return np.stack(batch)

@@ -1,0 +1,87 @@
+"""Training-process bootstrap: wire a JAX process into the elastic job.
+
+The TPU analog of torch's ``init_process_group`` + env:// rendezvous
+(reference: the env torchelastic exports and training.py:462 rank
+assignment): the agent exports ``NodeEnv`` vars computed from the
+master-assigned comm world; ``init_elastic()`` consumes them and calls
+``jax.distributed.initialize``. Our master owns coordinator address
+assignment and restart, which is the elasticity seam JAX itself lacks
+(SURVEY.md §5 "Distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.utils.device import configure_devices
+
+
+@dataclass
+class ElasticContext:
+    process_id: int = 0
+    num_processes: int = 1
+    node_rank: int = 0
+    node_num: int = 1
+    local_rank: int = 0
+    local_world_size: int = 1
+    restart_count: int = 0
+    rdzv_round: int = 0
+    coordinator_addr: str = ""
+    master_addr: str = ""
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def in_elastic_job(self) -> bool:
+        return bool(self.master_addr)
+
+
+def elastic_context() -> ElasticContext:
+    return ElasticContext(
+        process_id=int(os.getenv(NodeEnv.PROCESS_ID, "0")),
+        num_processes=int(os.getenv(NodeEnv.NUM_PROCESSES, "1")),
+        node_rank=int(os.getenv(NodeEnv.NODE_RANK, "0")),
+        node_num=int(os.getenv(NodeEnv.NODE_NUM, "1")),
+        local_rank=int(os.getenv("DLROVER_TPU_LOCAL_RANK", "0")),
+        local_world_size=int(os.getenv("DLROVER_TPU_LOCAL_WORLD_SIZE", "1")),
+        restart_count=int(os.getenv(NodeEnv.RESTART_COUNT, "0")),
+        rdzv_round=int(os.getenv("DLROVER_TPU_RDZV_ROUND", "0")),
+        coordinator_addr=os.getenv(NodeEnv.COORDINATOR_ADDR, ""),
+        master_addr=os.getenv(NodeEnv.MASTER_ADDR, ""),
+    )
+
+
+_initialized = False
+
+
+def init_elastic(timeout_secs: int = 300) -> ElasticContext:
+    """Configure devices and join the JAX distributed system.
+
+    Safe to call for single-process jobs (no-op init). Fast re-init after a
+    restart is just process re-exec + this call — the agent already
+    re-assigned ``process_id``/``coordinator_addr`` for the new world.
+    """
+    global _initialized
+    ctx = elastic_context()
+    configure_devices()  # honors DLROVER_TPU_DEVICE_SPEC before backend init
+    if ctx.is_distributed and not _initialized:
+        import jax
+
+        logger.info(
+            f"jax.distributed.initialize(coordinator="
+            f"{ctx.coordinator_addr}, n={ctx.num_processes}, "
+            f"id={ctx.process_id})"
+        )
+        jax.distributed.initialize(
+            coordinator_address=ctx.coordinator_addr,
+            num_processes=ctx.num_processes,
+            process_id=ctx.process_id,
+            initialization_timeout=timeout_secs,
+        )
+        _initialized = True
+    return ctx

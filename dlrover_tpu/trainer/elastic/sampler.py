@@ -1,0 +1,103 @@
+"""Checkpointable data sampler that survives world-size changes.
+
+Parity: dlrover/trainer/torch/elastic/sampler.py:25
+(ElasticDistributedSampler: ``state_dict:118`` / ``load_state_dict:130``) —
+the sampler records global progress (``completed_num``) so training resumes
+mid-epoch after a restart even when the number of data-parallel replicas
+changed; no torch dependency, indices feed any indexable dataset or a
+tf.data/grain pipeline equally.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+class ElasticDistributedSampler:
+    def __init__(
+        self,
+        dataset_size: int,
+        num_replicas: int = 1,
+        rank: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if rank >= num_replicas:
+            raise ValueError(
+                f"rank {rank} >= num_replicas {num_replicas}"
+            )
+        self.dataset_size = dataset_size
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        # samples (global, across all replicas) consumed in this epoch
+        self.completed_num = 0
+
+    def _epoch_indices(self) -> np.ndarray:
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            indices = rng.permutation(self.dataset_size)
+        else:
+            indices = np.arange(self.dataset_size)
+        if self.drop_last:
+            total = (
+                self.dataset_size // self.num_replicas
+            ) * self.num_replicas
+            indices = indices[:total]
+        else:
+            total = (
+                -(-self.dataset_size // self.num_replicas)
+            ) * self.num_replicas
+            pad = total - len(indices)
+            if pad:
+                indices = np.concatenate([indices, indices[:pad]])
+        return indices
+
+    def __iter__(self) -> Iterator[int]:
+        indices = self._epoch_indices()
+        # skip what the job already consumed (any previous world size):
+        # completed_num is global, so the remaining samples are simply
+        # re-dealt round-robin to the current replicas
+        remaining = indices[self.completed_num:]
+        for i, idx in enumerate(remaining):
+            if i % self.num_replicas == self.rank:
+                self.completed_num += self.num_replicas
+                yield int(idx)
+        # epoch exhausted: roll over so a plain
+        # ``for epoch in range(n): for batch in loader`` loop works even
+        # without an explicit set_epoch (which still overrides shuffling)
+        self.epoch += 1
+        self.completed_num = 0
+
+    def __len__(self) -> int:
+        indices_left = max(
+            0,
+            len(self._epoch_indices()) - self.completed_num,
+        )
+        return indices_left // self.num_replicas
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+        self.completed_num = 0
+
+    # -- checkpoint ----------------------------------------------------
+    def state_dict(self) -> Dict:
+        return {
+            "epoch": self.epoch,
+            "completed_num": self.completed_num,
+        }
+
+    def load_state_dict(self, state: Dict):
+        self.epoch = state.get("epoch", 0)
+        self.completed_num = state.get("completed_num", 0)
+        # clamp: a smaller dataset or changed padding must not overflow
+        total = len(self._epoch_indices())
+        if self.completed_num >= total:
+            self.completed_num = 0
+            self.epoch += 1

@@ -1,0 +1,96 @@
+"""Node health-check workload: timed matmul + cross-host collective.
+
+Parity: dlrover/trainer/torch/node_check/nvidia_gpu.py:26 and utils.py:59-90
+— the reference times a bf16 matmul plus 10 rounds of a 16M-element
+allgather over NCCL; slow/failed nodes are bisected by the master's paired
+rendezvous. The TPU version exercises the same two failure surfaces:
+
+- **chip compute**: a jitted bf16 matmul big enough to hit the MXU;
+- **ICI/DCN path**: a jitted ``psum`` across every process of the paired
+  group (XLA collective over the real interconnect when multi-host).
+
+Fault injection for tests mirrors ``MOCK_ERR_RANK`` (utils.py:50):
+``DLROVER_TPU_MOCK_ERR_RANK=<process_id>`` makes that rank raise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def write_result(elapsed: float, path: str = ""):
+    path = path or os.getenv("DLROVER_TPU_CHECK_RESULT_FILE", "")
+    if not path:
+        return
+    local_rank = os.getenv("DLROVER_TPU_LOCAL_RANK", "0")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(f"{path}.{local_rank}", "w") as f:
+        json.dump({"elapsed": elapsed}, f)
+
+
+def matmul_rounds(rounds: int = 3, size: int = 1024):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def mm(a):
+        return a @ a
+
+    a = jnp.ones((size, size), dtype=jnp.bfloat16)
+    mm(a).block_until_ready()  # compile outside the timed region
+    t0 = time.monotonic()
+    for _ in range(rounds):
+        a = mm(a)
+    a.block_until_ready()
+    return time.monotonic() - t0
+
+
+def collective_rounds(ctx, rounds: int = 10, elems: int = 1 << 20):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    sharding = NamedSharding(mesh, P("x"))
+    n = jax.device_count()
+    local = np.ones(
+        (elems // n * jax.local_device_count(),), np.float32
+    )
+    x = jax.make_array_from_process_local_data(sharding, local)
+
+    @jax.jit
+    def allreduce(v):
+        return jnp.sum(v) * jnp.ones_like(v)
+
+    allreduce(x).block_until_ready()
+    t0 = time.monotonic()
+    for _ in range(rounds):
+        x = allreduce(x)
+    x.block_until_ready()
+    return time.monotonic() - t0
+
+
+def main() -> int:
+    from dlrover_tpu.trainer.elastic.distributed import init_elastic
+
+    ctx = init_elastic()
+    mock_err = os.getenv("DLROVER_TPU_MOCK_ERR_RANK", "")
+    if mock_err and int(mock_err) == ctx.process_id:
+        raise RuntimeError(f"mock error on rank {ctx.process_id}")
+    t = matmul_rounds()
+    if ctx.is_distributed:
+        t += collective_rounds(ctx)
+    mock_slow = os.getenv("DLROVER_TPU_MOCK_SLOW_RANK", "")
+    if mock_slow and int(mock_slow) == ctx.process_id:
+        time.sleep(2.0)
+        t += 2.0
+    write_result(t)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

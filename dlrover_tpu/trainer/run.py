@@ -1,0 +1,204 @@
+"""``dlrover-tpu-run``: the elastic launcher (torchrun-superset analog).
+
+Parity: dlrover/trainer/torch/elastic_run.py:124-371 — on the first node it
+spawns a local job master when none is provided
+(``_launch_dlrover_local_master:230``), then runs the per-host elastic
+agent which rendezvouses through the master and supervises the training
+processes. Flags mirror the reference's additions: ``--network-check``,
+``--node-unit``, ``--max-restarts``, plus TPU-specific ``--device-spec``.
+
+Usage:
+    dlrover-tpu-run --nnodes=1 --nproc-per-node=2 train.py [args...]
+    dlrover-tpu-run --nnodes=2:4 --network-check train.py   # elastic range
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from typing import Optional, Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training_agent import (
+    ElasticTrainingAgent,
+    WorkerSpec,
+    WorkerState,
+)
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dlrover-tpu-run")
+    p.add_argument(
+        "--nnodes",
+        type=str,
+        default="1",
+        help="node count, fixed ('2') or elastic range ('2:4')",
+    )
+    p.add_argument("--nproc-per-node", type=int, default=1)
+    p.add_argument("--node-rank", type=int, default=0)
+    p.add_argument(
+        "--master-addr",
+        type=str,
+        default="",
+        help="existing master host:port; empty => node 0 spawns one",
+    )
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--monitor-interval", type=float, default=3.0)
+    p.add_argument(
+        "--rdzv-waiting-timeout",
+        type=float,
+        default=5.0,
+        help="lastcall seconds to wait for more nodes past min",
+    )
+    p.add_argument(
+        "--node-unit",
+        type=int,
+        default=1,
+        help="hosts per TPU slice; worlds are multiples of this",
+    )
+    p.add_argument(
+        "--network-check",
+        action="store_true",
+        help="run the paired node health check before training",
+    )
+    p.add_argument(
+        "--device-spec",
+        type=str,
+        default="",
+        help="'cpu:8' for CPU-hosted virtual devices, default: real TPU",
+    )
+    p.add_argument("--log-dir", type=str, default="")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def parse_nnodes(spec: str) -> Tuple[int, int]:
+    if ":" in spec:
+        lo, hi = spec.split(":", 1)
+        return int(lo), int(hi)
+    n = int(spec)
+    return n, n
+
+
+def launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
+    """Parity: _launch_dlrover_local_master elastic_run.py:230."""
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "dlrover_tpu.master.main",
+            "--node_num",
+            str(node_num),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=None,
+        text=True,
+    )
+    deadline = time.time() + 30
+    addr = ""
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("DLROVER_TPU_MASTER_ADDR="):
+            addr = line.strip().split("=", 1)[1]
+            break
+    if not addr:
+        proc.terminate()
+        raise RuntimeError("local master failed to start")
+    return proc, addr
+
+
+def _run_network_check(args, client: MasterClient) -> bool:
+    """Run the node health check before training (parity:
+    NetworkCheckElasticAgent training.py:799 + run_network_check:1014).
+    The check rendezvous was already configured via RendezvousParamsReport."""
+    from dlrover_tpu.agent.node_check_agent import run_network_check
+
+    return run_network_check(
+        node_rank=args.node_rank,
+        nproc_per_node=args.nproc_per_node,
+        client=client,
+        device_spec=args.device_spec,
+    )
+
+
+def run(args) -> int:
+    min_nodes, max_nodes = parse_nnodes(args.nnodes)
+    master_proc: Optional[subprocess.Popen] = None
+    master_addr = args.master_addr or os.getenv(NodeEnv.MASTER_ADDR, "")
+    if not master_addr:
+        if args.node_rank != 0:
+            raise SystemExit(
+                "--master-addr is required on non-zero node ranks"
+            )
+        master_proc, master_addr = launch_local_master(max_nodes)
+        logger.info(f"spawned local master at {master_addr}")
+    os.environ[NodeEnv.MASTER_ADDR] = master_addr
+
+    client = MasterClient(
+        master_addr, node_id=args.node_rank, node_type="worker"
+    )
+    # configure both rendezvous
+    for name in (
+        RendezvousName.ELASTIC_TRAINING,
+        RendezvousName.NETWORK_CHECK,
+    ):
+        client.report(
+            comm.RendezvousParamsReport(
+                rdzv_name=name,
+                min_nodes=min_nodes,
+                max_nodes=max_nodes,
+                waiting_timeout=args.rdzv_waiting_timeout,
+                node_unit=args.node_unit,
+            )
+        )
+
+    try:
+        if args.network_check:
+            ok = _run_network_check(args, client)
+            if not ok:
+                logger.error("this node failed the network check")
+                return 3
+
+        spec = WorkerSpec(
+            entrypoint=args.training_script,
+            args=list(args.training_script_args),
+            nproc_per_node=args.nproc_per_node,
+            max_restarts=args.max_restarts,
+            monitor_interval=args.monitor_interval,
+            log_dir=args.log_dir,
+            device_spec=args.device_spec,
+        )
+        agent = ElasticTrainingAgent(
+            node_rank=args.node_rank, spec=spec, client=client
+        )
+        result = agent.run()
+        logger.info(
+            f"agent finished: {result.state} after "
+            f"{result.restarts} restarts"
+        )
+        return 0 if result.state == WorkerState.SUCCEEDED else 1
+    finally:
+        client.close()
+        if master_proc is not None:
+            master_proc.terminate()
+            try:
+                master_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                master_proc.kill()
+
+
+def main(argv=None) -> int:
+    return run(parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+"""Device/platform configuration helpers.
+
+This container (and CI hosts) may pre-import jax with a TPU plugin pinned by
+sitecustomize, so env vars like ``JAX_PLATFORMS``/``XLA_FLAGS`` set at
+process start are ignored — only ``jax.config.update`` before first backend
+use takes effect. These helpers centralize that.
+"""
+
+from __future__ import annotations
+
+import os
+
+DEVICE_SPEC_ENV = "DLROVER_TPU_DEVICE_SPEC"
+
+
+def configure_devices(spec: str = ""):
+    """Apply a device spec like ``"cpu:8"`` (virtual 8-device CPU mesh,
+    multi-process capable) or ``"tpu"`` (default backend). Must run before
+    jax creates a backend. No-op for empty spec."""
+    spec = spec or os.getenv(DEVICE_SPEC_ENV, "")
+    if not spec:
+        return
+    import jax
+
+    if spec.startswith("cpu"):
+        n = int(spec.split(":", 1)[1]) if ":" in spec else 1
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    elif spec.startswith("tpu"):
+        # default backend; nothing to force
+        pass
+    else:
+        raise ValueError(f"unknown device spec: {spec}")
+
+
+def local_device_count() -> int:
+    import jax
+
+    return jax.local_device_count()

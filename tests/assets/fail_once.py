@@ -1,0 +1,7 @@
+"""Fails on the first launch, succeeds after one agent restart."""
+
+import os
+import sys
+
+restart = int(os.getenv("DLROVER_TPU_RESTART_COUNT", "0"))
+sys.exit(1 if restart == 0 else 0)

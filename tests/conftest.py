@@ -1,13 +1,17 @@
 """Test env: run JAX on a virtual 8-device CPU mesh so multi-chip sharding
-logic is exercised without TPU hardware (SURVEY.md §4 lesson)."""
+logic is exercised without TPU hardware (SURVEY.md §4 lesson).
+
+NOTE: this container's sitecustomize imports jax at interpreter start and
+pins JAX_PLATFORMS=axon, so env vars are too late — only
+``jax.config.update`` works (see dlrover_tpu/utils/device.py).
+"""
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 os.environ.setdefault("DLROVER_TPU_SOCKET_DIR", "/tmp/dlrover_tpu_test/sockets")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")

@@ -38,6 +38,7 @@ from dlrover_tpu.common.constants import (
     TrainingExceptionLevel,
 )
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.utils.env import ensure_framework_on_pythonpath
 
 
 class WorkerState(str, Enum):
@@ -168,6 +169,7 @@ class ElasticTrainingAgent:
         )
         if self._spec.device_spec:
             env["DLROVER_TPU_DEVICE_SPEC"] = self._spec.device_spec
+        ensure_framework_on_pythonpath(env)
         return env
 
     # ------------------------------------------------------------------
@@ -247,6 +249,15 @@ class ElasticTrainingAgent:
     # ------------------------------------------------------------------
     def run(self) -> RunResult:
         """Parity: _invoke_run training.py:548."""
+        try:
+            return self._run_loop()
+        except BaseException:
+            # never leave training processes orphaned (they would keep the
+            # TPU chip locked and hang in collectives)
+            self._stop_workers()
+            raise
+
+    def _run_loop(self) -> RunResult:
         spec = self._spec
         world = self._rendezvous()
         self._start_workers(world)

@@ -39,24 +39,25 @@ class ElasticDistributedSampler:
         # samples (global, across all replicas) consumed in this epoch
         self.completed_num = 0
 
+    def _epoch_total(self) -> int:
+        """Samples per epoch after drop/pad, without materializing indices."""
+        if self.drop_last:
+            return (
+                self.dataset_size // self.num_replicas
+            ) * self.num_replicas
+        return -(-self.dataset_size // self.num_replicas) * self.num_replicas
+
     def _epoch_indices(self) -> np.ndarray:
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)
             indices = rng.permutation(self.dataset_size)
         else:
             indices = np.arange(self.dataset_size)
-        if self.drop_last:
-            total = (
-                self.dataset_size // self.num_replicas
-            ) * self.num_replicas
+        total = self._epoch_total()
+        if total <= len(indices):
             indices = indices[:total]
         else:
-            total = (
-                -(-self.dataset_size // self.num_replicas)
-            ) * self.num_replicas
-            pad = total - len(indices)
-            if pad:
-                indices = np.concatenate([indices, indices[:pad]])
+            indices = np.concatenate([indices, indices[: total - len(indices)]])
         return indices
 
     def __iter__(self) -> Iterator[int]:
@@ -76,10 +77,7 @@ class ElasticDistributedSampler:
         self.completed_num = 0
 
     def __len__(self) -> int:
-        indices_left = max(
-            0,
-            len(self._epoch_indices()) - self.completed_num,
-        )
+        indices_left = max(0, self._epoch_total() - self.completed_num)
         return indices_left // self.num_replicas
 
     def set_epoch(self, epoch: int):
@@ -97,7 +95,7 @@ class ElasticDistributedSampler:
         self.epoch = state.get("epoch", 0)
         self.completed_num = state.get("completed_num", 0)
         # clamp: a smaller dataset or changed padding must not overflow
-        total = len(self._epoch_indices())
+        total = self._epoch_total()
         if self.completed_num >= total:
             self.completed_num = 0
             self.epoch += 1

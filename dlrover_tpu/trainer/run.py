@@ -18,6 +18,7 @@ import argparse
 import os
 import subprocess
 import sys
+import threading
 import time
 from typing import Optional, Tuple
 
@@ -28,6 +29,7 @@ from dlrover_tpu.agent.training_agent import (
     WorkerState,
 )
 from dlrover_tpu.common import comm
+from dlrover_tpu.utils.env import child_env
 from dlrover_tpu.common.constants import NodeEnv, RendezvousName
 from dlrover_tpu.common.log import default_logger as logger
 
@@ -100,16 +102,26 @@ def launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
         stdout=subprocess.PIPE,
         stderr=None,
         text=True,
+        env=child_env(),
     )
-    deadline = time.time() + 30
-    addr = ""
-    while time.time() < deadline:
-        line = proc.stdout.readline()
-        if not line:
-            break
-        if line.startswith("DLROVER_TPU_MASTER_ADDR="):
-            addr = line.strip().split("=", 1)[1]
-            break
+    # Read the address line on a thread so a wedged master (alive but never
+    # printing its address) cannot block the launcher past the deadline; the
+    # thread keeps draining stdout afterwards so the pipe never fills up.
+    box: dict = {}
+    got = threading.Event()
+
+    def _reader():
+        for line in proc.stdout:
+            if not got.is_set() and line.startswith(
+                "DLROVER_TPU_MASTER_ADDR="
+            ):
+                box["addr"] = line.strip().split("=", 1)[1]
+                got.set()
+        got.set()
+
+    threading.Thread(target=_reader, daemon=True).start()
+    got.wait(timeout=30)
+    addr = box.get("addr", "")
     if not addr:
         proc.terminate()
         raise RuntimeError("local master failed to start")

@@ -32,9 +32,3 @@ def configure_devices(spec: str = ""):
         pass
     else:
         raise ValueError(f"unknown device spec: {spec}")
-
-
-def local_device_count() -> int:
-    import jax
-
-    return jax.local_device_count()

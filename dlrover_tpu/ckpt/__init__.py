@@ -1,0 +1,23 @@
+"""Flash Checkpoint: async in-memory checkpointing for JAX on TPU.
+
+Parity map (reference → here):
+- dlrover/python/elastic_agent/torch/ckpt_saver.py → ``saver.py`` (agent side)
+- dlrover/trainer/torch/flash_checkpoint/engine.py → ``engine.py`` (train proc)
+- dlrover/trainer/torch/flash_checkpoint/checkpointer.py + ddp.py →
+  ``checkpointer.py`` (user facade)
+- shm layout / SharedMemoryHandler (ckpt_saver.py:208) → ``shm_handler.py``
+
+TPU-native differences: the state is a JAX pytree whose leaves may be
+sharded ``jax.Array``s laid out by GSPMD over a device mesh; each host
+process saves exactly its *addressable* shards (replica_id==0) together
+with their global index, so a checkpoint written under one mesh can be
+restored under another (world-size elasticity).
+"""
+
+from dlrover_tpu.ckpt.checkpointer import (  # noqa: F401
+    Checkpointer,
+    FlashCheckpointer,
+    StorageType,
+)
+from dlrover_tpu.ckpt.engine import CheckpointEngine  # noqa: F401
+from dlrover_tpu.ckpt.saver import AsyncCheckpointSaver  # noqa: F401

@@ -1,0 +1,71 @@
+"""User-facing Flash Checkpoint API.
+
+Parity: ``Checkpointer`` checkpointer.py:23 and the per-framework facades
+(``DdpCheckpointer`` ddp.py:25 etc.). In JAX there is one model of state —
+a pytree (params/opt_state/step/sampler state) — so a single
+``FlashCheckpointer`` covers what the reference needed DDP/FSDP/Megatron/
+DeepSpeed variants for; sharded-leaf handling is automatic.
+
+Usage::
+
+    ckptr = FlashCheckpointer("/ckpt/run1")
+    ckptr.save_checkpoint(step, state)                    # async, ~ms
+    ckptr.save_checkpoint(step, state, StorageType.DISK)  # ensure persisted
+    step, state = ckptr.load_checkpoint(target=state)
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any, Optional, Tuple
+
+from dlrover_tpu.common.storage import CheckpointStorage
+from dlrover_tpu.ckpt.engine import CheckpointEngine
+
+
+class StorageType(Enum):
+    MEMORY = 0
+    DISK = 1
+
+
+class Checkpointer:
+    """Abstract facade (kept for API parity; FlashCheckpointer is the
+    concrete one)."""
+
+    def save_checkpoint(
+        self,
+        step: int,
+        state: Any,
+        storage_type: StorageType = StorageType.MEMORY,
+    ) -> bool:
+        raise NotImplementedError
+
+    def load_checkpoint(self, target: Any) -> Tuple[int, Optional[Any]]:
+        raise NotImplementedError
+
+
+class FlashCheckpointer(Checkpointer):
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        storage: Optional[CheckpointStorage] = None,
+    ):
+        self.checkpoint_dir = checkpoint_dir
+        self.engine = CheckpointEngine(storage=storage)
+
+    def save_checkpoint(
+        self,
+        step: int,
+        state: Any,
+        storage_type: StorageType = StorageType.MEMORY,
+    ) -> bool:
+        if storage_type == StorageType.DISK:
+            return self.engine.save_to_storage(
+                step, state, self.checkpoint_dir
+            )
+        return self.engine.save_to_memory(step, state, self.checkpoint_dir)
+
+    def load_checkpoint(self, target: Any) -> Tuple[int, Optional[Any]]:
+        """Returns ``(step, state)``; ``(-1, None)`` when no checkpoint
+        exists yet."""
+        return self.engine.load(target, self.checkpoint_dir)

@@ -1,0 +1,215 @@
+"""Training-process side of Flash Checkpoint.
+
+Parity: ``CheckpointEngine`` engine.py:131 —
+``save_state_dict_to_memory`` (engine.py:284) stages the state into shm
+under a non-blocking shard lock (if the agent is still persisting the
+previous step, this save is *skipped*, never blocked on), then notifies
+the agent saver through the event queue. ``get_state_dict_from_memory``
+(engine.py:315) restores straight from shm after a restart.
+
+TPU-native: the "state dict" is any JAX pytree; sharded ``jax.Array``
+leaves are staged as per-host shard records with global indices
+(``sharding.host_shard_records``), with async D2H overlapping the copies.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import (
+    SharedLock,
+    SharedQueue,
+    server_exists,
+)
+from dlrover_tpu.common.storage import CheckpointStorage, PosixDiskStorage
+from dlrover_tpu.ckpt import saver as saver_mod
+from dlrover_tpu.ckpt.saver import SaveEvent
+from dlrover_tpu.ckpt.sharding import (
+    ShardRecord,
+    host_shard_index_set,
+    host_shard_records,
+    restore_state,
+)
+from dlrover_tpu.ckpt.shm_handler import ShmHandler
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.getenv(name, default))
+    except ValueError:
+        return default
+
+
+class CheckpointEngine:
+    """One per training process. Talks to the per-host agent saver when one
+    is serving the IPC endpoints; otherwise falls back to synchronous
+    storage writes (plain ``python train.py`` without the launcher)."""
+
+    def __init__(self, storage: Optional[CheckpointStorage] = None):
+        self.local_rank = _env_int("DLROVER_TPU_LOCAL_RANK", 0)
+        self.global_shard_id = _env_int("DLROVER_TPU_PROCESS_ID", 0)
+        self.global_shard_num = _env_int("DLROVER_TPU_NUM_PROCESSES", 1)
+        self.storage = storage or PosixDiskStorage()
+        self._agent_mode = server_exists(saver_mod.CKPT_EVENT_QUEUE)
+        self._shm: Optional[ShmHandler] = None
+        self._queue: Optional[SharedQueue] = None
+        self._lock: Optional[SharedLock] = None
+        if self._agent_mode:
+            self._shm = ShmHandler(self.local_rank, create=False)
+            self._queue = SharedQueue(saver_mod.CKPT_EVENT_QUEUE)
+            self._lock = SharedLock(
+                saver_mod.shard_lock_name(self.local_rank)
+            )
+
+    # ------------------------------------------------------------------
+    # save
+    # ------------------------------------------------------------------
+    def save_to_memory(
+        self, step: int, state: Any, checkpoint_dir: str, sync: bool = False
+    ) -> bool:
+        """Stage ``state`` into shm and notify the agent. Returns False when
+        skipped because the saver still holds the shard lock."""
+        if not self._agent_mode:
+            return self._save_sync(step, state, checkpoint_dir)
+        assert self._lock and self._shm and self._queue
+        # Lock-handoff protocol (parity: engine.py:284 + ckpt_saver.py:534):
+        # we take the shard lock here and the *saver* force-releases it after
+        # persisting, so shm can never be overwritten before it is safe on
+        # storage — a save issued while the saver is busy is skipped, never
+        # blocked on.
+        if not self._lock.acquire(blocking=False):
+            logger.warning(
+                f"step {step}: saver busy persisting a previous checkpoint; "
+                f"skipping this save"
+            )
+            return False
+        try:
+            t0 = time.time()
+            records = host_shard_records(state)
+            extra = {
+                "checkpoint_dir": checkpoint_dir,
+                "global_shard_id": self.global_shard_id,
+                "global_shard_num": self.global_shard_num,
+            }
+            self._shm.save_records(step, records, extra)
+            logger.info(
+                f"step {step}: staged {len(records)} shard records to shm "
+                f"in {time.time() - t0:.3f}s"
+            )
+        except BaseException:
+            self._lock.release()
+            raise
+        self._queue.put(
+            SaveEvent(
+                step=step,
+                checkpoint_dir=checkpoint_dir,
+                local_rank=self.local_rank,
+                global_shard_id=self.global_shard_id,
+                global_shard_num=self.global_shard_num,
+                sync=sync,
+            )
+        )
+        return True
+
+    def save_to_storage(
+        self, step: int, state: Any, checkpoint_dir: str
+    ) -> bool:
+        """Stage to shm and ask the agent to persist this step to storage
+        (the reference's ``StorageType.DISK`` path)."""
+        return self.save_to_memory(step, state, checkpoint_dir, sync=True)
+
+    def _save_sync(self, step: int, state: Any, checkpoint_dir: str) -> bool:
+        """No agent: write this process's shard directly to storage through
+        the same payload/done/commit helpers the saver uses, so files stay
+        interchangeable."""
+        records = host_shard_records(state)
+        self.storage.safe_makedirs(
+            os.path.join(
+                saver_mod.step_dir(checkpoint_dir, step), saver_mod.DONE_DIR
+            )
+        )
+        payload = saver_mod.build_shard_payload(
+            step, self.global_shard_id, self.global_shard_num, records, {}
+        )
+        saver_mod.write_shard_and_done(
+            self.storage, checkpoint_dir, step, payload
+        )
+        if self.global_shard_id == 0:
+            return saver_mod.commit_checkpoint(
+                self.storage, checkpoint_dir, step, self.global_shard_num
+            )
+        return True
+
+    # ------------------------------------------------------------------
+    # load
+    # ------------------------------------------------------------------
+    def latest_step(self, checkpoint_dir: str) -> int:
+        raw = self.storage.read(
+            os.path.join(checkpoint_dir, saver_mod.TRACKER_FILE)
+        )
+        if not raw:
+            return -1
+        try:
+            return int(raw.decode() if isinstance(raw, bytes) else raw)
+        except ValueError:
+            return -1
+
+    def load(
+        self, target: Any, checkpoint_dir: str
+    ) -> Tuple[int, Optional[Any]]:
+        """Restore ``target``-shaped state. Prefers shm when it holds a step
+        at least as new as the committed one (fast elastic-restart path,
+        engine.py:315), else reads the committed step from storage."""
+        committed = self.latest_step(checkpoint_dir)
+        if self._agent_mode and self._shm is not None:
+            try:
+                shm_step, records, _ = self._shm.load_records()
+                if shm_step >= committed and self._shm_covers(
+                    records, target
+                ):
+                    by_path: Dict[str, list] = {}
+                    for r in records:
+                        by_path.setdefault(r.path, []).append(r)
+                    state = restore_state(
+                        target, lambda p: by_path.get(p, [])
+                    )
+                    logger.info(f"restored step {shm_step} from memory")
+                    return shm_step, state
+            except (LookupError, ValueError):
+                pass
+        if committed < 0:
+            return -1, None
+        return committed, self._load_from_storage(
+            target, checkpoint_dir, committed
+        )
+
+    def _shm_covers(self, records, target) -> bool:
+        """shm restore is only safe when this process's target shards match
+        what this process staged (same world split)."""
+        have = {(r.path, r.index) for r in records}
+        return host_shard_index_set(target) <= have
+
+    def _load_from_storage(
+        self, target: Any, checkpoint_dir: str, step: int
+    ) -> Any:
+        sdir = saver_mod.step_dir(checkpoint_dir, step)
+        by_path: Dict[str, list] = {}
+        for fname in self.storage.listdir(sdir):
+            if not fname.endswith(".ckpt"):
+                continue
+            payload = self.storage.read_state_dict(
+                os.path.join(sdir, fname)
+            )
+            for m in payload["records"]:
+                rec = ShardRecord(
+                    path=m["path"],
+                    global_shape=tuple(m["global_shape"]),
+                    dtype=m["dtype"],
+                    index=tuple(tuple(i) for i in m["index"]),
+                    data=m["data"],
+                )
+                by_path.setdefault(rec.path, []).append(rec)
+        return restore_state(target, lambda p: by_path.get(p, []))

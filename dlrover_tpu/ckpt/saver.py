@@ -1,0 +1,401 @@
+"""Agent-side async checkpoint saver.
+
+Parity: ``AsyncCheckpointSaver`` ckpt_saver.py:341-1146 —
+
+- ``start_async_saving_ckpt`` (ckpt_saver.py:405): the agent starts a
+  daemon thread *before spawning workers* that owns the IPC endpoints
+  (event queue + per-shard meta dict/lock) and instantiates the saver on
+  the first registration message from a training process.
+- event loop (``_sync_shm_to_storage`` ckpt_saver.py:505): drains per-shard
+  SAVE events; when every local shard reported a step (or the straggler
+  timeout fires) it persists shm → storage with one thread per shard
+  (``save_step_checkpoint``/``_save_shard`` ckpt_saver.py:750,534).
+- commit protocol (``commit_checkpoint`` ckpt_saver.py:813): every shard
+  writes a done file; node-0 waits for ``global_shard_num`` done files on
+  the shared filesystem, then atomically publishes the tracker file
+  ``latest_step`` — a checkpoint exists only once the tracker names it.
+- ``save_shm_to_storage`` (ckpt_saver.py:623): called on SIGTERM and
+  before an elastic restart ("save at breakpoint", training.py:614-623) to
+  persist whatever newer state is still in memory.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import SharedLock, SharedQueue
+from dlrover_tpu.common.storage import (
+    CheckpointStorage,
+    PosixDiskStorage,
+)
+from dlrover_tpu.ckpt.shm_handler import ShmHandler
+
+CKPT_EVENT_QUEUE = "ckpt_event_queue"
+TRACKER_FILE = "latest_step"
+DONE_DIR = "._done"
+
+
+def shard_lock_name(local_rank: int) -> str:
+    return f"ckpt_lock_{local_rank}"
+
+
+def step_dir(checkpoint_dir: str, step: int) -> str:
+    return os.path.join(checkpoint_dir, f"step_{step}")
+
+
+def shard_file(checkpoint_dir: str, step: int, global_shard_id: int) -> str:
+    return os.path.join(
+        step_dir(checkpoint_dir, step), f"shard_{global_shard_id}.ckpt"
+    )
+
+
+def build_shard_payload(
+    step: int, global_shard_id: int, global_shard_num: int, records, extra
+) -> Dict:
+    """Single source of truth for the on-disk shard format — the agent path
+    and the launcher-less sync path must stay byte-compatible."""
+    return {
+        "step": step,
+        "global_shard_id": global_shard_id,
+        "global_shard_num": global_shard_num,
+        "records": [
+            {
+                "path": r.path,
+                "global_shape": r.global_shape,
+                "dtype": r.dtype,
+                "index": r.index,
+                "data": r.data,
+            }
+            for r in records
+        ],
+        "extra": extra,
+    }
+
+
+def write_shard_and_done(
+    storage, checkpoint_dir: str, step: int, payload: Dict
+):
+    gid = payload["global_shard_id"]
+    storage.write_state_dict(
+        payload, shard_file(checkpoint_dir, step, gid)
+    )
+    done = os.path.join(
+        step_dir(checkpoint_dir, step), DONE_DIR, f"{gid}.done"
+    )
+    storage.write(str(payload["global_shard_num"]), done)
+
+
+def commit_checkpoint(
+    storage,
+    checkpoint_dir: str,
+    step: int,
+    global_shard_num: int,
+    timeout: float = 600.0,
+    stop_event: Optional[threading.Event] = None,
+) -> bool:
+    """Wait for all global done files, then atomically publish the tracker.
+    Parity: commit_checkpoint ckpt_saver.py:813."""
+    done_dir = os.path.join(step_dir(checkpoint_dir, step), DONE_DIR)
+    deadline = time.time() + timeout
+    done: List[str] = []
+    while time.time() < deadline:
+        try:
+            done = [
+                f for f in storage.listdir(done_dir) if f.endswith(".done")
+            ]
+        except FileNotFoundError:
+            done = []
+        if len(done) >= global_shard_num:
+            storage.write(
+                str(step), os.path.join(checkpoint_dir, TRACKER_FILE)
+            )
+            storage.commit(step, True)
+            logger.info(f"checkpoint step {step} committed")
+            return True
+        if stop_event is not None and stop_event.is_set():
+            return False
+        time.sleep(0.2)
+    logger.error(
+        f"commit of step {step} timed out: "
+        f"{len(done)}/{global_shard_num} shards done"
+    )
+    storage.commit(step, False)
+    return False
+
+
+@dataclass
+class SaveEvent:
+    """One training process finished staging one shard into shm."""
+
+    step: int
+    checkpoint_dir: str
+    local_rank: int
+    global_shard_id: int
+    global_shard_num: int
+    sync: bool = False  # True => also wait for storage persist (storage API)
+
+
+@dataclass
+class _StepState:
+    checkpoint_dir: str = ""
+    global_shard_num: int = 1
+    ranks: Set[int] = field(default_factory=set)
+    first_seen: float = 0.0
+
+
+class AsyncCheckpointSaver:
+    """Singleton per agent process; owns shm/IPC servers for all local
+    shards and persists them to storage off the training's critical path."""
+
+    _singleton: Optional["AsyncCheckpointSaver"] = None
+    _lock = threading.Lock()
+
+    def __init__(
+        self,
+        local_shard_num: int,
+        node_rank: int = 0,
+        storage: Optional[CheckpointStorage] = None,
+        straggler_timeout: float = 120.0,
+    ):
+        self.local_shard_num = local_shard_num
+        self.node_rank = node_rank
+        self.storage = storage or PosixDiskStorage()
+        self.straggler_timeout = straggler_timeout
+        self._event_queue = SharedQueue(CKPT_EVENT_QUEUE, create=True)
+        self._shm_handlers = [
+            ShmHandler(r, create=True) for r in range(local_shard_num)
+        ]
+        self._shard_locks = [
+            SharedLock(shard_lock_name(r), create=True)
+            for r in range(local_shard_num)
+        ]
+        self._steps: Dict[int, _StepState] = {}
+        self._persisted_step = -1
+        self._stop = threading.Event()
+        self._loop_thread: Optional[threading.Thread] = None
+        # event loop and save-at-breakpoint/SIGTERM can race; persists are
+        # idempotent but serializing them keeps the logs and locks sane
+        self._persist_mutex = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    @classmethod
+    def start_async_saving_ckpt(
+        cls,
+        local_shard_num: int,
+        node_rank: int = 0,
+        storage: Optional[CheckpointStorage] = None,
+    ) -> "AsyncCheckpointSaver":
+        with cls._lock:
+            if cls._singleton is None:
+                saver = cls(
+                    local_shard_num, node_rank=node_rank, storage=storage
+                )
+                saver._loop_thread = threading.Thread(
+                    target=saver._event_loop,
+                    name="checkpoint-saver",
+                    daemon=True,
+                )
+                saver._loop_thread.start()
+                saver.register_signal_handlers()
+                cls._singleton = saver
+            return cls._singleton
+
+    @classmethod
+    def get_saver(cls) -> Optional["AsyncCheckpointSaver"]:
+        return cls._singleton
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            if cls._singleton is not None:
+                cls._singleton.close()
+                cls._singleton = None
+
+    def close(self):
+        self._stop.set()
+        for h in self._shm_handlers:
+            h.close(unlink=True)
+        for lk in self._shard_locks:
+            lk.close()
+        self._event_queue.close()
+
+    def register_signal_handlers(self):
+        """SIGTERM (preemption) → persist shm, then previous handler.
+        Parity: register_signal_handler ckpt_saver.py:467."""
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        prev_term = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            logger.info("saver got SIGTERM: persisting in-memory checkpoint")
+            try:
+                self.save_shm_to_storage()
+            except Exception as e:
+                logger.error(f"SIGTERM persist failed: {e!r}")
+            if callable(prev_term):
+                prev_term(signum, frame)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _on_term)
+
+    # ------------------------------------------------------------------
+    # event loop
+    # ------------------------------------------------------------------
+    def _event_loop(self):
+        while not self._stop.is_set():
+            try:
+                ev = self._event_queue.get(timeout=2.0)
+            except TimeoutError:
+                ev = None
+            except Exception:
+                if self._stop.is_set():
+                    return
+                ev = None
+            now = time.time()
+            if isinstance(ev, SaveEvent):
+                if ev.step <= self._persisted_step:
+                    continue  # stale event (e.g. replayed across a restart)
+                st = self._steps.setdefault(ev.step, _StepState())
+                st.checkpoint_dir = ev.checkpoint_dir
+                st.global_shard_num = ev.global_shard_num
+                st.first_seen = st.first_seen or now
+                st.ranks.add(ev.local_rank)
+            # persist any step that is complete (or timed out waiting)
+            for step in sorted(list(self._steps)):
+                st = self._steps[step]
+                complete = len(st.ranks) >= self.local_shard_num
+                expired = now - st.first_seen > self.straggler_timeout
+                if complete or expired:
+                    if expired and not complete:
+                        logger.warning(
+                            f"step {step}: only shards {sorted(st.ranks)} "
+                            f"reported; persisting partial node shards"
+                        )
+                    del self._steps[step]
+                    self._persist_step(step, st)
+
+    # ------------------------------------------------------------------
+    # persistence
+    # ------------------------------------------------------------------
+    def _persist_step(self, step: int, st: _StepState):
+        t0 = time.time()
+        try:
+            with self._persist_mutex:
+                ckpt_dir = st.checkpoint_dir
+                self.storage.safe_makedirs(step_dir(ckpt_dir, step))
+                self.storage.safe_makedirs(
+                    os.path.join(step_dir(ckpt_dir, step), DONE_DIR)
+                )
+                with ThreadPoolExecutor(
+                    max_workers=max(1, self.local_shard_num),
+                    thread_name_prefix="ckpt-shard",
+                ) as pool:
+                    futures = [
+                        pool.submit(self._save_shard, step, r, st)
+                        for r in sorted(st.ranks)
+                    ]
+                    ok = all(f.result() for f in futures)
+                if ok:
+                    self._persisted_step = max(self._persisted_step, step)
+                logger.info(
+                    f"persisted step {step} ({len(st.ranks)} local shards) "
+                    f"in {time.time() - t0:.2f}s"
+                )
+            # shard locks are free again: the trainer can stage the next
+            # step while node-0 waits for the other nodes' done files
+            if self.node_rank == 0:
+                self._commit_checkpoint(step, st)
+        except Exception as e:
+            # one bad step (disk full, transient FS error) must not kill the
+            # saver thread or leave the handoff locks held — that would
+            # silently end checkpointing for the rest of the job
+            logger.error(f"persist of step {step} failed: {e!r}")
+            for r in st.ranks:
+                try:
+                    self._shard_locks[r].force_release()
+                except Exception:
+                    pass
+
+    def _save_shard(self, step: int, local_rank: int, st: _StepState) -> bool:
+        """shm → one shard file + its done file. The trainer staged under
+        the shard lock and left it held; we persist and then force-release
+        it, completing the handoff (a trainer save meanwhile is skipped)."""
+        lock = self._shard_locks[local_rank]
+        try:
+            handler = self._shm_handlers[local_rank]
+            try:
+                shm_step, records, extra = handler.load_records()
+            except LookupError:
+                logger.warning(f"shard {local_rank}: no shm checkpoint")
+                return False
+            if shm_step != step:
+                logger.warning(
+                    f"shard {local_rank}: shm holds step {shm_step}, "
+                    f"wanted {step}; skipping"
+                )
+                return False
+            gid = extra.get("global_shard_id", local_rank)
+            payload = build_shard_payload(
+                step, gid, st.global_shard_num, records, extra
+            )
+            write_shard_and_done(
+                self.storage, st.checkpoint_dir, step, payload
+            )
+            return True
+        except Exception as e:
+            logger.error(f"shard {local_rank} persist failed: {e!r}")
+            return False
+        finally:
+            lock.force_release()
+
+    def _commit_checkpoint(self, step: int, st: _StepState):
+        commit_checkpoint(
+            self.storage,
+            st.checkpoint_dir,
+            step,
+            st.global_shard_num,
+            stop_event=self._stop,
+        )
+
+    # ------------------------------------------------------------------
+    # breakpoint / SIGTERM persistence
+    # ------------------------------------------------------------------
+    def save_shm_to_storage(self):
+        """Persist in-memory checkpoints newer than the last persisted step
+        (the workers may be dead already — shm outlives them)."""
+        steps: Dict[int, _StepState] = {}
+        for r, handler in enumerate(self._shm_handlers):
+            if handler.no_checkpoint():
+                continue
+            meta = handler.metadata()
+            step = int(meta.get("step", -1))
+            extra = meta.get("extra", {})
+            if step <= self._persisted_step or not extra.get(
+                "checkpoint_dir"
+            ):
+                continue
+            st = steps.setdefault(step, _StepState())
+            st.checkpoint_dir = extra["checkpoint_dir"]
+            st.global_shard_num = int(extra.get("global_shard_num", 1))
+            st.ranks.add(r)
+        for step, st in sorted(steps.items()):
+            logger.info(f"save-at-breakpoint: persisting shm step {step}")
+            self._persist_step(step, st)
+
+    @classmethod
+    def save_shm_to_storage_if_any(cls):
+        saver = cls.get_saver()
+        if saver is not None:
+            saver.save_shm_to_storage()

@@ -1,0 +1,218 @@
+"""JAX pytree ↔ host-memory shard records.
+
+The torch reference flattens a ``state_dict`` of CPU tensors
+(ckpt_saver.py:270). The TPU equivalent must handle leaves that are
+GSPMD-sharded ``jax.Array``s: every host process owns a subset of shards
+(``arr.addressable_shards``), each covering a global index. We record
+``(path, global_shape, dtype, index, data)`` per shard so that
+
+- saving is per-host and embarrassingly parallel (no gather), and
+- loading can reassemble any slice of the global array from whichever
+  shard files contain it, even if the mesh/world size changed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# index of a shard in the global array: ((start, stop) per dim); () = scalar
+Index = Tuple[Tuple[int, int], ...]
+
+
+@dataclass
+class ShardRecord:
+    """One contiguous block of one leaf, owned by this host."""
+
+    path: str  # "/"-joined pytree key path
+    global_shape: Tuple[int, ...]
+    dtype: str
+    index: Index
+    data: Optional[np.ndarray] = None  # None once serialized to shm
+
+    @property
+    def nbytes(self) -> int:
+        n = np.dtype(self.dtype).itemsize
+        for lo, hi in self.index:
+            n *= hi - lo
+        return n
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(hi - lo for lo, hi in self.index)
+
+
+def _slices_to_index(slices: Sequence[slice], shape: Sequence[int]) -> Index:
+    out = []
+    for s, dim in zip(slices, shape):
+        lo = 0 if s.start is None else s.start
+        hi = dim if s.stop is None else s.stop
+        out.append((int(lo), int(hi)))
+    return tuple(out)
+
+
+def _keystr(kp) -> str:
+    import jax
+
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+        for k in kp
+    ) or "."
+
+
+def host_shard_records(state: Any) -> List[ShardRecord]:
+    """Flatten a pytree into this host's shard records (device→host copy).
+
+    ``jax.Array`` leaves contribute their addressable shards with
+    ``replica_id == 0`` (so replicated arrays are saved exactly once per
+    replica set); numpy/python leaves are saved whole by every process that
+    holds them — load dedupes by path+index, and on a single host there is
+    no duplication at all. Device→host copies are started async for all
+    shards before any is consumed.
+    """
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    records: List[ShardRecord] = []
+    pending: List[Tuple[ShardRecord, Any]] = []
+    for kp, leaf in leaves:
+        path = _keystr(kp)
+        if isinstance(leaf, jax.Array):
+            gshape = tuple(leaf.shape)
+            dt = str(leaf.dtype)
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue
+                rec = ShardRecord(
+                    path=path,
+                    global_shape=gshape,
+                    dtype=dt,
+                    index=_slices_to_index(shard.index, gshape),
+                )
+                try:  # overlap D2H of all shards
+                    shard.data.copy_to_host_async()
+                except Exception:
+                    pass
+                pending.append((rec, shard.data))
+        else:
+            arr = np.asarray(leaf)
+            records.append(
+                ShardRecord(
+                    path=path,
+                    global_shape=tuple(arr.shape),
+                    dtype=str(arr.dtype),
+                    index=tuple((0, d) for d in arr.shape),
+                    data=arr,
+                )
+            )
+    for rec, dev in pending:
+        rec.data = np.asarray(dev)
+        records.append(rec)
+    return records
+
+
+def host_shard_index_set(state: Any) -> set:
+    """The ``(path, index)`` pairs ``host_shard_records`` would produce,
+    without performing any device→host copies."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    out = set()
+    for kp, leaf in leaves:
+        path = _keystr(kp)
+        if isinstance(leaf, jax.Array):
+            gshape = tuple(leaf.shape)
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue
+                out.add((path, _slices_to_index(shard.index, gshape)))
+        else:
+            arr = np.asarray(leaf)
+            out.add((path, tuple((0, d) for d in arr.shape)))
+    return out
+
+
+def assemble_leaf(
+    global_shape: Tuple[int, ...],
+    dtype: str,
+    want: Index,
+    records: List[ShardRecord],
+) -> np.ndarray:
+    """Build the ``want`` slice of a leaf from overlapping shard records."""
+    shape = tuple(hi - lo for lo, hi in want)
+    # fast path: a single record covers the request exactly
+    for r in records:
+        if r.index == want and r.data is not None:
+            return r.data
+    out = np.empty(shape, dtype=np.dtype(dtype))
+    filled = 0
+    for r in records:
+        if r.data is None:
+            continue
+        # overlap of r.index with want, in both coordinate systems
+        src_sel, dst_sel, ok = [], [], True
+        for (wlo, whi), (rlo, rhi) in zip(want, r.index):
+            lo, hi = max(wlo, rlo), min(whi, rhi)
+            if lo >= hi:
+                ok = False
+                break
+            src_sel.append(slice(lo - rlo, hi - rlo))
+            dst_sel.append(slice(lo - wlo, hi - wlo))
+        if not ok:
+            continue
+        block = r.data[tuple(src_sel)] if src_sel else r.data
+        if dst_sel:
+            out[tuple(dst_sel)] = block
+        else:
+            out[...] = block
+        filled += block.size
+    if filled < int(np.prod(shape)):
+        raise ValueError(
+            f"checkpoint shards do not cover requested index {want} of "
+            f"shape {global_shape}"
+        )
+    return out
+
+
+def restore_state(
+    target: Any,
+    read_records: Callable[[str], List[ShardRecord]],
+) -> Any:
+    """Rebuild a pytree shaped/sharded like ``target`` from shard records.
+
+    ``read_records(path)`` returns every available record for a leaf.
+    ``jax.Array`` targets are rebuilt shard-by-shard on their existing
+    sharding via ``jax.make_array_from_single_device_arrays`` — each host
+    reads only the slices it needs, which is what makes restore-from-memory
+    fast after an elastic restart.
+    """
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(target)
+    out = []
+    for kp, leaf in leaves:
+        path = _keystr(kp)
+        recs = read_records(path)
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+            gshape = tuple(leaf.shape)
+            dt = str(leaf.dtype)
+            singles = []
+            for shard in leaf.addressable_shards:
+                want = _slices_to_index(shard.index, gshape)
+                block = assemble_leaf(gshape, dt, want, recs)
+                singles.append(jax.device_put(block, shard.device))
+            arr = jax.make_array_from_single_device_arrays(
+                gshape, leaf.sharding, singles
+            )
+            out.append(arr)
+        else:
+            np_leaf = np.asarray(leaf)
+            want = tuple((0, d) for d in np_leaf.shape)
+            block = assemble_leaf(
+                tuple(np_leaf.shape), str(np_leaf.dtype), want, recs
+            )
+            # preserve python scalar-ness for 0-d leaves
+            out.append(block[()] if block.ndim == 0 else block)
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,173 @@
+"""Shared-memory staging area for one checkpoint shard.
+
+Parity: ``SharedMemoryHandler`` ckpt_saver.py:208-339 — a tracker-free POSIX
+shm segment holds the raw tensor bytes; a ``SharedDict`` (unix-socket served
+by the agent) holds the metadata describing what is in the segment. The
+writer protocol is crash-safe: metadata is invalidated before the bytes are
+touched and re-published (with the new step) only after every buffer landed,
+so a reader can never see step-N metadata over step-M bytes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import asdict, dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import (
+    SharedDict,
+    SharedMemory,
+    attach_shared_memory,
+    create_shared_memory,
+)
+from dlrover_tpu.ckpt.sharding import Index, ShardRecord
+
+_META_DICT_PREFIX = "ckpt_meta"
+_SHM_PREFIX = "dlrover_tpu_ckpt"
+
+
+def shard_meta_name(local_rank: int) -> str:
+    return f"{_META_DICT_PREFIX}_{local_rank}"
+
+
+def shard_shm_name(local_rank: int) -> str:
+    job = os.getenv("DLROVER_TPU_JOB_NAME", "job")
+    node = os.getenv("DLROVER_TPU_NODE_RANK", "0")
+    return f"{_SHM_PREFIX}_{job}_{node}_{local_rank}"
+
+
+@dataclass
+class RecordMeta:
+    path: str
+    global_shape: Tuple[int, ...]
+    dtype: str
+    index: Index
+    offset: int
+    nbytes: int
+
+
+class ShmHandler:
+    """One shm segment + one meta dict, shared by one (engine, saver) pair.
+
+    The side that owns the unix-socket servers (the agent) passes
+    ``create=True``; training processes attach as clients.
+    """
+
+    def __init__(self, local_rank: int, create: bool = False):
+        self.local_rank = local_rank
+        self._meta = SharedDict(shard_meta_name(local_rank), create=create)
+        self._shm: Optional[SharedMemory] = None
+
+    # -- writer (training process) -------------------------------------
+    def save_records(
+        self, step: int, records: List[ShardRecord], extra: Dict
+    ) -> None:
+        metas: List[RecordMeta] = []
+        offset = 0
+        for r in records:
+            metas.append(
+                RecordMeta(
+                    path=r.path,
+                    global_shape=tuple(r.global_shape),
+                    dtype=r.dtype,
+                    index=r.index,
+                    offset=offset,
+                    nbytes=r.data.nbytes,
+                )
+            )
+            offset += r.data.nbytes
+        total = max(offset, 1)
+        if self._shm is None or self._shm.size < total:
+            if self._shm is not None:
+                self._shm.close()
+            self._shm = create_shared_memory(
+                shard_shm_name(self.local_rank), total
+            )
+            if self._shm is None:
+                raise RuntimeError("cannot allocate checkpoint shm")
+        # invalidate before mutating bytes
+        self._meta.set("valid", False)
+        buf = self._shm.buf
+        for r, m in zip(records, metas):
+            src = np.ascontiguousarray(r.data)
+            view = np.ndarray(
+                (m.nbytes,), dtype=np.uint8, buffer=buf, offset=m.offset
+            )
+            view[:] = src.view(np.uint8).reshape(-1)
+        self._meta.update(
+            {
+                "step": step,
+                "records": [asdict(m) for m in metas],
+                "extra": extra,
+                "shm_name": shard_shm_name(self.local_rank),
+                "valid": True,
+            }
+        )
+
+    # -- reader (agent saver, or engine on restore) --------------------
+    def metadata(self) -> Dict:
+        return self._meta.as_dict()
+
+    def load_records(self) -> Tuple[int, List[ShardRecord], Dict]:
+        """Read back (step, records, extra); records hold *copies* of the
+        bytes so the segment can be overwritten immediately after."""
+        meta = self.metadata()
+        if not meta.get("valid"):
+            raise LookupError("no valid checkpoint in shared memory")
+        needed = max(
+            (m["offset"] + m["nbytes"] for m in meta["records"]), default=1
+        )
+        shm = self._shm
+        if shm is not None and shm.size < needed:
+            # the writer outgrew and recreated the segment; our cached
+            # mapping points at the old unlinked one — reattach
+            shm.close()
+            shm = self._shm = None
+        if shm is None:
+            shm = attach_shared_memory(meta["shm_name"])
+            if shm is None or shm.size < needed:
+                raise LookupError("checkpoint shm segment missing")
+            self._shm = shm
+        records = []
+        for m in meta["records"]:
+            raw = np.ndarray(
+                (m["nbytes"],),
+                dtype=np.uint8,
+                buffer=shm.buf,
+                offset=m["offset"],
+            )
+            shape = tuple(hi - lo for lo, hi in m["index"])
+            data = (
+                raw.copy().view(np.dtype(m["dtype"])).reshape(shape)
+            )
+            records.append(
+                ShardRecord(
+                    path=m["path"],
+                    global_shape=tuple(m["global_shape"]),
+                    dtype=m["dtype"],
+                    index=tuple(tuple(i) for i in m["index"]),
+                    data=data,
+                )
+            )
+        return int(meta["step"]), records, meta.get("extra", {})
+
+    def no_checkpoint(self) -> bool:
+        try:
+            return not self.metadata().get("valid")
+        except Exception:
+            return True
+
+    def close(self, unlink: bool = False):
+        if self._shm is not None:
+            self._shm.close()
+            if unlink:
+                self._shm.unlink()
+            self._shm = None
+        self._meta.close()
+        if unlink:
+            logger.info(
+                f"checkpoint shm shard {self.local_rank} unlinked"
+            )

@@ -31,13 +31,38 @@ SOCKET_DIR_ENV = "DLROVER_TPU_SOCKET_DIR"
 
 
 def _socket_dir() -> str:
-    d = os.getenv(SOCKET_DIR_ENV, "/tmp/dlrover_tpu/sockets")
+    # namespaced per job so two launchers on one host cannot clobber each
+    # other's endpoints (the shm segments are namespaced the same way)
+    job = os.getenv("DLROVER_TPU_JOB_NAME", "job")
+    d = os.getenv(
+        SOCKET_DIR_ENV, os.path.join("/tmp/dlrover_tpu", job, "sockets")
+    )
     os.makedirs(d, exist_ok=True)
     return d
 
 
 def _socket_path(name: str) -> str:
     return os.path.join(_socket_dir(), f"{name}.sock")
+
+
+def server_exists(name: str) -> bool:
+    """True when some process is *actually serving* the named IPC endpoint
+    (a stale socket file left by a killed process probes as dead and is
+    removed)."""
+    path = _socket_path(name)
+    if not os.path.exists(path):
+        return False
+    try:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.settimeout(1.0)
+            s.connect(path)
+        return True
+    except OSError:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return False
 
 
 def clear_sockets():
@@ -178,11 +203,23 @@ class SharedLock(LocalSocketComm):
     def _do_locked(self) -> bool:
         return self._lock.locked()
 
+    def _do_force_release(self) -> bool:
+        if self._lock.locked():
+            self._owner = None
+            self._lock.release()
+            return True
+        return False
+
     def acquire(self, blocking: bool = True) -> bool:
         return self._call("acquire", blocking, self._owner_id())
 
     def release(self) -> bool:
         return self._call("release", self._owner_id())
+
+    def force_release(self) -> bool:
+        """Release regardless of owner — for lock-handoff protocols where a
+        different process (or a dead owner's supervisor) must unlock."""
+        return self._call("force_release")
 
     def locked(self) -> bool:
         return self._call("locked")

@@ -73,6 +73,9 @@ class CheckpointStorage(ABC):
 
     @abstractmethod
     def write(self, content: bytes | str, path: str):
+        """Write ``content`` to ``path`` **atomically**: readers must never
+        observe a partial file (the commit protocol publishes tracker files
+        through this)."""
         ...
 
     @abstractmethod

@@ -57,7 +57,11 @@ class ElasticDistributedSampler:
         if total <= len(indices):
             indices = indices[:total]
         else:
-            indices = np.concatenate([indices, indices[: total - len(indices)]])
+            # wrap as many times as needed (num_replicas can exceed the
+            # dataset size); a short epoch would give replicas different
+            # step counts and hang the next collective
+            reps = -(-total // len(indices))
+            indices = np.tile(indices, reps)[:total]
         return indices
 
     def __iter__(self) -> Iterator[int]:

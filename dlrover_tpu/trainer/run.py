@@ -28,6 +28,7 @@ from dlrover_tpu.agent.training_agent import (
     WorkerSpec,
     WorkerState,
 )
+from dlrover_tpu.ckpt.saver import AsyncCheckpointSaver
 from dlrover_tpu.common import comm
 from dlrover_tpu.utils.env import child_env
 from dlrover_tpu.common.constants import NodeEnv, RendezvousName
@@ -74,6 +75,12 @@ def parse_args(argv=None):
         type=str,
         default="",
         help="'cpu:8' for CPU-hosted virtual devices, default: real TPU",
+    )
+    p.add_argument(
+        "--job-name",
+        type=str,
+        default="",
+        help="namespaces IPC sockets/shm so jobs on one host don't collide",
     )
     p.add_argument("--log-dir", type=str, default="")
     p.add_argument("training_script", type=str)
@@ -143,6 +150,8 @@ def _run_network_check(args, client: MasterClient) -> bool:
 
 
 def run(args) -> int:
+    if args.job_name:
+        os.environ[NodeEnv.JOB_NAME] = args.job_name
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
     master_proc: Optional[subprocess.Popen] = None
     master_addr = args.master_addr or os.getenv(NodeEnv.MASTER_ADDR, "")
@@ -189,9 +198,16 @@ def run(args) -> int:
             log_dir=args.log_dir,
             device_spec=args.device_spec,
         )
+        # Flash-checkpoint saver must own its IPC endpoints before workers
+        # spawn (parity: start_async_saving_ckpt ckpt_saver.py:405); it also
+        # persists shm before any elastic restart ("save at breakpoint").
+        saver = AsyncCheckpointSaver.start_async_saving_ckpt(
+            local_shard_num=args.nproc_per_node, node_rank=args.node_rank
+        )
         agent = ElasticTrainingAgent(
             node_rank=args.node_rank, spec=spec, client=client
         )
+        agent.set_checkpoint_hook(saver.save_shm_to_storage)
         result = agent.run()
         logger.info(
             f"agent finished: {result.state} after "
@@ -199,6 +215,7 @@ def run(args) -> int:
         )
         return 0 if result.state == WorkerState.SUCCEEDED else 1
     finally:
+        AsyncCheckpointSaver.reset()
         client.close()
         if master_proc is not None:
             master_proc.terminate()

@@ -1,0 +1,58 @@
+"""Elastic worker exercising Flash Checkpoint through the real agent.
+
+Run 0: trains to step 3, flash-saves each step to memory only, then dies
+hard (simulated preemption) — the agent's save-at-breakpoint must persist
+the shm checkpoint before restarting us.
+Run 1: must resume from step 3 and finish.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+from dlrover_tpu.trainer.elastic.distributed import init_elastic
+from dlrover_tpu.ckpt import FlashCheckpointer
+
+
+def main() -> int:
+    init_elastic()
+    import jax.numpy as jnp
+
+    ckpt_dir = os.environ["TEST_CKPT_DIR"]
+    restart = int(os.getenv("DLROVER_TPU_RESTART_COUNT", "0"))
+
+    ckptr = FlashCheckpointer(ckpt_dir)
+    state = {"w": jnp.zeros((8,)), "step": 0}
+    start, restored = ckptr.load_checkpoint(state)
+    if restored is not None:
+        state = restored
+        print(f"resumed from step {start}", flush=True)
+
+    if restart > 0 and int(state["step"]) < 3:
+        print(f"FAIL: resumed at step {state['step']}, want 3", flush=True)
+        return 1
+
+    for step in range(int(state["step"]) + 1, 6):
+        state = {"w": state["w"] + 1.0, "step": step}
+        # a memory save is skipped (not blocked) while the saver is busy;
+        # retry so every step really lands in shm before we move on
+        for _ in range(100):
+            if ckptr.save_checkpoint(step, state):
+                break
+            time.sleep(0.2)
+        if restart == 0 and step == 3:
+            # die without persisting to disk: only shm has step 3
+            os._exit(13)
+
+    w = np.asarray(state["w"])
+    if not np.allclose(w, 5.0):
+        print(f"FAIL: w={w}", flush=True)
+        return 1
+    print("ckpt_train done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

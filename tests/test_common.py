@@ -127,6 +127,32 @@ class TestIPC:
         assert not client.release()  # releasing an unlocked lock is a no-op
         lock.close()
 
+    def test_force_release_breaks_dead_owner_lock(self):
+        lock = SharedLock("test-fr", create=True)
+        client = SharedLock("test-fr", create=False)
+        assert client.acquire(blocking=False)
+        # lock-handoff: the server side releases on behalf of the client
+        assert lock.force_release()
+        assert not lock.locked()
+        assert not lock.force_release()  # idempotent on unlocked
+        lock.close()
+
+    def test_server_exists_probes_liveness(self):
+        from dlrover_tpu.common.multi_process import (
+            _socket_path,
+            server_exists,
+        )
+
+        q = SharedQueue("test-alive", create=True)
+        assert server_exists("test-alive")
+        q.close()
+        # dead socket file left behind must probe False (and get cleaned)
+        with open(_socket_path("test-stale"), "w"):
+            pass
+        assert not server_exists("test-stale")
+        assert not os.path.exists(_socket_path("test-stale"))
+        assert not server_exists("test-never-existed")
+
     def test_shared_memory_survives_process(self):
         name = f"dlrover-tpu-test-{os.getpid()}"
         p = mp.get_context("spawn").Process(target=_shm_child, args=(name,))

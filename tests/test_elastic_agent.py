@@ -105,3 +105,30 @@ class TestLauncher:
             ]
         )
         assert rc == 0
+
+    @pytest.mark.slow
+    def test_flash_ckpt_survives_preemption(self, master, tmp_path):
+        """Worker flash-saves to memory only and dies hard at step 3; the
+        agent persists shm before restarting, and the restarted worker
+        resumes from step 3 (whole-stack Flash Checkpoint)."""
+        from dlrover_tpu.ckpt.saver import AsyncCheckpointSaver
+        from dlrover_tpu.trainer import run as run_mod
+
+        AsyncCheckpointSaver.reset()
+        ckpt_dir = str(tmp_path / "flash")
+        os.environ["TEST_CKPT_DIR"] = ckpt_dir
+        try:
+            rc = run_mod.main(
+                [
+                    "--nnodes=1",
+                    "--nproc-per-node=1",
+                    f"--master-addr={master.addr}",
+                    "--monitor-interval=0.3",
+                    "--device-spec=cpu:1",
+                    os.path.join(ASSETS, "ckpt_train.py"),
+                ]
+            )
+        finally:
+            os.environ.pop("TEST_CKPT_DIR", None)
+            AsyncCheckpointSaver.reset()
+        assert rc == 0

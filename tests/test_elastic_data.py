@@ -28,6 +28,17 @@ class TestSampler:
         assert flat == sorted(set(flat))  # disjoint
         assert len(flat) == 100
 
+    def test_replicas_exceed_dataset_all_ranks_step_equally(self):
+        # num_replicas > dataset_size: padding must wrap repeatedly so
+        # every rank yields the same count (else collectives hang)
+        samplers = [
+            ElasticDistributedSampler(3, num_replicas=8, rank=r)
+            for r in range(8)
+        ]
+        assert all(len(s) == 1 for s in samplers)
+        counts = {r: len(list(s)) for r, s in enumerate(samplers)}
+        assert set(counts.values()) == {1}
+
     def test_shuffle_deterministic_across_ranks(self):
         a = list(
             ElasticDistributedSampler(50, 2, 0, shuffle=True, seed=7)

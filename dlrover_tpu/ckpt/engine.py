@@ -15,6 +15,7 @@ leaves are staged as per-host shard records with global indices
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
@@ -43,6 +44,13 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _overlaps(a, b) -> bool:
+    """Two index tuples ((lo,hi),...) intersect."""
+    if len(a) != len(b):
+        return False
+    return all(max(alo, blo) < min(ahi, bhi) for (alo, ahi), (blo, bhi) in zip(a, b)) if a else True
+
+
 class CheckpointEngine:
     """One per training process. Talks to the per-host agent saver when one
     is serving the IPC endpoints; otherwise falls back to synchronous
@@ -68,10 +76,23 @@ class CheckpointEngine:
     # save
     # ------------------------------------------------------------------
     def save_to_memory(
-        self, step: int, state: Any, checkpoint_dir: str, sync: bool = False
+        self,
+        step: int,
+        state: Any,
+        checkpoint_dir: str,
+        sync: bool = False,
+        block: bool = True,
     ) -> bool:
         """Stage ``state`` into shm and notify the agent. Returns False when
-        skipped because the saver still holds the shard lock."""
+        skipped because the saver still holds the shard lock.
+
+        ``block=False`` runs the device→host copy + shm staging on a
+        background thread and returns immediately — safe because
+        ``jax.Array`` leaves are immutable (the train loop's next step
+        builds new arrays). Do NOT combine with a train step that donates
+        its state buffers: donation invalidates the arrays the staging
+        thread is still reading.
+        """
         if not self._agent_mode:
             return self._save_sync(step, state, checkpoint_dir)
         assert self._lock and self._shm and self._queue
@@ -86,6 +107,21 @@ class CheckpointEngine:
                 f"skipping this save"
             )
             return False
+        if block:
+            self._stage_and_notify(step, state, checkpoint_dir, sync)
+        else:
+            t = threading.Thread(
+                target=self._stage_and_notify,
+                args=(step, state, checkpoint_dir, sync),
+                name=f"ckpt-stage-{step}",
+                daemon=True,
+            )
+            t.start()
+        return True
+
+    def _stage_and_notify(
+        self, step: int, state: Any, checkpoint_dir: str, sync: bool
+    ):
         try:
             t0 = time.time()
             records = host_shard_records(state)
@@ -99,8 +135,13 @@ class CheckpointEngine:
                 f"step {step}: staged {len(records)} shard records to shm "
                 f"in {time.time() - t0:.3f}s"
             )
-        except BaseException:
-            self._lock.release()
+        except BaseException as e:
+            # force_release, not release: under block=False this runs on the
+            # staging thread, whose owner id differs from the acquirer's, so
+            # an owner-checked release would silently leak the lock and end
+            # checkpointing for the rest of the job
+            self._lock.force_release()
+            logger.error(f"step {step}: shm staging failed: {e!r}")
             raise
         self._queue.put(
             SaveEvent(
@@ -112,14 +153,28 @@ class CheckpointEngine:
                 sync=sync,
             )
         )
-        return True
 
     def save_to_storage(
-        self, step: int, state: Any, checkpoint_dir: str
+        self,
+        step: int,
+        state: Any,
+        checkpoint_dir: str,
+        timeout: float = 600.0,
     ) -> bool:
-        """Stage to shm and ask the agent to persist this step to storage
-        (the reference's ``StorageType.DISK`` path)."""
-        return self.save_to_memory(step, state, checkpoint_dir, sync=True)
+        """Stage to shm, ask the agent to persist this step, and wait until
+        the commit tracker names it (the reference's ``StorageType.DISK``
+        contract: returning True means the checkpoint is on storage)."""
+        if not self.save_to_memory(step, state, checkpoint_dir, sync=True):
+            return False
+        if not self._agent_mode:
+            return True  # _save_sync already committed
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.latest_step(checkpoint_dir) >= step:
+                return True
+            time.sleep(0.2)
+        logger.error(f"step {step}: storage persist not committed in time")
+        return False
 
     def _save_sync(self, step: int, state: Any, checkpoint_dir: str) -> bool:
         """No agent: write this process's shard directly to storage through
@@ -147,15 +202,7 @@ class CheckpointEngine:
     # load
     # ------------------------------------------------------------------
     def latest_step(self, checkpoint_dir: str) -> int:
-        raw = self.storage.read(
-            os.path.join(checkpoint_dir, saver_mod.TRACKER_FILE)
-        )
-        if not raw:
-            return -1
-        try:
-            return int(raw.decode() if isinstance(raw, bytes) else raw)
-        except ValueError:
-            return -1
+        return saver_mod.read_tracker(self.storage, checkpoint_dir)
 
     def load(
         self, target: Any, checkpoint_dir: str
@@ -165,21 +212,35 @@ class CheckpointEngine:
         engine.py:315), else reads the committed step from storage."""
         committed = self.latest_step(checkpoint_dir)
         if self._agent_mode and self._shm is not None:
+            # take the shard lock so we never read shm mid-rewrite by an
+            # in-flight block=False staging thread or while the saver is
+            # persisting; if we can't get it in time, storage is the safe
+            # source
+            got_lock = False
             try:
-                shm_step, records, _ = self._shm.load_records()
-                if shm_step >= committed and self._shm_covers(
-                    records, target
-                ):
-                    by_path: Dict[str, list] = {}
-                    for r in records:
-                        by_path.setdefault(r.path, []).append(r)
-                    state = restore_state(
-                        target, lambda p: by_path.get(p, [])
-                    )
-                    logger.info(f"restored step {shm_step} from memory")
-                    return shm_step, state
-            except (LookupError, ValueError):
-                pass
+                got_lock = self._lock.acquire(blocking=True)
+            except (TimeoutError, RuntimeError):
+                got_lock = False
+            if got_lock:
+                try:
+                    shm_step, records, _ = self._shm.load_records()
+                    if shm_step >= committed and self._shm_covers(
+                        records, target
+                    ):
+                        by_path: Dict[str, list] = {}
+                        for r in records:
+                            by_path.setdefault(r.path, []).append(r)
+                        state = restore_state(
+                            target, lambda p: by_path.get(p, [])
+                        )
+                        logger.info(
+                            f"restored step {shm_step} from memory"
+                        )
+                        return shm_step, state
+                except (LookupError, ValueError):
+                    pass
+                finally:
+                    self._lock.force_release()
         if committed < 0:
             return -1, None
         return committed, self._load_from_storage(
@@ -196,10 +257,12 @@ class CheckpointEngine:
         self, target: Any, checkpoint_dir: str, step: int
     ) -> Any:
         sdir = saver_mod.step_dir(checkpoint_dir, step)
+        files = [
+            f for f in self.storage.listdir(sdir) if f.endswith(".ckpt")
+        ]
+        needed = self._filter_needed_shards(sdir, files, target)
         by_path: Dict[str, list] = {}
-        for fname in self.storage.listdir(sdir):
-            if not fname.endswith(".ckpt"):
-                continue
+        for fname in needed:
             payload = self.storage.read_state_dict(
                 os.path.join(sdir, fname)
             )
@@ -213,3 +276,30 @@ class CheckpointEngine:
                 )
                 by_path.setdefault(rec.path, []).append(rec)
         return restore_state(target, lambda p: by_path.get(p, []))
+
+    def _filter_needed_shards(self, sdir, files, target):
+        """Use the .idx sidecars to read only shard files overlapping this
+        host's slices of ``target`` (restart I/O stays O(local state), not
+        O(global state) × hosts). Falls back to all files when any sidecar
+        is missing."""
+        wanted = host_shard_index_set(target)
+        needed = []
+        for fname in files:
+            index = None
+            try:
+                index = self.storage.read_state_dict(
+                    os.path.join(sdir, fname + ".idx")
+                )
+            except Exception:
+                index = None
+            if index is None:
+                return files
+            for m in index:
+                ridx = tuple(tuple(i) for i in m["index"])
+                if any(
+                    p == m["path"] and _overlaps(ridx, widx)
+                    for p, widx in wanted
+                ):
+                    needed.append(fname)
+                    break
+        return needed

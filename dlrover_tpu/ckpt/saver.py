@@ -41,6 +41,21 @@ CKPT_EVENT_QUEUE = "ckpt_event_queue"
 TRACKER_FILE = "latest_step"
 DONE_DIR = "._done"
 
+# serializes the tracker's read-check-write so concurrent commit threads
+# can never regress it
+_tracker_mutex = threading.Lock()
+
+
+def read_tracker(storage, checkpoint_dir: str) -> int:
+    """Committed step named by the tracker file; -1 when absent/garbled."""
+    raw = storage.read(os.path.join(checkpoint_dir, TRACKER_FILE))
+    if not raw:
+        return -1
+    try:
+        return int(raw.decode() if isinstance(raw, bytes) else raw)
+    except (AttributeError, ValueError):
+        return -1
+
 
 def shard_lock_name(local_rank: int) -> str:
     return f"ckpt_lock_{local_rank}"
@@ -83,9 +98,16 @@ def write_shard_and_done(
     storage, checkpoint_dir: str, step: int, payload: Dict
 ):
     gid = payload["global_shard_id"]
-    storage.write_state_dict(
-        payload, shard_file(checkpoint_dir, step, gid)
-    )
+    path = shard_file(checkpoint_dir, step, gid)
+    storage.write_state_dict(payload, path)
+    # index sidecar (record metas without data): lets a restarting host
+    # read only the shard files that contain its slices instead of the
+    # whole checkpoint
+    index = [
+        {k: m[k] for k in ("path", "global_shape", "dtype", "index")}
+        for m in payload["records"]
+    ]
+    storage.write_state_dict(index, path + ".idx")
     done = os.path.join(
         step_dir(checkpoint_dir, step), DONE_DIR, f"{gid}.done"
     )
@@ -113,9 +135,14 @@ def commit_checkpoint(
         except FileNotFoundError:
             done = []
         if len(done) >= global_shard_num:
-            storage.write(
-                str(step), os.path.join(checkpoint_dir, TRACKER_FILE)
-            )
+            # monotonic: concurrent commit threads for different steps must
+            # never regress the tracker (read-check-write under a mutex)
+            with _tracker_mutex:
+                if step > read_tracker(storage, checkpoint_dir):
+                    storage.write(
+                        str(step),
+                        os.path.join(checkpoint_dir, TRACKER_FILE),
+                    )
             storage.commit(step, True)
             logger.info(f"checkpoint step {step} committed")
             return True
@@ -183,6 +210,9 @@ class AsyncCheckpointSaver:
         # event loop and save-at-breakpoint/SIGTERM can race; persists are
         # idempotent but serializing them keeps the logs and locks sane
         self._persist_mutex = threading.Lock()
+        # live async commit threads by step (joined bounded on close so a
+        # fully-persisted final step doesn't die uncommitted)
+        self._commit_threads: Dict[int, threading.Thread] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -220,7 +250,24 @@ class AsyncCheckpointSaver:
                 cls._singleton.close()
                 cls._singleton = None
 
-    def close(self):
+    def close(self, drain_timeout: float = 30.0):
+        # drain: anything staged but not yet persisted (queued events the
+        # 2s-poll loop has not consumed) must land on storage before the
+        # shm segments are unlinked. Commits during drain are bounded — a
+        # dead peer node must not stall shutdown for the full 600s.
+        try:
+            self.save_shm_to_storage(commit_timeout=drain_timeout)
+        except Exception as e:
+            logger.error(f"drain-on-close persist failed: {e!r}")
+        # a persisted final step whose async commit thread is still polling
+        # must get its chance to publish the tracker
+        deadline = time.time() + drain_timeout
+        for step, t in list(self._commit_threads.items()):
+            t.join(timeout=max(0.0, deadline - time.time()))
+            if t.is_alive():
+                logger.warning(
+                    f"commit of step {step} still pending at shutdown"
+                )
         self._stop.set()
         for h in self._shm_handlers:
             h.close(unlink=True)
@@ -289,7 +336,13 @@ class AsyncCheckpointSaver:
     # ------------------------------------------------------------------
     # persistence
     # ------------------------------------------------------------------
-    def _persist_step(self, step: int, st: _StepState):
+    def _persist_step(
+        self,
+        step: int,
+        st: _StepState,
+        sync_commit: bool = False,
+        commit_timeout: float = 600.0,
+    ):
         t0 = time.time()
         try:
             with self._persist_mutex:
@@ -313,10 +366,23 @@ class AsyncCheckpointSaver:
                     f"persisted step {step} ({len(st.ranks)} local shards) "
                     f"in {time.time() - t0:.2f}s"
                 )
-            # shard locks are free again: the trainer can stage the next
-            # step while node-0 waits for the other nodes' done files
+            # shard locks are free again, and the commit wait normally runs
+            # on its own thread: a straggling node must not stall the event
+            # loop (newer steps would be skipped for up to the commit
+            # timeout). Breakpoint/SIGTERM persists commit synchronously —
+            # the process may be about to die.
             if self.node_rank == 0:
-                self._commit_checkpoint(step, st)
+                if sync_commit:
+                    self._commit_checkpoint(step, st, commit_timeout)
+                else:
+                    t = threading.Thread(
+                        target=self._commit_checkpoint,
+                        args=(step, st, commit_timeout),
+                        name=f"ckpt-commit-{step}",
+                        daemon=True,
+                    )
+                    self._commit_threads[step] = t
+                    t.start()
         except Exception as e:
             # one bad step (disk full, transient FS error) must not kill the
             # saver thread or leave the handoff locks held — that would
@@ -360,19 +426,25 @@ class AsyncCheckpointSaver:
         finally:
             lock.force_release()
 
-    def _commit_checkpoint(self, step: int, st: _StepState):
-        commit_checkpoint(
-            self.storage,
-            st.checkpoint_dir,
-            step,
-            st.global_shard_num,
-            stop_event=self._stop,
-        )
+    def _commit_checkpoint(
+        self, step: int, st: _StepState, timeout: float = 600.0
+    ):
+        try:
+            commit_checkpoint(
+                self.storage,
+                st.checkpoint_dir,
+                step,
+                st.global_shard_num,
+                timeout=timeout,
+                stop_event=self._stop,
+            )
+        finally:
+            self._commit_threads.pop(step, None)
 
     # ------------------------------------------------------------------
     # breakpoint / SIGTERM persistence
     # ------------------------------------------------------------------
-    def save_shm_to_storage(self):
+    def save_shm_to_storage(self, commit_timeout: float = 600.0):
         """Persist in-memory checkpoints newer than the last persisted step
         (the workers may be dead already — shm outlives them)."""
         steps: Dict[int, _StepState] = {}
@@ -392,7 +464,9 @@ class AsyncCheckpointSaver:
             st.ranks.add(r)
         for step, st in sorted(steps.items()):
             logger.info(f"save-at-breakpoint: persisting shm step {step}")
-            self._persist_step(step, st)
+            self._persist_step(
+                step, st, sync_commit=True, commit_timeout=commit_timeout
+            )
 
     @classmethod
     def save_shm_to_storage_if_any(cls):

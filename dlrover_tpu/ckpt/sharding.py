@@ -147,7 +147,10 @@ def assemble_leaf(
         if r.index == want and r.data is not None:
             return r.data
     out = np.empty(shape, dtype=np.dtype(dtype))
-    filled = 0
+    # coverage mask, not a count: overlapping records (e.g. files from two
+    # world layouts in one step dir) must not mask a real hole — a hole
+    # would silently return np.empty garbage as weights
+    covered = np.zeros(shape, dtype=bool) if shape else np.zeros((), bool)
     for r in records:
         if r.data is None:
             continue
@@ -165,10 +168,11 @@ def assemble_leaf(
         block = r.data[tuple(src_sel)] if src_sel else r.data
         if dst_sel:
             out[tuple(dst_sel)] = block
+            covered[tuple(dst_sel)] = True
         else:
             out[...] = block
-        filled += block.size
-    if filled < int(np.prod(shape)):
+            covered[...] = True
+    if not covered.all():
         raise ValueError(
             f"checkpoint shards do not cover requested index {want} of "
             f"shape {global_shape}"

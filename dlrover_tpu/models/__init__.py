@@ -1,0 +1,23 @@
+"""Model families + sharded training (GPT-2, Llama, MoE variants)."""
+
+from dlrover_tpu.models.config import (  # noqa: F401
+    TransformerConfig,
+    gpt2_small,
+    gpt2_xl,
+    llama2_7b,
+    tiny,
+)
+from dlrover_tpu.models.transformer import (  # noqa: F401
+    forward,
+    init_params,
+    logical_axes,
+    loss_fn,
+)
+from dlrover_tpu.models.train import (  # noqa: F401
+    TrainState,
+    build_train_step,
+    init_sharded_state,
+    param_shardings,
+    shard_batch,
+    state_shardings,
+)

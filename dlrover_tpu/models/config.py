@@ -1,0 +1,101 @@
+"""Model-family configs.
+
+Covers the reference's benchmark families: GPT-2 (nanogpt / GPT-2 xl 1.5B
+flash-ckpt benchmarks, BASELINE.md) and Llama-2 (atorch/examples/llama2).
+One config dataclass switches the architectural differences (learned vs
+rotary positions, LayerNorm vs RMSNorm, GELU-MLP vs SwiGLU, MHA vs GQA,
+optional MoE blocks).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    num_layers: int = 12
+    model_dim: int = 768
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None  # None => MHA
+    mlp_dim: Optional[int] = None  # None => 4*model_dim (gpt) / swiglu dim
+    max_seq_len: int = 1024
+    # architecture switches
+    rope: bool = False  # False => learned positional embeddings
+    rope_theta: float = 10000.0
+    rmsnorm: bool = False
+    swiglu: bool = False
+    tie_embeddings: bool = True
+    # MoE: every `moe_every`-th block uses an expert FFN
+    num_experts: int = 0
+    moe_every: int = 2
+    capacity_factor: float = 1.25
+    # numerics
+    dtype: str = "bfloat16"  # activation/compute dtype
+    param_dtype: str = "float32"
+    remat: bool = False  # checkpoint each block (HBM <-> FLOPs trade)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.model_dim // self.num_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        if self.mlp_dim:
+            return self.mlp_dim
+        return 4 * self.model_dim
+
+
+def gpt2_small() -> TransformerConfig:
+    return TransformerConfig()
+
+
+def gpt2_xl() -> TransformerConfig:
+    """GPT-2 xl 1.5B — the reference's flash-ckpt benchmark model
+    (docs/blogs/flash_checkpoint.md:292, megatron_flash_checkpoint.md)."""
+    return TransformerConfig(
+        num_layers=48, model_dim=1600, num_heads=25, max_seq_len=1024
+    )
+
+
+def llama2_7b() -> TransformerConfig:
+    """Llama-2-7B — the reference's atorch throughput benchmark model
+    (atorch/examples/llama2/README.md:398)."""
+    return TransformerConfig(
+        vocab_size=32000,
+        num_layers=32,
+        model_dim=4096,
+        num_heads=32,
+        num_kv_heads=32,
+        mlp_dim=11008,
+        max_seq_len=4096,
+        rope=True,
+        rmsnorm=True,
+        swiglu=True,
+        tie_embeddings=False,
+    )
+
+
+def tiny(**overrides) -> TransformerConfig:
+    """Test config: small every-feature model."""
+    cfg = TransformerConfig(
+        vocab_size=256,
+        num_layers=2,
+        model_dim=32,
+        num_heads=4,
+        num_kv_heads=2,
+        mlp_dim=64,
+        max_seq_len=64,
+        rope=True,
+        rmsnorm=True,
+        swiglu=True,
+        tie_embeddings=False,
+        dtype="float32",
+    )
+    return replace(cfg, **overrides)

@@ -1,0 +1,21 @@
+"""TPU-native parallelism layer.
+
+Replaces the reference's process-group fabric (atorch
+``create_parallel_group`` distributed.py:321, megatron-style TP modules
+layers.py:239-670, sequence-parallel distributed_attention.py:21, MoE
+moe_layer.py:87) with a **mesh + GSPMD sharding** design: one
+``jax.sharding.Mesh`` with named axes, a rule library that annotates the
+pytree, and XLA inserting the collectives. Explicit collectives appear only
+where the algorithm requires them (ring attention ``ppermute``, MoE
+``all_to_all``) inside ``shard_map``.
+"""
+
+from dlrover_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    build_mesh,
+)
+from dlrover_tpu.parallel.sharding_rules import (  # noqa: F401
+    ShardingRules,
+    apply_rules,
+    logical_to_mesh_axes,
+)

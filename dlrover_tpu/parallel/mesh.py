@@ -1,0 +1,126 @@
+"""Named-axis device mesh construction.
+
+Parity: atorch ``create_parallel_group`` / ``init_distributed``
+(atorch/atorch/distributed/distributed.py:321,588) — the reference builds
+NCCL process groups per named dim ("tensor", "pipe", "data", …) with rank
+reordering. On TPU the whole fabric is one ``jax.sharding.Mesh``: axis
+order encodes which collectives ride fast ICI (innermost axes) vs DCN
+(outermost, e.g. data-parallel across pod slices), and XLA/GSPMD derives
+the groups from shardings — no NCCL analog needed.
+
+Canonical axis names (any subset, sizes multiply to the device count):
+
+- ``dp``    pure data parallel (params replicated)
+- ``fsdp``  data parallel with param/optimizer sharding (ZeRO-3 analog)
+- ``tp``    tensor (megatron row/col) parallel
+- ``sp``    sequence/context parallel (ring attention)
+- ``ep``    expert parallel (MoE all-to-all)
+- ``pp``    pipeline stages
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Sizes per named axis; unspecified axes default to 1 and are kept in
+    the mesh (size-1 axes are free) so sharding rules never dangle."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+    # axes whose communication crosses slices/hosts over DCN; they are laid
+    # out outermost so ICI keeps the bandwidth-hungry collectives
+    dcn_axes: Tuple[str, ...] = ()
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp * self.ep * self.pp
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    @staticmethod
+    def from_dict(d: Dict[str, int]) -> "MeshConfig":
+        known = {k: v for k, v in d.items() if k in AXIS_ORDER}
+        return MeshConfig(**known)
+
+
+def build_mesh(
+    config: MeshConfig,
+    devices: Optional[Sequence] = None,
+):
+    """Build a ``jax.sharding.Mesh`` whose physical layout respects ICI
+    topology (``mesh_utils.create_device_mesh``) with DCN axes outermost
+    (``create_hybrid_device_mesh``) when requested."""
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    axis_names = tuple(AXIS_ORDER)
+    sizes = tuple(getattr(config, a) for a in AXIS_ORDER)
+    if devices is None:
+        devices = jax.devices()
+    n = int(np.prod(sizes))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(axis_names, sizes))} needs {n} devices, "
+            f"have {len(devices)}"
+        )
+    if config.dcn_axes:
+        dcn_sizes = tuple(
+            getattr(config, a) if a in config.dcn_axes else 1
+            for a in AXIS_ORDER
+        )
+        ici_sizes = tuple(
+            1 if a in config.dcn_axes else getattr(config, a)
+            for a in AXIS_ORDER
+        )
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_sizes,
+            dcn_mesh_shape=dcn_sizes,
+            devices=devices,
+        )
+    else:
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                sizes, devices=list(devices)
+            )
+        except (ValueError, AssertionError):
+            # CPU/virtual meshes have no physical topology metadata
+            dev_array = np.asarray(list(devices)).reshape(sizes)
+    return Mesh(dev_array, axis_names)
+
+
+def data_axes() -> Tuple[str, ...]:
+    """Mesh axes a global batch is sharded over."""
+    return ("dp", "fsdp")
+
+
+def batch_sharding(mesh):
+    """Canonical input-batch sharding: batch dim over (dp, fsdp), sequence
+    dim over sp (context parallel slices the sequence)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+
+
+def process_axis_index(mesh, axis: str) -> int:
+    """This process's coordinate along ``axis`` (for per-host data feeds):
+    the coordinate of the first mesh device owned by this process."""
+    import jax
+
+    for idx, dev in np.ndenumerate(mesh.devices):
+        if dev.process_index == jax.process_index():
+            return idx[mesh.axis_names.index(axis)]
+    return 0

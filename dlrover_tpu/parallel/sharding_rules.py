@@ -1,0 +1,89 @@
+"""Logical-axis sharding rules: the GSPMD replacement for the reference's
+hand-written TP modules.
+
+Parity: atorch's megatron-style ``RowParallelLinear``/``ColumnParallelLinear``
+/``VocabParallelEmbedding`` (modules/distributed_modules/layers.py:239,392,
+549) and its module-registry rewriting HF models into TP versions
+(modules_registry.py). On TPU none of that module surgery exists: models
+annotate each parameter with *logical* axis names ("embed", "mlp", "heads",
+"vocab", …), a rule table maps logical names → mesh axes, and ``jit`` with
+``NamedSharding`` makes XLA insert exactly the collectives megatron does
+(all-gather for column-parallel, reduce-scatter/psum for row-parallel) —
+fused with the matmuls.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+
+@dataclass
+class ShardingRules:
+    """Mapping from logical axis name → mesh axis (or axes, or None for
+    replicated). The default table implements DP/FSDP/TP/SP/EP for a
+    transformer LM."""
+
+    rules: Dict[str, MeshAxes] = field(default_factory=dict)
+
+    def axes_for(self, logical: Sequence[Optional[str]]) -> Tuple:
+        return tuple(self.rules.get(name) if name else None for name in logical)
+
+
+def default_lm_rules() -> ShardingRules:
+    """Megatron-equivalent layout:
+
+    - "mlp"/"heads"/"kv_heads" (column-parallel outputs) → tp
+    - "embed" (row-parallel inputs / residual stream)    → fsdp (ZeRO-3)
+    - "vocab"                                            → tp (vocab-parallel
+      embedding + cross-entropy, layers.py:549 analog)
+    - "seq" activations                                  → sp
+    - "experts"                                          → ep
+    - "batch"                                            → (dp, fsdp)
+    """
+    return ShardingRules(
+        rules={
+            "batch": ("dp", "fsdp"),
+            "seq": "sp",
+            "embed": "fsdp",
+            "mlp": "tp",
+            "heads": "tp",
+            "kv_heads": "tp",
+            "head_dim": None,
+            "vocab": "tp",
+            "experts": "ep",
+            "expert_mlp": "tp",
+            "norm": None,
+        }
+    )
+
+
+def logical_to_mesh_axes(
+    logical_axes: Sequence[Optional[str]], rules: ShardingRules
+):
+    """PartitionSpec for one array's logical axis names."""
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*rules.axes_for(logical_axes))
+
+
+def apply_rules(
+    logical_tree: Any,
+    rules: ShardingRules,
+    mesh,
+):
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.tree_util.tree_map(
+        lambda axes: NamedSharding(
+            mesh, logical_to_mesh_axes(axes, rules)
+        ),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(a is None or isinstance(a, str) for a in x),
+    )

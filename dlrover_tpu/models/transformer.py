@@ -189,20 +189,15 @@ def _rope(x, positions, theta: float):
 
 
 def _causal_attention(q, k, v):
-    """Single-shard causal attention, fp32 softmax. [B,T,H,D]."""
-    D = q.shape[-1]
-    H, Hkv = q.shape[2], k.shape[2]
-    if Hkv != H:
-        k = jnp.repeat(k, H // Hkv, axis=2)
-        v = jnp.repeat(v, H // Hkv, axis=2)
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * (D**-0.5)
-    T = q.shape[1]
-    mask = jnp.tril(jnp.ones((T, T), bool))
-    s = jnp.where(mask[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    """Single-shard causal attention. [B,T,H,D].
+
+    Dispatches to the Pallas flash-attention kernel on TPU (block-tiled,
+    O(T) HBM traffic) and the materialized-score jnp path elsewhere —
+    ops/flash_attention.py owns both and their shared numerics.
+    """
+    from dlrover_tpu.ops.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, causal=True)
 
 
 def _attention_block(x, layer, cfg: TransformerConfig, mesh, positions):

@@ -1,0 +1,805 @@
+"""Block-tiled flash attention for TPU (Pallas) with a jnp fallback.
+
+Parity: the reference integrates CUDA flash-attention (FA1/FA2 + GLM
+custom-mask kernels) via wrapper modules at
+atorch/atorch/modules/transformer/layers.py:54-1168 and TF bindings at
+tfplus/tfplus/flash_attn/kernels/flash_attention_fwd_kernel.cc:172. The
+TPU-native equivalent is a Pallas kernel: the (q_block, kv_block) tiles
+ride the MXU, the online-softmax state (running max / sum) lives in VMEM
+scratch, and HBM traffic is O(T) per query block instead of the O(T^2)
+score matrix.
+
+Design:
+
+- ``flash_attention(q, k, v)`` — public entry, [B, T, H, D] layout, GQA
+  (H_kv divides H, resolved in the BlockSpec index map — KV heads are
+  never materialized ``H/H_kv`` times), causal or custom position masks,
+  dynamic block offsets so ring attention (parallel/ring_attention.py)
+  can reuse the same kernel per KV hop.
+- Differentiable via ``jax.custom_vjp``: backward is two more Pallas
+  kernels (dq pass and dk/dv pass) using the saved (o, logsumexp)
+  residuals, the standard FA2 recomputation split.
+- On non-TPU backends it dispatches to ``flash_attention_reference`` —
+  identical math, pure jnp — so CPU tests are fast; the kernels
+  themselves are tested under ``interpret=True``.
+
+Mask contract: ``mask_fn(q_pos, k_pos)`` receives broadcastable int32
+position arrays (shapes ``[bq, 1]`` and ``[1, bk]``) and must return an
+elementwise bool mask, e.g. ``lambda q, k: q >= k`` for causal.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MaskFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+NEG_INF = -1e30  # finite stand-in for -inf: keeps exp()=0 without NaN risk
+_LANES = 128  # f32 VMEM tile lane count; scratch vectors are padded to it
+
+
+def _mask_for_block(q_pos, k_pos, causal, mask_fn):
+    """[bq,1] x [1,bk] positions -> bool mask or None (= all visible)."""
+    if mask_fn is not None:
+        return mask_fn(q_pos, k_pos)
+    if causal:
+        return q_pos >= k_pos
+    return None
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+def _fwd_kernel(
+    off_ref,  # SMEM [2]: (q_offset, k_offset) global position offsets
+    q_ref,  # VMEM [1, 1, bq, D]
+    k_ref,  # VMEM [1, 1, bk, D]
+    v_ref,  # VMEM [1, 1, bk, D]
+    o_ref,  # VMEM [1, 1, bq, D]
+    lse_ref,  # VMEM [1, 1, bq, 1]
+    acc_ref,  # scratch [bq, D] f32
+    m_ref,  # scratch [bq, _LANES] f32
+    l_ref,  # scratch [bq, _LANES] f32
+    *,
+    causal: bool,
+    mask_fn: Optional[MaskFn],
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+):
+    jk = pl.program_id(3)
+    nk = pl.num_programs(3)
+    q_off = off_ref[0] + pl.program_id(2) * block_q
+    k_off = off_ref[1] + jk * block_k
+
+    @pl.when(jk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # whole-block causal skip: no query in this block can see any key
+    visible = True
+    if causal and mask_fn is None:
+        visible = q_off + block_q - 1 >= k_off
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q,
+            k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        s = s * sm_scale
+        q_pos = q_off + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+        k_pos = k_off + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        mask = _mask_for_block(q_pos, k_pos, causal, mask_fn)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # fully-masked-so-far rows keep m_new == NEG_INF; exponentiate
+        # against 0 there so p = exp(NEG_INF) = 0 instead of exp(0) = 1
+        m_safe = jnp.where(m_new > NEG_INF * 0.5, m_new, 0.0)
+        alpha = jnp.exp(m_prev - m_safe)
+        p = jnp.exp(s - m_safe)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p,
+            v_ref[0, 0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        # fully-masked rows: l == 0 -> output 0, lse = NEG_INF
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        m = m_ref[:, :1]
+        lse = jnp.where(l > 0.0, m + jnp.log(safe_l), NEG_INF)
+        lse_ref[0, 0] = lse
+
+
+def _fwd_pallas(
+    q,
+    k,
+    v,
+    offsets,
+    *,
+    causal,
+    mask_fn,
+    sm_scale,
+    block_q,
+    block_k,
+    interpret,
+):
+    # Kernel layout is [B, H, T, D]: TPU tiling needs the last two block
+    # dims to be (seq_block, head_dim) — (8,128)-aligned or full-size.
+    B, Tq, H, D = q.shape
+    Tk, Hkv = k.shape[1], k.shape[2]
+    nq, nk = Tq // block_q, Tk // block_k
+    group = H // Hkv
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        causal=causal,
+        mask_fn=mask_fn,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    grid = (B, H, nq, nk)
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, i, j: (b, h // group, j, 0)
+    )
+    # minor dim 1 == full array dim, so the tile is legal and lse costs
+    # [B,H,T] f32 in HBM instead of 128x that
+    lse_spec = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)
+    )
+    ot, lse4 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            q_spec,
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=[q_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                "parallel",
+                "parallel",
+                "parallel",
+                "arbitrary",
+            ),
+        ),
+        interpret=interpret,
+    )(offsets, qt, kt, vt)
+    return ot.transpose(0, 2, 1, 3), lse4[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (FA2 split: dq pass, then dk/dv pass)
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(
+    off_ref,
+    q_ref,  # [1, 1, bq, D]
+    k_ref,  # [1, 1, bk, D]
+    v_ref,
+    do_ref,  # [1, 1, bq, D]
+    lse_ref,  # [1, 1, bq, 1]
+    delta_ref,  # [1, 1, bq, 1]
+    dq_ref,  # out [1, 1, bq, D]
+    dq_acc,  # scratch [bq, D] f32
+    *,
+    causal,
+    mask_fn,
+    sm_scale,
+    block_q,
+    block_k,
+):
+    jk = pl.program_id(3)
+    nk = pl.num_programs(3)
+    q_off = off_ref[0] + pl.program_id(2) * block_q
+    k_off = off_ref[1] + jk * block_k
+
+    @pl.when(jk == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    visible = True
+    if causal and mask_fn is None:
+        visible = q_off + block_q - 1 >= k_off
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * sm_scale
+        )
+        q_pos = q_off + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+        k_pos = k_off + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        mask = _mask_for_block(q_pos, k_pos, causal, mask_fn)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        lse = lse_ref[0, 0, :, :1]  # [bq, 1]
+        # fully-masked rows have lse == NEG_INF; exp(s - lse) would be
+        # exp(0) = 1 there, leaking gradient through positions the
+        # forward zeroed — zero p explicitly
+        row_valid = lse > NEG_INF * 0.5
+        p = jnp.where(row_valid, jnp.exp(s - lse), 0.0)
+        do = do_ref[0, 0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do,
+            v_ref[0, 0],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        delta = delta_ref[0, 0, :, :1]
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds,
+            k,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    off_ref,
+    q_ref,  # [1, 1, bq, D]
+    k_ref,  # [1, 1, bk, D]
+    v_ref,
+    do_ref,
+    lse_ref,  # [1, 1, bq, 1]
+    delta_ref,
+    dk_ref,  # out [1, 1, bk, D]  (per q-head; summed over groups outside)
+    dv_ref,
+    dk_acc,  # scratch [bk, D] f32
+    dv_acc,
+    *,
+    causal,
+    mask_fn,
+    sm_scale,
+    block_q,
+    block_k,
+):
+    iq = pl.program_id(3)
+    nq = pl.num_programs(3)
+    q_off = off_ref[0] + iq * block_q
+    k_off = off_ref[1] + pl.program_id(2) * block_k
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    visible = True
+    if causal and mask_fn is None:
+        visible = q_off + block_q - 1 >= k_off
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * sm_scale
+        )
+        q_pos = q_off + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+        k_pos = k_off + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        mask = _mask_for_block(q_pos, k_pos, causal, mask_fn)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        lse = lse_ref[0, 0, :, :1]
+        # zero p on fully-masked rows (see _bwd_dq_kernel)
+        row_valid = lse > NEG_INF * 0.5
+        p = jnp.where(row_valid, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        do = do_ref[0, 0].astype(jnp.float32)
+        # dv += p^T @ do
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p,
+            do,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do,
+            v_ref[0, 0],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        delta = delta_ref[0, 0, :, :1]
+        ds = p * (dp - delta) * sm_scale  # [bq, bk]
+        # dk += ds^T @ q
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds,
+            q,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(
+    q,
+    k,
+    v,
+    offsets,
+    o,
+    lse,
+    do,
+    *,
+    causal,
+    mask_fn,
+    sm_scale,
+    block_q,
+    block_k,
+    interpret,
+):
+    B, Tq, H, D = q.shape
+    Tk, Hkv = k.shape[1], k.shape[2]
+    nq, nk = Tq // block_q, Tk // block_k
+    group = H // Hkv
+    qt = q.transpose(0, 2, 1, 3)  # [B,H,T,D] kernel layout
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = do.transpose(0, 2, 1, 3)
+
+    # delta_i = rowsum(do_i * o_i) — bandwidth-bound, XLA fuses it
+    delta = jnp.einsum(
+        "bqhd,bqhd->bhq",
+        do.astype(jnp.float32),
+        o.astype(jnp.float32),
+    )
+    delta4 = delta[..., None]  # [B,H,Tq,1]
+    lse4 = lse[..., None]
+
+    common = dict(
+        causal=causal,
+        mask_fn=mask_fn,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, i, j: (b, h // group, j, 0)
+    )
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)
+    )
+
+    dqt = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            q_spec,
+            kv_spec,
+            kv_spec,
+            q_spec,
+            row_spec,
+            row_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                "parallel",
+                "parallel",
+                "parallel",
+                "arbitrary",
+            ),
+        ),
+        interpret=interpret,
+    )(offsets, qt, kt, vt, dot, lse4, delta4)
+
+    # dk/dv pass: grid iterates k blocks outer, q blocks inner. Outputs are
+    # per q-head ([B,H,Tk,D]); GQA folds the head group by summing outside.
+    q_spec2 = pl.BlockSpec(
+        (1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)
+    )
+    kv_spec2 = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, j, i: (b, h // group, j, 0)
+    )
+    kv_out_spec = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)
+    )
+    row_spec2 = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)
+    )
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(B, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            q_spec2,
+            kv_spec2,
+            kv_spec2,
+            q_spec2,
+            row_spec2,
+            row_spec2,
+        ],
+        out_specs=[kv_out_spec, kv_out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tk, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Tk, D), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                "parallel",
+                "parallel",
+                "parallel",
+                "arbitrary",
+            ),
+        ),
+        interpret=interpret,
+    )(offsets, qt, kt, vt, dot, lse4, delta4)
+
+    dq = dqt.transpose(0, 2, 1, 3)
+    dk_t = dk_full.transpose(0, 2, 1, 3)  # [B,Tk,H,D]
+    dv_t = dv_full.transpose(0, 2, 1, 3)
+    if group > 1:
+        dk = dk_t.reshape(B, Tk, Hkv, group, D).sum(3)
+        dv = dv_t.reshape(B, Tk, Hkv, group, D).sum(3)
+    else:
+        dk, dv = dk_t, dv_t
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper around the pallas path (static offsets)
+# ---------------------------------------------------------------------------
+# Offsets are static here so they can ride nondiff_argnums; callers with
+# *traced* offsets (ring attention's per-hop global positions) use the raw
+# ``flash_attention_fwd``/``flash_attention_bwd`` pair and define their own
+# VJP at the ring level, where the lse residual's gradient is handled.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_pallas(
+    q, k, v, offsets, causal, mask_fn, sm_scale, block_q, block_k
+):
+    o, _ = _fwd_pallas(
+        q,
+        k,
+        v,
+        jnp.asarray(offsets, jnp.int32),
+        causal=causal,
+        mask_fn=mask_fn,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=_interpret_default(),
+    )
+    return o
+
+
+def _flash_fwd_rule(
+    q, k, v, offsets, causal, mask_fn, sm_scale, block_q, block_k
+):
+    o, lse = _fwd_pallas(
+        q,
+        k,
+        v,
+        jnp.asarray(offsets, jnp.int32),
+        causal=causal,
+        mask_fn=mask_fn,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=_interpret_default(),
+    )
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(
+    offsets, causal, mask_fn, sm_scale, block_q, block_k, res, do
+):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd_pallas(
+        q,
+        k,
+        v,
+        jnp.asarray(offsets, jnp.int32),
+        o,
+        lse,
+        do,
+        causal=causal,
+        mask_fn=mask_fn,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=_interpret_default(),
+    )
+    return dq, dk, dv
+
+
+_flash_pallas.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _interpret_default() -> bool:
+    """Pallas kernels only compile on TPU; interpret elsewhere (tests)."""
+    return jax.default_backend() != "tpu"
+
+
+# Raw (non-differentiable) kernel entries for callers composing their own
+# VJP — ring attention merges per-hop (o, lse) partials across devices.
+def flash_attention_fwd(
+    q,
+    k,
+    v,
+    *,
+    causal=True,
+    sm_scale=None,
+    mask_fn=None,
+    q_offset=0,
+    k_offset=0,
+    block_q=512,
+    block_k=512,
+    interpret=None,
+):
+    """Forward kernel; returns ``(o, lse)`` with lse ``[B,H,Tq]`` f32."""
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    bq, bk = _validate_blocks(q, k, block_q, block_k)
+    return _fwd_pallas(
+        q,
+        k,
+        v,
+        jnp.asarray(jnp.stack([q_offset, k_offset]), jnp.int32),
+        causal=causal,
+        mask_fn=mask_fn,
+        sm_scale=scale,
+        block_q=bq,
+        block_k=bk,
+        interpret=_interpret_default() if interpret is None else interpret,
+    )
+
+
+def flash_attention_bwd(
+    q,
+    k,
+    v,
+    o,
+    lse,
+    do,
+    *,
+    causal=True,
+    sm_scale=None,
+    mask_fn=None,
+    q_offset=0,
+    k_offset=0,
+    block_q=512,
+    block_k=512,
+    interpret=None,
+):
+    """Backward kernels; returns ``(dq, dk, dv)`` given saved residuals."""
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    bq, bk = _validate_blocks(q, k, block_q, block_k)
+    return _bwd_pallas(
+        q,
+        k,
+        v,
+        jnp.asarray(jnp.stack([q_offset, k_offset]), jnp.int32),
+        o,
+        lse,
+        do,
+        causal=causal,
+        mask_fn=mask_fn,
+        sm_scale=scale,
+        block_q=bq,
+        block_k=bk,
+        interpret=_interpret_default() if interpret is None else interpret,
+    )
+
+
+def _validate_blocks(q, k, block_q, block_k):
+    Tq, Tk = q.shape[1], k.shape[1]
+    bq, bk = min(block_q, Tq), min(block_k, Tk)
+    if Tq % bq or Tk % bk or bq % 8 or bk % 8:
+        # TPU sublane tiling wants 8-aligned seq blocks; the public entry
+        # falls back to the jnp path on this error
+        raise ValueError(
+            f"sequence lengths ({Tq=}, {Tk=}) must divide into 8-aligned "
+            f"blocks ({bq=}, {bk=}); pad inputs or pass other block sizes"
+        )
+    return bq, bk
+
+
+# ---------------------------------------------------------------------------
+# jnp reference (CPU fallback + numerics oracle)
+# ---------------------------------------------------------------------------
+def flash_attention_reference(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    mask_fn: Optional[MaskFn] = None,
+    q_offset=0,
+    k_offset=0,
+    return_residuals: bool = False,
+):
+    """Same semantics as the kernel, materialized scores. Differentiable."""
+    D = q.shape[-1]
+    H, Hkv = q.shape[2], k.shape[2]
+    if Hkv != H:
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
+    scale = sm_scale if sm_scale is not None else D**-0.5
+    s = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        )
+        * scale
+    )
+    Tq, Tk = q.shape[1], k.shape[1]
+    q_pos = (q_offset + jnp.arange(Tq))[:, None]
+    k_pos = (k_offset + jnp.arange(Tk))[None, :]
+    mask = _mask_for_block(q_pos, k_pos, causal, mask_fn)
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.maximum(m, NEG_INF)
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    visible = m > NEG_INF / 2
+    o = jnp.einsum("bhqk,bkhd->bqhd", p / jnp.maximum(l, 1e-30), v)
+    o = jnp.where(
+        visible.squeeze(-1)[..., None].transpose(0, 2, 1, 3), o, 0.0
+    ).astype(q.dtype)
+    if not return_residuals:
+        return o
+    lse = jnp.where(
+        visible, m_safe + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF
+    ).squeeze(-1)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    mask_fn: Optional[MaskFn] = None,
+    q_offset=0,
+    k_offset=0,
+    block_q: int = 512,
+    block_k: int = 512,
+    return_residuals: bool = False,
+    force: Optional[str] = None,
+):
+    """Flash attention over ``q:[B,Tq,H,D] k,v:[B,Tk,Hkv,D]``.
+
+    ``q_offset``/``k_offset`` are global position offsets (scalars, may be
+    traced) so a caller holding one ring hop's KV block can evaluate the
+    correct causal/custom mask. ``return_residuals`` adds the f32
+    logsumexp ``[B,H,Tq]``, letting callers merge partial attention
+    results across devices (online-softmax merge in ring attention).
+
+    ``force``: ``None`` auto-picks (pallas on TPU, jnp elsewhere),
+    ``"pallas"``/``"reference"`` override.
+
+    The differentiable pallas path requires static int offsets; for
+    traced offsets or ``return_residuals`` gradients, compose
+    ``flash_attention_fwd``/``flash_attention_bwd`` directly (see ring
+    attention).
+    """
+    mode = force
+    if mode is None:
+        mode = "pallas" if jax.default_backend() == "tpu" else "reference"
+    if mode == "reference":
+        return flash_attention_reference(
+            q,
+            k,
+            v,
+            causal=causal,
+            sm_scale=sm_scale,
+            mask_fn=mask_fn,
+            q_offset=q_offset,
+            k_offset=k_offset,
+            return_residuals=return_residuals,
+        )
+
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    try:
+        bq, bk = _validate_blocks(q, k, block_q, block_k)
+    except ValueError:
+        if force is not None:
+            raise
+        # odd sequence length: the jnp path has no tiling constraint
+        return flash_attention_reference(
+            q,
+            k,
+            v,
+            causal=causal,
+            sm_scale=scale,
+            mask_fn=mask_fn,
+            q_offset=q_offset,
+            k_offset=k_offset,
+            return_residuals=return_residuals,
+        )
+    if return_residuals:
+        # raw forward — callers own the VJP (e.g. the ring merge)
+        return flash_attention_fwd(
+            q,
+            k,
+            v,
+            causal=causal,
+            sm_scale=scale,
+            mask_fn=mask_fn,
+            q_offset=q_offset,
+            k_offset=k_offset,
+            block_q=bq,
+            block_k=bk,
+        )
+    if not isinstance(q_offset, int) or not isinstance(k_offset, int):
+        raise ValueError(
+            "the differentiable pallas path needs static int offsets; "
+            "use flash_attention_fwd/_bwd for traced offsets"
+        )
+    return _flash_pallas(
+        q, k, v, (q_offset, k_offset), causal, mask_fn, scale, bq, bk
+    )

@@ -1,0 +1,339 @@
+"""8-bit (blockwise-quantized state) AdamW for TPU.
+
+Parity: ATorch's low-bit optimizer — python driver
+atorch/atorch/optimizers/low_bit/functional.py (vectorwise/blockwise
+quantization, linear + nonlinear qmaps) backed by the CUDA kernels in
+atorch/atorch/ops/csrc/{quantize.cu,dequantize.cu,quantization_optimizer.cu}.
+
+TPU-native design: optimizer moments are stored as int8 codes + one f32
+scale per 128-element block. The hot path (dequantize -> Adam moment
+update -> requantize -> parameter delta) is a single fused Pallas kernel
+— one HBM read of (g, codes, scales) and one write of (codes', scales',
+update), the same memory-traffic win the reference's fused CUDA kernel
+gets. Block size 128 = one VPU lane row, so per-block reductions
+(max|m|) are single-row reductions with no cross-lane shuffles.
+
+Quantization is *linear* blockwise (codes = round(x/scale * 127)): on
+TPU a nonlinear 256-entry codebook lookup per element (the reference's
+dynamic map) would serialize into gathers; linear keeps the whole update
+elementwise on the VPU. The f32 scale per 128 values bounds relative
+error to ~0.4% of the block max, and Adam's moments are smooth enough
+that this matches fp32 training loss in the tests.
+
+The same math runs as plain jnp off-TPU (``use_pallas=False`` or CPU
+backend), so numerics are identical across paths.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK = 128  # quantization block = one VPU lane row
+_ROWS = 256  # rows per pallas grid step (256*128 elems/step)
+
+
+@jax.tree_util.register_pytree_node_class
+class Quantized8:
+    """Blockwise linearly quantized tensor: ``x ~ codes * scales / qmax``.
+
+    ``codes``/``scales`` are pytree children; ``shape``/``signed`` are
+    static aux data so jit never traces them.
+    """
+
+    def __init__(self, codes, scales, shape, signed):
+        self.codes = codes  # int8 [nblocks, BLOCK]
+        self.scales = scales  # f32 [nblocks, 1]
+        self.shape = tuple(shape)
+        self.signed = bool(signed)
+
+    def tree_flatten(self):
+        return (self.codes, self.scales), (self.shape, self.signed)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1])
+
+    def __repr__(self):
+        return (
+            f"Quantized8(shape={self.shape}, signed={self.signed}, "
+            f"nblocks={self.codes.shape[0]})"
+        )
+
+
+def _to_blocks(x):
+    flat = x.reshape(-1)
+    pad = (-flat.size) % BLOCK
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(-1, BLOCK)
+
+
+def _from_blocks(blocks, shape):
+    n = 1
+    for d in shape:
+        n *= d
+    return blocks.reshape(-1)[:n].reshape(shape)
+
+
+def _quant_block_math(x, signed):
+    """x: [rows, BLOCK] f32 -> (int8 codes, scales [rows,1]).
+
+    Power-2 ("sqrt") map, the reference's ``power-2`` qmap
+    (low_bit/functional.py:531 ``create_pow_map``): normalize to the block
+    max, code = round(sign(y)*sqrt(|y|)*127). The sqrt spreads codes
+    toward zero, so the smallest representable nonzero value is
+    scale/127^2 instead of scale/127 — without it Adam's second moment
+    underflows to 0 for small-magnitude coordinates and the update blows
+    up through the eps denominator. Purely elementwise (no codebook
+    gather), so it stays on the VPU.
+    """
+    if signed:
+        scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    else:
+        scale = jnp.max(x, axis=-1, keepdims=True)
+    safe = jnp.maximum(scale, 1e-30)
+    y = x / safe
+    codes = jnp.round(jnp.sign(y) * jnp.sqrt(jnp.abs(y)) * 127.0)
+    lo = -127.0 if signed else 0.0
+    codes = jnp.clip(codes, lo, 127.0)
+    return codes.astype(jnp.int8), scale
+
+
+def _dequant_block_math(codes, scales):
+    c = codes.astype(jnp.float32) / 127.0
+    return jnp.sign(c) * c * c * scales
+
+
+def quantize_8bit(x, signed: bool = True) -> Quantized8:
+    codes, scales = _quant_block_math(
+        _to_blocks(x.astype(jnp.float32)), signed
+    )
+    return Quantized8(codes, scales, tuple(x.shape), signed)
+
+
+def dequantize_8bit(q: Quantized8):
+    return _from_blocks(_dequant_block_math(q.codes, q.scales), q.shape)
+
+
+# ---------------------------------------------------------------------------
+# fused 8-bit adam update
+# ---------------------------------------------------------------------------
+def _adam8_block_math(g, m, v, lr, b1, b2, eps, bc1, bc2):
+    """Shared fp32 math: returns (m_new, v_new, delta). All [rows, BLOCK]."""
+    m_new = b1 * m + (1.0 - b1) * g
+    v_new = b2 * v + (1.0 - b2) * g * g
+    m_hat = m_new / bc1
+    v_hat = v_new / bc2
+    delta = -lr * m_hat / (jnp.sqrt(v_hat) + eps)
+    return m_new, v_new, delta
+
+
+def _adam8_kernel(
+    scalar_ref,  # SMEM [4]: lr, bc1, bc2, eps  (f32)
+    g_ref,  # [R, BLOCK] f32
+    mc_ref,  # [R, BLOCK] i8
+    ms_ref,  # [R, 1] f32
+    vc_ref,  # [R, BLOCK] i8
+    vs_ref,  # [R, 1] f32
+    mc_out,
+    ms_out,
+    vc_out,
+    vs_out,
+    delta_out,  # [R, BLOCK] f32
+    *,
+    b1: float,
+    b2: float,
+):
+    lr, bc1, bc2, eps = (
+        scalar_ref[0],
+        scalar_ref[1],
+        scalar_ref[2],
+        scalar_ref[3],
+    )
+    g = g_ref[:].astype(jnp.float32)
+    m = _dequant_block_math(mc_ref[:], ms_ref[:])
+    v = _dequant_block_math(vc_ref[:], vs_ref[:])
+    m_new, v_new, delta = _adam8_block_math(
+        g, m, v, lr, b1, b2, eps, bc1, bc2
+    )
+    mc, ms = _quant_block_math(m_new, signed=True)
+    vc, vs = _quant_block_math(v_new, signed=False)
+    mc_out[:] = mc
+    ms_out[:] = ms
+    vc_out[:] = vc
+    vs_out[:] = vs
+    delta_out[:] = delta
+
+
+def _adam8_update_pallas(g_blocks, mq, vq, scalars, b1, b2, interpret):
+    rows = g_blocks.shape[0]
+    r = min(_ROWS, rows)
+    if rows % r:
+        # pad rows to the grid chunk; padded rows carry zeros
+        pad = (-rows) % r
+        g_blocks = jnp.pad(g_blocks, ((0, pad), (0, 0)))
+        mq = Quantized8(
+            jnp.pad(mq.codes, ((0, pad), (0, 0))),
+            jnp.pad(mq.scales, ((0, pad), (0, 0))),
+            mq.shape,
+            mq.signed,
+        )
+        vq = Quantized8(
+            jnp.pad(vq.codes, ((0, pad), (0, 0))),
+            jnp.pad(vq.scales, ((0, pad), (0, 0))),
+            vq.shape,
+            vq.signed,
+        )
+    nrows = g_blocks.shape[0]
+    grid = (nrows // r,)
+    row_spec = pl.BlockSpec((r, BLOCK), lambda i: (i, 0))
+    scale_spec = pl.BlockSpec((r, 1), lambda i: (i, 0))
+    outs = pl.pallas_call(
+        functools.partial(_adam8_kernel, b1=b1, b2=b2),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            row_spec,
+            row_spec,
+            scale_spec,
+            row_spec,
+            scale_spec,
+        ],
+        out_specs=[row_spec, scale_spec, row_spec, scale_spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((nrows, BLOCK), jnp.int8),
+            jax.ShapeDtypeStruct((nrows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((nrows, BLOCK), jnp.int8),
+            jax.ShapeDtypeStruct((nrows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((nrows, BLOCK), jnp.float32),
+        ],
+        interpret=interpret,
+    )(scalars, g_blocks, mq.codes, mq.scales, vq.codes, vq.scales)
+    mc, ms, vc, vs, delta = outs
+    return (
+        Quantized8(mc[:rows], ms[:rows], mq.shape, True),
+        Quantized8(vc[:rows], vs[:rows], vq.shape, False),
+        delta[:rows],
+    )
+
+
+def _adam8_update_jnp(g_blocks, mq, vq, scalars, b1, b2):
+    lr, bc1, bc2, eps = scalars[0], scalars[1], scalars[2], scalars[3]
+    m = _dequant_block_math(mq.codes, mq.scales)
+    v = _dequant_block_math(vq.codes, vq.scales)
+    m_new, v_new, delta = _adam8_block_math(
+        g_blocks, m, v, lr, b1, b2, eps, bc1, bc2
+    )
+    mc, ms = _quant_block_math(m_new, signed=True)
+    vc, vs = _quant_block_math(v_new, signed=False)
+    return (
+        Quantized8(mc, ms, mq.shape, True),
+        Quantized8(vc, vs, vq.shape, False),
+        delta,
+    )
+
+
+class Adam8State(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Updates  # pytree of Quantized8
+    nu: optax.Updates  # pytree of Quantized8
+
+
+def adamw_8bit(
+    learning_rate: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    min_quantized_size: int = 4096,
+    use_pallas: bool | None = None,
+) -> optax.GradientTransformation:
+    """AdamW whose moments live in int8 — 4x less optimizer-state HBM
+    than fp32 Adam (the FSDP/ZeRO memory ceiling on big models).
+
+    Tensors smaller than ``min_quantized_size`` keep fp32 moments (the
+    reference does the same for small params, where block stats are
+    noisy and savings negligible).
+    """
+
+    def _pallas_enabled():
+        if use_pallas is not None:
+            return use_pallas
+        return jax.default_backend() == "tpu"
+
+    def init_fn(params):
+        def _init_m(p):
+            if p.size < min_quantized_size:
+                return jnp.zeros_like(p, jnp.float32)
+            return quantize_8bit(jnp.zeros_like(p, jnp.float32), True)
+
+        def _init_v(p):
+            if p.size < min_quantized_size:
+                return jnp.zeros_like(p, jnp.float32)
+            return quantize_8bit(jnp.zeros_like(p, jnp.float32), False)
+
+        return Adam8State(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(_init_m, params),
+            nu=jax.tree.map(_init_v, params),
+        )
+
+    def update_fn(grads, state, params=None):
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+        bc1 = 1.0 - b1**cf
+        bc2 = 1.0 - b2**cf
+        scalars = jnp.stack(
+            [jnp.asarray(learning_rate, jnp.float32), bc1, bc2, eps]
+        )
+
+        is_q = lambda x: isinstance(x, Quantized8)  # noqa: E731
+
+        def _one(g, m, v):
+            if not is_q(m):
+                # small tensor: plain fp32 adam
+                m_new = b1 * m + (1.0 - b1) * g
+                v_new = b2 * v + (1.0 - b2) * g * g
+                delta = (
+                    -learning_rate
+                    * (m_new / bc1)
+                    / (jnp.sqrt(v_new / bc2) + eps)
+                )
+                return delta.astype(g.dtype), m_new, v_new
+            g_blocks = _to_blocks(g.astype(jnp.float32))
+            if _pallas_enabled():
+                mq, vq, delta = _adam8_update_pallas(
+                    g_blocks, m, v, scalars, b1, b2, interpret=False
+                )
+            else:
+                mq, vq, delta = _adam8_update_jnp(
+                    g_blocks, m, v, scalars, b1, b2
+                )
+            return _from_blocks(delta, g.shape).astype(g.dtype), mq, vq
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        results = [
+            _one(g, m, v) for g, m, v in zip(flat_g, flat_m, flat_v)
+        ]
+        updates = treedef.unflatten([r[0] for r in results])
+        mu = treedef.unflatten([r[1] for r in results])
+        nu = treedef.unflatten([r[2] for r in results])
+
+        if weight_decay and params is not None:
+            updates = jax.tree.map(
+                lambda u, p: u - learning_rate * weight_decay * p,
+                updates,
+                params,
+            )
+        return updates, Adam8State(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)

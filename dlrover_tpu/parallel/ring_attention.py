@@ -119,18 +119,186 @@ def ring_attention_local(
     return out.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# kernel-backed ring: flash-attention Pallas kernel per KV hop
+# ---------------------------------------------------------------------------
+def _merge_partials(o_a, lse_a, o_b, lse_b):
+    """Online-softmax merge of two normalized partial attentions.
+
+    ``o`` [B,T,H,D] f32 normalized, ``lse`` [B,H,T] f32 log-sum-exp.
+    """
+    lse_new = jnp.logaddexp(lse_a, lse_b)
+    w_a = jnp.exp(lse_a - lse_new)  # [B,H,T]
+    w_b = jnp.exp(lse_b - lse_new)
+    to_o = lambda w: w.transpose(0, 2, 1)[..., None]  # noqa: E731
+    return o_a * to_o(w_a) + o_b * to_o(w_b), lse_new
+
+
+def _ring_fwd_scan(q, k, v, axis_name, causal, sm_scale, mask_fn):
+    from dlrover_tpu.ops.flash_attention import NEG_INF, flash_attention_fwd
+
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, j):
+        o_acc, lse_acc, kv = carry
+        k_blk, v_blk = kv
+        blk_idx = (my_idx - j) % n
+        o_j, lse_j = flash_attention_fwd(
+            q,
+            k_blk,
+            v_blk,
+            causal=causal,
+            sm_scale=sm_scale,
+            mask_fn=mask_fn,
+            q_offset=my_idx * T,
+            k_offset=blk_idx * T,
+        )
+        o_new, lse_new = _merge_partials(
+            o_acc, lse_acc, o_j.astype(jnp.float32), lse_j
+        )
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, lse_new, (k_nxt, v_nxt)), None
+
+    o0 = jnp.zeros((B, T, H, D), jnp.float32)
+    lse0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
+    (o, lse, _), _ = lax.scan(step, (o0, lse0, (k, v)), jnp.arange(n))
+    return o.astype(q.dtype), lse
+
+
+def _make_ring_flash(axis_name, causal, sm_scale, mask_fn):
+    """Build the custom-vjp kernel ring for one static config.
+
+    Forward: one flash kernel call per KV hop, partials merged with the
+    online-softmax rule. Backward: a second ring pass — ``dq``
+    accumulates locally; ``dk``/``dv`` partials travel *with* their KV
+    block (rotated by the same ppermute), so after n hops each device
+    holds the complete gradient of its own KV shard. The kernel's
+    ``p = exp(s - lse_global)`` recomputation makes every per-hop
+    contribution exact.
+    """
+    from dlrover_tpu.ops.flash_attention import flash_attention_bwd
+
+    @jax.custom_vjp
+    def ring_flash(q, k, v):
+        o, _ = _ring_fwd_scan(
+            q, k, v, axis_name, causal, sm_scale, mask_fn
+        )
+        return o
+
+    def fwd(q, k, v):
+        o, lse = _ring_fwd_scan(
+            q, k, v, axis_name, causal, sm_scale, mask_fn
+        )
+        return o, (q, k, v, o, lse)
+
+    def bwd(res, do):
+        q, k, v, o, lse = res
+        n = lax.psum(1, axis_name)
+        my_idx = lax.axis_index(axis_name)
+        T = q.shape[1]
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def step(carry, j):
+            dq_acc, kv, dkv = carry
+            k_blk, v_blk = kv
+            dk_acc, dv_acc = dkv
+            blk_idx = (my_idx - j) % n
+            dq_j, dk_j, dv_j = flash_attention_bwd(
+                q,
+                k_blk,
+                v_blk,
+                o,
+                lse,
+                do,
+                causal=causal,
+                sm_scale=sm_scale,
+                mask_fn=mask_fn,
+                q_offset=my_idx * T,
+                k_offset=blk_idx * T,
+            )
+            dq_acc = dq_acc + dq_j.astype(jnp.float32)
+            dk_acc = dk_acc + dk_j.astype(jnp.float32)
+            dv_acc = dv_acc + dv_j.astype(jnp.float32)
+            # dk/dv ride along with their kv block around the ring
+            k_nxt = lax.ppermute(k_blk, axis_name, perm)
+            v_nxt = lax.ppermute(v_blk, axis_name, perm)
+            dk_nxt = lax.ppermute(dk_acc, axis_name, perm)
+            dv_nxt = lax.ppermute(dv_acc, axis_name, perm)
+            return (dq_acc, (k_nxt, v_nxt), (dk_nxt, dv_nxt)), None
+
+        dq0 = jnp.zeros(q.shape, jnp.float32)
+        dkv0 = (
+            jnp.zeros(k.shape, jnp.float32),
+            jnp.zeros(v.shape, jnp.float32),
+        )
+        (dq, _, (dk, dv)), _ = lax.scan(
+            step, (dq0, (k, v), dkv0), jnp.arange(n)
+        )
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    ring_flash.defvjp(fwd, bwd)
+    return ring_flash
+
+
+def ring_flash_attention_local(
+    q,
+    k,
+    v,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    mask_fn: Optional[MaskFn] = None,
+):
+    """Kernel-backed per-device ring body (call inside ``shard_map``).
+
+    Same contract as ``ring_attention_local`` but each hop's block math
+    runs in the Pallas flash-attention kernel (ops/flash_attention.py);
+    GQA KV stays unexpanded all the way through the ring (H_kv heads on
+    the wire instead of H).
+    """
+    # built per call: the custom_vjp wrapper is cheap to construct, and
+    # callers jit the enclosing step, so trace caching happens above us
+    # (an identity-keyed cache here would leak mask_fn closures)
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    fn = _make_ring_flash(axis_name, causal, scale, mask_fn)
+    return fn(q, k, v)
+
+
 def ring_self_attention(
-    q, k, v, mesh, *, causal: bool = True, mask_fn: Optional[MaskFn] = None
+    q,
+    k,
+    v,
+    mesh,
+    *,
+    causal: bool = True,
+    mask_fn: Optional[MaskFn] = None,
+    use_kernel: Optional[bool] = None,
 ):
     """Global-view wrapper: shards [B,S,H,D] over the mesh and runs the
-    ring. Inputs may be any layout; outputs match q's sharding."""
+    ring. Inputs may be any layout; outputs match q's sharding.
+
+    ``use_kernel=None`` auto-picks the Pallas-kernel ring on TPU and the
+    jnp ring elsewhere (kernels run under the slow interpreter off-TPU).
+    """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    if use_kernel is None:
+        use_kernel = jax.default_backend() == "tpu"
     spec = P(("dp", "fsdp"), "sp", "tp", None)
-    fn = functools.partial(
-        ring_attention_local, causal=causal, mask_fn=mask_fn
-    )
+    if use_kernel:
+        fn = functools.partial(
+            ring_flash_attention_local, causal=causal, mask_fn=mask_fn
+        )
+    else:
+        fn = functools.partial(
+            ring_attention_local, causal=causal, mask_fn=mask_fn
+        )
     return shard_map(
         fn,
         mesh=mesh,

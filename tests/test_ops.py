@@ -1,0 +1,327 @@
+"""Ops layer numerics: Pallas flash attention (interpret mode), kernel
+ring attention, AGD/WSAM, 8-bit AdamW."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.ops import (
+    adamw_8bit,
+    agd,
+    dequantize_8bit,
+    flash_attention,
+    make_wsam_grad_fn,
+    quantize_8bit,
+)
+from dlrover_tpu.ops.flash_attention import (
+    flash_attention_bwd,
+    flash_attention_fwd,
+    flash_attention_reference,
+)
+from dlrover_tpu.ops.optimizers import apply_wsam_sharpness
+from dlrover_tpu.ops.quantized_optim import (
+    _adam8_update_jnp,
+    _adam8_update_pallas,
+    _to_blocks,
+)
+
+
+def _qkv(B=2, T=128, H=4, Hkv=4, D=32, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), dtype)
+    k = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), dtype)
+    v = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), dtype)
+    return q, k, v
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_matches_reference(self, causal):
+        q, k, v = _qkv()
+        ref = flash_attention_reference(q, k, v, causal=causal)
+        out = flash_attention(
+            q, k, v, causal=causal, force="pallas", block_q=64, block_k=64
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_gqa(self):
+        q, k, v = _qkv(H=8, Hkv=2)
+        ref = flash_attention_reference(q, k, v)
+        out = flash_attention(q, k, v, force="pallas", block_q=64)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_custom_mask(self):
+        # sliding-window mask (positions within 32 of the query)
+        win = lambda qp, kp: (qp >= kp) & (qp - kp < 32)  # noqa: E731
+        q, k, v = _qkv()
+        ref = flash_attention_reference(q, k, v, causal=True, mask_fn=win)
+        out = flash_attention(
+            q, k, v, causal=True, mask_fn=win, force="pallas", block_q=64
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_grads_match_reference(self):
+        q, k, v = _qkv(T=128, H=4, Hkv=2)
+
+        def lp(q, k, v):
+            return (
+                flash_attention(q, k, v, force="pallas", block_q=64) ** 2
+            ).sum()
+
+        def lr(q, k, v):
+            return (flash_attention_reference(q, k, v) ** 2).sum()
+
+        gp = jax.grad(lp, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(a, b, atol=5e-4)
+
+    def test_offsets_shift_causal_mask(self):
+        # kernel with k_offset sees keys as "earlier" -> full visibility
+        q, k, v = _qkv(T=64)
+        o1, lse1 = flash_attention_fwd(
+            q, k, v, causal=True, q_offset=64, k_offset=0, block_q=64
+        )
+        ref = flash_attention_reference(
+            q, k, v, causal=True, q_offset=64, k_offset=0
+        )
+        np.testing.assert_allclose(o1, ref, atol=2e-5)
+        # and bwd runs with the same offsets
+        do = jnp.ones_like(o1)
+        dq, dk, dv = flash_attention_bwd(
+            q, k, v, o1, lse1, do, causal=True, q_offset=64, k_offset=0
+        )
+        assert dq.shape == q.shape and dk.shape == k.shape
+
+    def test_fully_masked_rows_zero_grads(self):
+        # rows whose every key is masked must get zero output AND zero
+        # gradient through the pallas backward (regression: p=exp(s-lse)
+        # was 1, not 0, when lse==NEG_INF)
+        blind = lambda qp, kp: (qp >= kp) & (qp >= 64)  # noqa: E731
+        q, k, v = _qkv(T=128)
+
+        def lp(q, k, v):
+            return (
+                flash_attention(
+                    q, k, v, mask_fn=blind, force="pallas", block_q=64
+                )
+                ** 2
+            ).sum()
+
+        def lr(q, k, v):
+            return (
+                flash_attention_reference(q, k, v, mask_fn=blind) ** 2
+            ).sum()
+
+        out = flash_attention(
+            q, k, v, mask_fn=blind, force="pallas", block_q=64
+        )
+        assert float(jnp.abs(out[:, :64]).max()) == 0.0
+        gp = jax.grad(lp, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+        assert float(jnp.abs(gp[0][:, :64]).max()) == 0.0
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(a, b, atol=5e-4)
+
+    def test_odd_length_falls_back(self):
+        q, k, v = _qkv(T=100)  # 100 doesn't tile into 64/128 blocks
+        out = flash_attention(q, k, v)  # auto mode: should not raise
+        ref = flash_attention_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+class TestKernelRing:
+    def test_ring_kernel_matches_reference(self, sp_mesh):
+        from dlrover_tpu.parallel.ring_attention import ring_self_attention
+
+        q, k, v = _qkv(T=256, H=4, Hkv=2)
+        ref = flash_attention_reference(q, k, v, causal=True)
+        out = ring_self_attention(
+            q, k, v, sp_mesh, causal=True, use_kernel=True
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_ring_kernel_grads(self, sp_mesh):
+        from dlrover_tpu.parallel.ring_attention import ring_self_attention
+
+        q, k, v = _qkv(T=256, H=4, Hkv=2)
+
+        def lk(q, k, v):
+            return (
+                ring_self_attention(
+                    q, k, v, sp_mesh, causal=True, use_kernel=True
+                )
+                ** 2
+            ).sum()
+
+        def lr(q, k, v):
+            return (flash_attention_reference(q, k, v) ** 2).sum()
+
+        gk = jax.grad(lk, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(a, b, atol=1e-3)
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    return build_mesh(MeshConfig(sp=4, dp=2))
+
+
+class TestAGD:
+    def test_converges_on_quadratic(self):
+        params = {"w": jnp.full((64,), 5.0)}
+        tx = agd(1e-1)
+        st = tx.init(params)
+
+        def loss(p):
+            return jnp.sum(p["w"] ** 2)
+
+        for _ in range(200):
+            g = jax.grad(loss)(params)
+            u, st = tx.update(g, st, params)
+            params = optax.apply_updates(params, u)
+        assert float(loss(params)) < 1e-3
+
+    def test_weight_decay_and_clip(self):
+        params = {"w": jnp.full((8,), 2.0)}
+        tx = agd(1e-2, weight_decay=0.1, clip=1.0)
+        st = tx.init(params)
+        g = {"w": jnp.full((8,), 1e6)}  # huge grad: clip caps the update
+        u, st = tx.update(g, st, params)
+        # |update| <= lr_adjust*clip + lr*wd*|p|
+        assert float(jnp.abs(u["w"]).max()) < 1.0
+
+    def test_amsgrad_state(self):
+        params = {"w": jnp.zeros((4,))}
+        tx = agd(1e-3, amsgrad=True)
+        st = tx.init(params)
+        assert st.max_exp_avg_sq is not None
+        u, st2 = tx.update({"w": jnp.ones((4,))}, st, params)
+        assert float(st2.max_exp_avg_sq["w"].max()) >= 0.0
+
+
+class TestWSAM:
+    def _grad_fn(self, p, _batch):
+        loss = jnp.sum((p["w"] - 1.0) ** 2)
+        return loss, jax.grad(lambda q: jnp.sum((q["w"] - 1.0) ** 2))(p)
+
+    def test_decoupled_converges(self):
+        wg = make_wsam_grad_fn(self._grad_fn, rho=0.05, decouple=True)
+        p = {"w": jnp.full((16,), 3.0)}
+        tx = optax.sgd(1e-1)
+        st = tx.init(p)
+        for _ in range(100):
+            loss, g, sh = wg(p, None)
+            u, st = tx.update(g, st, p)
+            u = apply_wsam_sharpness(u, sh, 1e-1)
+            p = optax.apply_updates(p, u)
+        assert float(loss) < 1e-2
+
+    def test_blended_converges(self):
+        wg = make_wsam_grad_fn(self._grad_fn, rho=0.05, decouple=False)
+        p = {"w": jnp.full((16,), 3.0)}
+        tx = optax.sgd(1e-1)
+        st = tx.init(p)
+        for _ in range(100):
+            loss, g, sh = wg(p, None)
+            assert float(jnp.abs(sh["w"]).max()) == 0.0  # zero tree
+            u, st = tx.update(g, st, p)
+            p = optax.apply_updates(p, u)
+        assert float(loss) < 1e-2
+
+
+class TestQuantizedOptim:
+    def test_quant_roundtrip(self):
+        x = jnp.asarray(
+            np.random.default_rng(0).normal(size=(1000,)), jnp.float32
+        )
+        q = quantize_8bit(x, signed=True)
+        err = float(
+            jnp.abs(dequantize_8bit(q) - x).max() / jnp.abs(x).max()
+        )
+        assert err < 0.02
+
+    def test_tracks_fp32_adam(self):
+        p8 = {
+            "w": jnp.asarray(
+                np.random.default_rng(1).normal(size=(8192,)), jnp.float32
+            )
+        }
+        pf = jax.tree.map(lambda x: x, p8)
+        tx8, txf = adamw_8bit(1e-2), optax.adamw(1e-2)
+        s8, sf = tx8.init(p8), txf.init(pf)
+
+        def loss(p):
+            return jnp.sum((p["w"] - 1.0) ** 2)
+
+        for _ in range(100):
+            u8, s8 = tx8.update(jax.grad(loss)(p8), s8, p8)
+            p8 = optax.apply_updates(p8, u8)
+            uf, sf = txf.update(jax.grad(loss)(pf), sf, pf)
+            pf = optax.apply_updates(pf, uf)
+        # trajectories stay close despite 8-bit moments
+        assert float(jnp.abs(p8["w"] - pf["w"]).max()) < 0.2
+        assert float(loss(p8)) < 2.0 * float(loss(pf)) + 1.0
+
+    def test_small_params_stay_fp32(self):
+        p = {"small": jnp.zeros((16,)), "big": jnp.zeros((8192,))}
+        tx = adamw_8bit(1e-3, min_quantized_size=4096)
+        st = tx.init(p)
+        assert isinstance(st.mu["small"], jnp.ndarray)
+        assert not isinstance(st.mu["big"], jnp.ndarray)
+
+    def test_pallas_matches_jnp_path(self):
+        rng = np.random.default_rng(2)
+        g = _to_blocks(
+            jnp.asarray(rng.normal(size=(4096,)), jnp.float32)
+        )
+        mq = quantize_8bit(
+            jnp.asarray(rng.normal(size=(4096,)) * 0.01, jnp.float32), True
+        )
+        vq = quantize_8bit(
+            jnp.asarray(
+                np.abs(rng.normal(size=(4096,))) * 1e-3, jnp.float32
+            ),
+            False,
+        )
+        sc = jnp.stack(
+            [
+                jnp.float32(1e-2),
+                jnp.float32(0.9),
+                jnp.float32(0.99),
+                jnp.float32(1e-8),
+            ]
+        )
+        a = _adam8_update_pallas(g, mq, vq, sc, 0.9, 0.999, interpret=True)
+        b = _adam8_update_jnp(g, mq, vq, sc, 0.9, 0.999)
+        assert bool((a[0].codes == b[0].codes).all())
+        assert bool((a[1].codes == b[1].codes).all())
+        np.testing.assert_allclose(a[2], b[2], atol=1e-7)
+
+    def test_update_is_jittable(self):
+        p = {"w": jnp.zeros((8192,))}
+        tx = adamw_8bit(1e-3)
+        st = tx.init(p)
+
+        @jax.jit
+        def step(g, st, p):
+            return tx.update(g, st, p)
+
+        u, st2 = step({"w": jnp.ones((8192,))}, st, p)
+        assert u["w"].shape == (8192,)
+
+
+class TestModelUsesFlash:
+    def test_transformer_attention_dispatches(self):
+        # _causal_attention now routes through ops.flash_attention
+        from dlrover_tpu.models.transformer import _causal_attention
+
+        q, k, v = _qkv(T=64)
+        out = _causal_attention(q, k, v)
+        ref = flash_attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5)

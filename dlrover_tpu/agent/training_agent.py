@@ -330,6 +330,15 @@ class ElasticTrainingAgent:
             except Exception as e:
                 logger.warning(f"save-at-breakpoint failed: {e!r}")
         self._stop_workers()
+        # a worker killed mid-staging leaves its shm shard lock held;
+        # release orphaned locks before the new generation starts saving
+        # (parity: reset_shared_memory ckpt_saver.py:527)
+        try:
+            from dlrover_tpu.ckpt.saver import AsyncCheckpointSaver
+
+            AsyncCheckpointSaver.reset_shared_memory_if_any()
+        except Exception as e:
+            logger.warning(f"shard-lock reset failed: {e!r}")
         if count_restart:
             self._restart_count += 1
         else:

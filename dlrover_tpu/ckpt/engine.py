@@ -207,16 +207,27 @@ class CheckpointEngine:
     def load(
         self, target: Any, checkpoint_dir: str
     ) -> Tuple[int, Optional[Any]]:
-        """Restore ``target``-shaped state. Prefers shm when it holds a step
-        at least as new as the committed one (fast elastic-restart path,
-        engine.py:315), else reads the committed step from storage."""
+        """Restore ``target``-shaped state. Prefers shm when *every*
+        process holds the same usable step at least as new as the committed
+        one (fast elastic-restart path, engine.py:315), else reads the
+        committed step from storage.
+
+        The cross-process agreement mirrors the reference's
+        ``verify_all_rank_step_consistent`` (engine.py:318): because
+        ``save_to_memory`` skips per-host when the shard lock is busy,
+        hosts can hold *different* shm steps after an elastic restart —
+        restoring them as-is would silently diverge the replicas. Every
+        process must call ``load`` (it's the restart path), so the
+        allgather below cannot deadlock."""
         committed = self.latest_step(checkpoint_dir)
+        # propose this host's usable shm step (-1 = none). The shard lock
+        # guards against reading shm mid-rewrite by an in-flight
+        # block=False staging thread or the persisting saver; a lock
+        # timeout just downgrades the proposal to -1.
+        candidate = -1
+        records = []
+        got_lock = False
         if self._agent_mode and self._shm is not None:
-            # take the shard lock so we never read shm mid-rewrite by an
-            # in-flight block=False staging thread or while the saver is
-            # persisting; if we can't get it in time, storage is the safe
-            # source
-            got_lock = False
             try:
                 got_lock = self._lock.acquire(blocking=True)
             except (TimeoutError, RuntimeError):
@@ -227,25 +238,65 @@ class CheckpointEngine:
                     if shm_step >= committed and self._shm_covers(
                         records, target
                     ):
-                        by_path: Dict[str, list] = {}
-                        for r in records:
-                            by_path.setdefault(r.path, []).append(r)
-                        state = restore_state(
-                            target, lambda p: by_path.get(p, [])
-                        )
-                        logger.info(
-                            f"restored step {shm_step} from memory"
-                        )
-                        return shm_step, state
+                        candidate = shm_step
                 except (LookupError, ValueError):
-                    pass
-                finally:
-                    self._lock.force_release()
+                    candidate = -1
+        try:
+            # every process reaches this collective exactly once per load,
+            # whatever its agent/lock state — a host that failed to read
+            # shm proposes -1 rather than skipping the allgather (which
+            # would deadlock the others)
+            agreed = self._all_processes_agree(candidate)
+            if agreed and candidate >= 0:
+                by_path: Dict[str, list] = {}
+                for r in records:
+                    by_path.setdefault(r.path, []).append(r)
+                try:
+                    state = restore_state(
+                        target, lambda p: by_path.get(p, [])
+                    )
+                    logger.info(f"restored step {candidate} from memory")
+                    return candidate, state
+                except (LookupError, ValueError) as e:
+                    logger.warning(
+                        f"shm restore of step {candidate} failed ({e!r}); "
+                        f"falling back to storage"
+                    )
+            elif candidate >= 0:
+                logger.warning(
+                    f"shm holds step {candidate} but processes disagree; "
+                    f"falling back to committed step {committed}"
+                )
+        finally:
+            if got_lock:
+                self._lock.force_release()
         if committed < 0:
             return -1, None
         return committed, self._load_from_storage(
             target, checkpoint_dir, committed
         )
+
+    def _all_processes_agree(self, candidate: int) -> bool:
+        """True iff every JAX process proposes the same shm step. Uses a
+        host allgather when ``jax.distributed`` is up; single-process (or
+        uninitialized) trivially agrees with itself."""
+        try:
+            import jax
+
+            if jax.process_count() <= 1:
+                return True
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            steps = multihost_utils.process_allgather(
+                np.asarray([candidate], np.int64)
+            )
+            return len({int(s) for s in np.ravel(steps)}) == 1
+        except Exception as e:
+            # no distributed runtime: be conservative only when we know
+            # there are peers we could not reach
+            logger.warning(f"shm step agreement check unavailable: {e!r}")
+            return self.global_shard_num <= 1
 
     def _shm_covers(self, records, target) -> bool:
         """shm restore is only safe when this process's target shards match

@@ -313,7 +313,16 @@ class AsyncCheckpointSaver:
             now = time.time()
             if isinstance(ev, SaveEvent):
                 if ev.step <= self._persisted_step:
-                    continue  # stale event (e.g. replayed across a restart)
+                    # stale event (e.g. a straggler shard arriving after a
+                    # timeout-triggered partial persist) — the trainer
+                    # staged under the shard lock and left it held;
+                    # discarding without releasing would mark that rank
+                    # "saver busy" forever. Only release when the rank's
+                    # shm still holds exactly this step: a newer shm step
+                    # means the lock was already recycled and may be held
+                    # by a *live* staging we must not break.
+                    self._release_if_shm_step(ev.local_rank, ev.step)
+                    continue
                 st = self._steps.setdefault(ev.step, _StepState())
                 st.checkpoint_dir = ev.checkpoint_dir
                 st.global_shard_num = ev.global_shard_num
@@ -473,3 +482,58 @@ class AsyncCheckpointSaver:
         saver = cls.get_saver()
         if saver is not None:
             saver.save_shm_to_storage()
+
+    def _release_if_shm_step(self, local_rank: int, step: int):
+        """Free ``local_rank``'s shard lock iff its shm still holds exactly
+        ``step`` (i.e. the lock belongs to that completed, now-obsolete
+        staging and nothing newer has recycled it)."""
+        try:
+            handler = self._shm_handlers[local_rank]
+            if handler.no_checkpoint():
+                return
+            shm_step = int(handler.metadata().get("step", -1))
+            if shm_step == step:
+                self._shard_locks[local_rank].force_release()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # worker-restart reset
+    # ------------------------------------------------------------------
+    def reset_shared_memory(self):
+        """Release shard locks orphaned by dead workers.
+
+        Parity: ckpt_saver.py:527 ``reset_shared_memory``. A trainer
+        killed mid-staging leaves its shard lock held; without this, every
+        save after the restart returns False ('saver busy') forever. The
+        agent calls this on its worker-restart path, after the workers are
+        stopped and ``save_shm_to_storage`` has persisted anything staged.
+
+        Holding ``_persist_mutex`` (not just probing it) makes this safe
+        against an in-flight persist: we wait for it to finish rather than
+        yanking locks from under ``_save_shard``'s shm reads, and ranks it
+        didn't cover still get their orphaned locks released afterwards.
+        The old generation's queued SaveEvents are purged first so the
+        event loop cannot later force-release a lock the *new* generation
+        holds."""
+        purged = 0
+        try:
+            while True:
+                self._event_queue.get(timeout=0.01)
+                purged += 1
+        except Exception:
+            pass
+        if purged:
+            logger.info(f"purged {purged} stale checkpoint events")
+        with self._persist_mutex:
+            for lk in self._shard_locks:
+                try:
+                    lk.force_release()
+                except Exception:
+                    pass
+
+    @classmethod
+    def reset_shared_memory_if_any(cls):
+        saver = cls.get_saver()
+        if saver is not None:
+            saver.reset_shared_memory()

@@ -64,16 +64,33 @@ def state_shardings(
     )
     opt_shape = jax.eval_shape(lambda: tx.init(_zeros_like_tree(params_shape)))
 
-    flat_p, treedef_p = jax.tree_util.tree_flatten(p_sh)
-    shape_leaves = jax.tree_util.tree_leaves(params_shape)
-    by_shape = {}
-    for sh, leaf in zip(flat_p, shape_leaves):
-        by_shape.setdefault((leaf.shape, leaf.dtype), sh)
+    # Optimizer moments mirror the param tree, so an opt-state leaf's tree
+    # path *ends with* its param's full path (e.g. inner_state[0].mu
+    # ['layers'][3]['attn']['wq']). Match structurally on the path suffix
+    # (shape-checked) rather than by (shape, dtype) — two same-shaped,
+    # differently-sharded params (square w_up/w_down) must not alias.
+    def _path_key(path):
+        return tuple(str(k) for k in path)
 
-    def opt_leaf_sharding(leaf):
-        return by_shape.get((leaf.shape, leaf.dtype), replicated)
+    param_shapes = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params_shape)[0]:
+        param_shapes[_path_key(path)] = leaf.shape
+    sh_by_path = {}
+    for path, sh in jax.tree_util.tree_flatten_with_path(p_sh)[0]:
+        sh_by_path[_path_key(path)] = sh
 
-    opt_sh = jax.tree_util.tree_map(opt_leaf_sharding, opt_shape)
+    def opt_leaf_sharding(path, leaf):
+        key = _path_key(path)
+        for start in range(len(key)):
+            suffix = key[start:]
+            # shape-checked but deliberately not dtype-checked: moments in
+            # a different precision (mu_dtype=bf16) still shard with their
+            # param
+            if param_shapes.get(suffix) == leaf.shape:
+                return sh_by_path[suffix]
+        return replicated
+
+    opt_sh = jax.tree_util.tree_map_with_path(opt_leaf_sharding, opt_shape)
     return TrainState(step=replicated, params=p_sh, opt_state=opt_sh)
 
 

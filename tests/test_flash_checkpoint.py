@@ -182,3 +182,76 @@ class TestCheckpointerNoAgent:
         assert step == 4
         np.testing.assert_array_equal(restored["w"], np.arange(6.0))
         assert restored["n"] == 2
+
+
+class TestAdviceFixes:
+    """Regressions for the round-1 advisor findings (ADVICE.md)."""
+
+    def test_stale_event_releases_shard_lock(self, saver, tmp_path):
+        # a SaveEvent at/below the persisted step must release the shard
+        # lock the trainer left held, or every later save reports busy
+        from dlrover_tpu.ckpt.saver import SaveEvent
+
+        saver._persisted_step = 50
+        eng = CheckpointEngine()
+        assert eng._agent_mode
+        assert eng._lock.acquire(blocking=False)  # trainer holds the lock
+        # the straggler actually staged step 50 before its event arrived;
+        # the release guard checks shm still holds exactly that step
+        eng._shm.save_records(
+            50,
+            host_shard_records({"w": jnp.arange(4.0)}),
+            {"checkpoint_dir": str(tmp_path)},
+        )
+        eng._queue.put(
+            SaveEvent(
+                step=50,
+                checkpoint_dir=str(tmp_path),
+                local_rank=0,
+                global_shard_id=0,
+                global_shard_num=1,
+            )
+        )
+        deadline = time.time() + 10
+        released = False
+        while time.time() < deadline:
+            if eng._lock.acquire(blocking=False):
+                released = True
+                eng._lock.force_release()
+                break
+            time.sleep(0.1)
+        assert released, "stale event did not release the shard lock"
+
+    def test_reset_shared_memory_frees_orphaned_locks(self, saver):
+        eng = CheckpointEngine()
+        assert eng._lock.acquire(blocking=False)
+        # dead worker: lock held, no persist in flight
+        saver.reset_shared_memory()
+        assert eng._lock.acquire(blocking=False)
+        eng._lock.force_release()
+
+    def test_step_agreement_single_process(self, saver):
+        eng = CheckpointEngine()
+        assert eng._all_processes_agree(42) is True
+
+    def test_step_agreement_disagreement_falls_back(
+        self, saver, tmp_path, monkeypatch
+    ):
+        # simulate two processes proposing different shm steps: the load
+        # must come from committed storage, not shm
+        eng = CheckpointEngine()
+        state = {"w": jnp.arange(8.0)}
+        assert eng.save_to_storage(3, state, str(tmp_path))
+        newer = {"w": jnp.arange(8.0) + 100.0}
+        assert eng.save_to_memory(7, newer, str(tmp_path))
+        # wait until the saver persisted step 7 and released the lock,
+        # then re-stage step 9 in shm only (not persisted)
+        deadline = time.time() + 10
+        while time.time() < deadline and eng.latest_step(str(tmp_path)) < 7:
+            time.sleep(0.1)
+        monkeypatch.setattr(
+            eng, "_all_processes_agree", lambda candidate: False
+        )
+        step, restored = eng.load({"w": jnp.zeros(8)}, str(tmp_path))
+        assert step == eng.latest_step(str(tmp_path))
+        np.testing.assert_allclose(restored["w"], newer["w"])

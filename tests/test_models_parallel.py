@@ -212,3 +212,43 @@ class TestModelParallelism:
         assert logits.shape == (2, 32, cfg.vocab_size)
         assert "lm_head" not in params
         assert "positions" in params["embed"]
+
+
+class TestOptStateShardings:
+    def test_square_mlp_moments_inherit_param_sharding(self):
+        """w_up (d,f) and w_down (f,d) with d == f have identical
+        (shape, dtype): a shape-keyed lookup would alias their optimizer
+        moments to one sharding. The structural path match must give each
+        moment exactly its param's sharding."""
+        import optax
+        from dlrover_tpu.models import tiny
+        from dlrover_tpu.models.train import state_shardings
+        from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+        cfg = tiny(mlp_dim=32)  # mlp_dim == model_dim -> square w_up/w_down
+        mesh = build_mesh(MeshConfig(fsdp=2, tp=2, dp=2))
+        tx = optax.adamw(1e-3)
+        sh = state_shardings(cfg, mesh, tx)
+
+        flat_p = {
+            tuple(str(k) for k in path): s
+            for path, s in jax.tree_util.tree_flatten_with_path(sh.params)[0]
+        }
+        opt_flat = jax.tree_util.tree_flatten_with_path(sh.opt_state)[0]
+        moment_leaves = [
+            (path, s)
+            for path, s in opt_flat
+            if any(".mu" in str(k) or ".nu" in str(k) for k in path)
+        ]
+        assert moment_leaves, "expected adam mu/nu leaves"
+        checked = 0
+        for path, s in moment_leaves:
+            key = tuple(str(k) for k in path)
+            for start in range(len(key)):
+                if key[start:] in flat_p:
+                    assert s == flat_p[key[start:]], (
+                        f"moment {key} sharded {s}, param {flat_p[key[start:]]}"
+                    )
+                    checked += 1
+                    break
+        assert checked == 2 * len(flat_p)

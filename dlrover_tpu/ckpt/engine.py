@@ -65,6 +65,7 @@ class CheckpointEngine:
         self._shm: Optional[ShmHandler] = None
         self._queue: Optional[SharedQueue] = None
         self._lock: Optional[SharedLock] = None
+        self._staging_threads: list = []
         if self._agent_mode:
             self._shm = ShmHandler(self.local_rank, create=False)
             self._queue = SharedQueue(saver_mod.CKPT_EVENT_QUEUE)
@@ -116,8 +117,38 @@ class CheckpointEngine:
                 name=f"ckpt-stage-{step}",
                 daemon=True,
             )
+            self._staging_threads = [
+                th for th in self._staging_threads if th.is_alive()
+            ] + [t]
             t.start()
         return True
+
+    def wait_staging(self, timeout: float = 60.0):
+        """Join in-flight ``block=False`` staging threads. Call before
+        process exit: a daemon thread doing D2H against a runtime that is
+        tearing down aborts the process (observed as rc=134)."""
+        deadline = time.time() + timeout
+        for t in self._staging_threads:
+            t.join(timeout=max(0.0, deadline - time.time()))
+        self._staging_threads = [
+            t for t in self._staging_threads if t.is_alive()
+        ]
+
+    def close(self, timeout: float = 60.0):
+        """Drain staging threads and drop IPC clients."""
+        self.wait_staging(timeout)
+        for attr in ("_queue", "_lock"):
+            obj = getattr(self, attr)
+            if obj is not None:
+                try:
+                    obj.close()
+                except Exception:
+                    pass
+        if self._shm is not None:
+            try:
+                self._shm.close(unlink=False)
+            except Exception:
+                pass
 
     def _stage_and_notify(
         self, step: int, state: Any, checkpoint_dir: str, sync: bool

@@ -123,19 +123,15 @@ def _model_flops_per_step(cfg, batch: int, seq: int, n_params: int) -> float:
 
 
 def run_goodput(jax, results: dict) -> bool:
-    import jax.numpy as jnp
     import optax
 
     from dlrover_tpu.ckpt.engine import CheckpointEngine
     from dlrover_tpu.ckpt.saver import AsyncCheckpointSaver
     from dlrover_tpu.models import (
-        TrainState,
         build_train_step,
-        init_params,
         init_sharded_state,
         shard_batch,
     )
-    from dlrover_tpu.models.train import state_shardings
     from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
 
     on_accel = jax.devices()[0].platform != "cpu"
@@ -167,6 +163,28 @@ def run_goodput(jax, results: dict) -> bool:
     AsyncCheckpointSaver.reset()
     AsyncCheckpointSaver.start_async_saving_ckpt(local_shard_num=1)
     engine = CheckpointEngine()
+
+    try:
+        return _goodput_body(
+            jax, results, engine, ckpt_dir, cfg, model_name, mesh, tx,
+            state, step_fn, data, batch, seq, bw, on_accel, n_dev,
+        )
+    finally:
+        # clean shutdown on EVERY path: join staging threads BEFORE the
+        # runtime can start tearing down (a daemon thread mid-D2H at exit
+        # aborts with rc=134), then close the saver (drains + unlinks shm)
+        engine.close()
+        AsyncCheckpointSaver.reset()
+
+
+def _goodput_body(
+    jax, results, engine, ckpt_dir, cfg, model_name, mesh, tx,
+    state, step_fn, data, batch, seq, bw, on_accel, n_dev,
+) -> bool:
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models import TrainState, init_params
+    from dlrover_tpu.models.train import state_shardings
 
     # restore template: sharded zeros, precompiled (a restarted worker
     # compiles this during normal bring-up, before it loads)
@@ -241,19 +259,13 @@ def run_goodput(jax, results: dict) -> bool:
             template = make_template()
             step0, state = engine.load(template, ckpt_dir)
             if state is None or step0 < 0:
-                return False
+                return False  # cleanup runs in run_goodput's finally
             jax.block_until_ready(state.params)
             restore_s = time.perf_counter() - t0
             done = step0
 
     wall = time.perf_counter() - t_bench0
     goodput = 100.0 * step_time / wall
-
-    # clean shutdown: join staging threads BEFORE the runtime can start
-    # tearing down (a daemon thread mid-D2H at exit aborts with rc=134),
-    # then close the saver (drains + unlinks shm)
-    engine.close()
-    AsyncCheckpointSaver.reset()
 
     results.update(
         {
@@ -344,7 +356,12 @@ def main() -> int:
     results: dict = {}
     if not run_goodput(jax, results):
         print(json.dumps({"metric": "error", "value": -1}))
-        return 1
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # same bypass as the success path: even after a clean drain the
+        # tunneled runtime's teardown can abort (rc=134), which would
+        # replace rc=1 and can drop the buffered error line
+        os._exit(1)
     try:
         run_mfu(jax, results)
     except Exception as e:

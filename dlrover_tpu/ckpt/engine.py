@@ -137,18 +137,27 @@ class CheckpointEngine:
     def close(self, timeout: float = 60.0):
         """Drain staging threads and drop IPC clients."""
         self.wait_staging(timeout)
+        if self._staging_threads:
+            # a wedged thread is about to race the shm close below — make
+            # the broken shutdown visible instead of identical to a clean one
+            logger.warning(
+                "closing engine with staging threads still alive: "
+                f"{[t.name for t in self._staging_threads]}"
+            )
         for attr in ("_queue", "_lock"):
             obj = getattr(self, attr)
             if obj is not None:
                 try:
                     obj.close()
-                except Exception:
-                    pass
+                except OSError as e:
+                    # teardown race (saver side already gone) is expected;
+                    # anything else should surface
+                    logger.warning(f"{attr} close failed: {e!r}")
         if self._shm is not None:
             try:
                 self._shm.close(unlink=False)
-            except Exception:
-                pass
+            except OSError as e:
+                logger.warning(f"shm close failed: {e!r}")
 
     def _stage_and_notify(
         self, step: int, state: Any, checkpoint_dir: str, sync: bool

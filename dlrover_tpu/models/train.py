@@ -47,28 +47,21 @@ def param_shardings(cfg: TransformerConfig, mesh, rules=None):
     return apply_rules(logical_axes(cfg), rules, mesh)
 
 
-def state_shardings(
-    cfg: TransformerConfig, mesh, tx, rules=None
-) -> TrainState:
-    """Shardings for the whole TrainState; optimizer-state leaves inherit
-    their param's sharding (ZeRO: m/v shard with the param), scalars are
-    replicated."""
-    import numpy as np
+def opt_state_shardings(params_shape, p_sh, tx, mesh):
+    """Shardings for ``tx.init``'s state: each leaf inherits its param's
+    sharding (ZeRO: m/v shard with the param), scalars are replicated.
+
+    Optimizer moments mirror the param tree, so an opt-state leaf's tree
+    path *ends with* its param's full path (e.g. inner_state[0].mu
+    ['layers'][3]['attn']['wq']). Match structurally on the path suffix
+    (shape-checked) rather than by (shape, dtype) — two same-shaped,
+    differently-sharded params (square w_up/w_down) must not alias.
+    """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    p_sh = param_shardings(cfg, mesh, rules)
     replicated = NamedSharding(mesh, P())
-
-    params_shape = jax.eval_shape(
-        lambda: init_params(jax.random.PRNGKey(0), cfg)
-    )
     opt_shape = jax.eval_shape(lambda: tx.init(_zeros_like_tree(params_shape)))
 
-    # Optimizer moments mirror the param tree, so an opt-state leaf's tree
-    # path *ends with* its param's full path (e.g. inner_state[0].mu
-    # ['layers'][3]['attn']['wq']). Match structurally on the path suffix
-    # (shape-checked) rather than by (shape, dtype) — two same-shaped,
-    # differently-sharded params (square w_up/w_down) must not alias.
     def _path_key(path):
         return tuple(str(k) for k in path)
 
@@ -90,7 +83,21 @@ def state_shardings(
                 return sh_by_path[suffix]
         return replicated
 
-    opt_sh = jax.tree_util.tree_map_with_path(opt_leaf_sharding, opt_shape)
+    return jax.tree_util.tree_map_with_path(opt_leaf_sharding, opt_shape)
+
+
+def state_shardings(
+    cfg: TransformerConfig, mesh, tx, rules=None
+) -> TrainState:
+    """Shardings for the whole TrainState."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    p_sh = param_shardings(cfg, mesh, rules)
+    replicated = NamedSharding(mesh, P())
+    params_shape = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg)
+    )
+    opt_sh = opt_state_shardings(params_shape, p_sh, tx, mesh)
     return TrainState(step=replicated, params=p_sh, opt_state=opt_sh)
 
 

@@ -250,6 +250,35 @@ def _mlp_block(x, layer, cfg: TransformerConfig, mesh):
     return x + out, jnp.float32(0.0)
 
 
+def embed_tokens(params: Params, tokens: jnp.ndarray, cfg: TransformerConfig):
+    """tokens [B,T] → residual stream [B,T,D] (token + learned positions)."""
+    dt = _dtype(cfg)
+    T = tokens.shape[-1]
+    x = params["embed"]["tokens"].astype(dt)[tokens]
+    if not cfg.rope:
+        x = x + params["embed"]["positions"].astype(dt)[:T][None]
+    return x
+
+
+def lm_head(params: Params, x: jnp.ndarray, cfg: TransformerConfig):
+    """final residual [B,T,D] → logits [B,T,vocab] fp32 (incl. final norm)."""
+    dt = _dtype(cfg)
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.tie_embeddings:
+        w = params["embed"]["tokens"].astype(dt)
+        logits = jnp.einsum("btd,vd->btv", x, w)
+    else:
+        logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dt))
+    return logits.astype(jnp.float32)
+
+
+def token_nll(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token negative log-likelihood."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
 def forward(
     params: Params,
     tokens: jnp.ndarray,
@@ -257,12 +286,9 @@ def forward(
     mesh=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """tokens [B,T] int32 → (logits [B,T,vocab] fp32, moe_aux_loss)."""
-    dt = _dtype(cfg)
     B, T = tokens.shape
-    x = params["embed"]["tokens"].astype(dt)[tokens]
+    x = embed_tokens(params, tokens, cfg)
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
-    if not cfg.rope:
-        x = x + params["embed"]["positions"].astype(dt)[:T][None]
 
     aux_total = jnp.float32(0.0)
 
@@ -277,15 +303,7 @@ def forward(
         x, aux = block(x, layer)
         aux_total = aux_total + aux
 
-    x = _norm(x, params["final_norm"], cfg)
-    if cfg.tie_embeddings:
-        w = params["embed"]["tokens"].astype(dt)
-        logits = jnp.einsum("btd,vd->btv", x, w)
-    else:
-        logits = jnp.einsum(
-            "btd,dv->btv", x, params["lm_head"].astype(dt)
-        )
-    return logits.astype(jnp.float32), aux_total
+    return lm_head(params, x, cfg), aux_total
 
 
 def loss_fn(
@@ -297,6 +315,4 @@ def loss_fn(
     moe_aux_weight: float = 0.01,
 ) -> jnp.ndarray:
     logits, aux = forward(params, tokens, cfg, mesh)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll) + moe_aux_weight * aux
+    return token_nll(logits, targets) + moe_aux_weight * aux

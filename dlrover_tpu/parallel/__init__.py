@@ -19,3 +19,6 @@ from dlrover_tpu.parallel.sharding_rules import (  # noqa: F401
     apply_rules,
     logical_to_mesh_axes,
 )
+# NOTE: pipeline/ring_attention/moe are imported as submodules
+# (dlrover_tpu.parallel.pipeline etc.) — they depend on dlrover_tpu.models,
+# which itself imports this package, so re-exporting them here would cycle.

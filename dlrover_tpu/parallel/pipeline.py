@@ -1,0 +1,315 @@
+"""GPipe-style pipeline parallelism over the ``pp`` mesh axis.
+
+Parity: the reference's PiPPy-based pipe compiler
+(atorch/atorch/modules/distributed_modules/compilers/pipe_compiler/
+distributed_pippy_compiler.py:541, PipelineStage.py:989) traces the model
+into per-stage graphs, places them on ranks and streams microbatches over
+torch RPC, with DeepSpeed 3D as a second backend
+(ds_3d_parallel_optimization.py). The TPU-native design needs none of that
+machinery:
+
+- per-stage layer parameters are **stacked on a leading axis sharded over
+  ``pp``** (stage s owns rows [s]), so placement is a sharding, not a
+  graph partitioner;
+- the microbatch rotation runs inside ``jax.shard_map`` that is *manual
+  over pp only* — dp/fsdp/tp stay GSPMD-auto inside the body, so ZeRO-3
+  and megatron-TP sharding compose with PP without stage-local rewrites;
+- activations hop stages via ``lax.ppermute`` over ICI;
+- autodiff through the scan-of-ppermute yields the backward pipeline
+  schedule for free (ppermute transposes to the reverse rotation).
+
+Schedule: GPipe with M microbatches over P stages — bubble fraction
+(P-1)/(M+P-1). Activation memory is bounded by ``cfg.remat`` (each stage
+checkpoint-recomputes its layer stack in backward, the standard GPipe
+memory trade).
+
+Layout contract: the embedding runs before the pipeline region and the
+final-norm/LM-head after it, in plain GSPMD-auto land; only the L
+transformer blocks are staged. ``cfg.num_layers`` must divide evenly into
+``pp`` stages and all blocks must be homogeneous (no MoE interleave —
+EP×PP composition is scoped out, as in the reference where MoE and PiPPy
+pipelines are separate optimizations).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.models.config import TransformerConfig
+from dlrover_tpu.models.train import TrainState, opt_state_shardings
+from dlrover_tpu.models.transformer import (
+    _attention_block,
+    _mlp_block,
+    embed_tokens,
+    init_params,
+    lm_head,
+    logical_axes,
+    token_nll,
+)
+from dlrover_tpu.parallel.sharding_rules import (
+    ShardingRules,
+    apply_rules,
+    default_lm_rules,
+)
+
+STAGE_AXES = ("stage", "layer_stack")  # leading axes of stacked stage params
+
+
+def pipeline_rules(rules: Optional[ShardingRules] = None) -> ShardingRules:
+    """Extend the LM rule table with the stage axes: "stage" → pp mesh
+    axis, the intra-stage layer-stack axis replicated."""
+    rules = rules or default_lm_rules()
+    merged = dict(rules.rules)
+    merged.setdefault("stage", "pp")
+    merged.setdefault("layer_stack", None)
+    return ShardingRules(rules=merged)
+
+
+def _check_pipeline_cfg(cfg: TransformerConfig, pp: int) -> None:
+    if cfg.num_experts:
+        raise ValueError(
+            "pipeline parallelism requires homogeneous blocks (MoE layers "
+            "interleave a different tree structure); use ep without pp"
+        )
+    if cfg.num_layers % pp != 0:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} must divide into pp={pp} stages"
+        )
+
+
+def stack_pipeline_params(params: Any, pp: int) -> Any:
+    """{"embed","final_norm",("lm_head"),"layers":[L dicts]} →
+    same dict with "layers" replaced by "stages": leaves [pp, L/pp, ...]."""
+    layers = params["layers"]
+    lp = len(layers) // pp
+    stages = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs).reshape(pp, lp, *xs[0].shape), *layers
+    )
+    out = {k: v for k, v in params.items() if k != "layers"}
+    out["stages"] = stages
+    return out
+
+
+def unstack_pipeline_params(pparams: Any, cfg: TransformerConfig) -> Any:
+    """Inverse of ``stack_pipeline_params`` (for checkpoints / eval)."""
+    stages = pparams["stages"]
+    L = cfg.num_layers
+
+    def leaf(x):
+        return x.reshape(L, *x.shape[2:])
+
+    flat = jax.tree_util.tree_map(leaf, stages)
+    layers = [
+        jax.tree_util.tree_map(lambda x: x[i], flat) for i in range(L)
+    ]
+    out = {k: v for k, v in pparams.items() if k != "stages"}
+    out["layers"] = layers
+    return out
+
+
+def pipeline_logical_axes(cfg: TransformerConfig, pp: int) -> Any:
+    """Logical-axis pytree congruent with ``stack_pipeline_params``'s
+    output: per-layer axes prefixed with the (stage, layer_stack) axes."""
+    axes = logical_axes(cfg)
+    layer0 = axes["layers"][0]
+
+    def prefixed(t):
+        return STAGE_AXES + t
+
+    stages = jax.tree_util.tree_map(
+        prefixed,
+        layer0,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(a is None or isinstance(a, str) for a in x),
+    )
+    out = {k: v for k, v in axes.items() if k != "layers"}
+    out["stages"] = stages
+    return out
+
+
+def pipeline_param_shardings(
+    cfg: TransformerConfig, mesh, pp: int, rules=None
+):
+    return apply_rules(
+        pipeline_logical_axes(cfg, pp), pipeline_rules(rules), mesh
+    )
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def pipeline_forward(
+    pparams: Any,
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    mesh,
+    num_microbatches: int,
+) -> jnp.ndarray:
+    """tokens [B,T] int32 → logits [B,T,vocab] fp32, staged over pp.
+
+    B must divide by ``num_microbatches`` (and the microbatch by the dp
+    sharding, as usual).
+    """
+    pp = mesh.shape["pp"]
+    M = num_microbatches
+    _check_pipeline_cfg(cfg, pp)
+    if mesh.shape.get("sp", 1) > 1:
+        raise ValueError("sp (ring attention) inside pp stages not supported")
+    dt = jnp.dtype(cfg.dtype)
+    B, T = tokens.shape
+    if B % M != 0:
+        raise ValueError(f"batch {B} must divide into {M} microbatches")
+    mb = B // M
+
+    # embedding: before the pipeline region, plain GSPMD
+    x = embed_tokens(pparams, tokens, cfg)
+    x = x.reshape(M, mb, T, cfg.model_dim)
+    x = lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(None, ("dp", "fsdp")))
+    )
+
+    def block(x, layer):
+        positions = jnp.broadcast_to(jnp.arange(T), x.shape[:2])
+        x = _attention_block(x, layer, cfg, None, positions)
+        x, _ = _mlp_block(x, layer, cfg, None)
+        return x
+
+    def stage_fn(stage_layers, x):
+        """Apply this stage's L/pp stacked layers via scan."""
+
+        def body(x, layer):
+            y = block(x, layer)
+            return y, None
+
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        x, _ = lax.scan(body, x, stage_layers)
+        return x
+
+    def pipelined(stages, x_mb):
+        # manual over pp: stages arrive [1, L/pp, ...] — drop the stage dim
+        stages_loc = jax.tree_util.tree_map(lambda a: a[0], stages)
+        idx = lax.axis_index("pp")
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+        x_loc = lax.pcast(x_mb, ("pp",), to="varying")
+        state = jnp.zeros_like(x_loc[0])
+        outputs = jnp.zeros_like(x_loc)
+
+        def tick(carry, t):
+            state, outputs = carry
+            inject = lax.dynamic_index_in_dim(
+                x_loc, jnp.minimum(t, M - 1), 0, keepdims=False
+            )
+            cur = jnp.where(idx == 0, inject, state)
+            out = stage_fn(stages_loc, cur)
+            oi = t - (pp - 1)
+            write = (idx == pp - 1) & (oi >= 0)
+            upd = lax.dynamic_update_index_in_dim(
+                outputs, out, jnp.clip(oi, 0, M - 1), 0
+            )
+            outputs = jnp.where(write, upd, outputs)
+            if pp > 1:
+                state = lax.ppermute(out, "pp", perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = lax.scan(
+            tick, (state, outputs), jnp.arange(M + pp - 1)
+        )
+        # new leading axis concatenated over pp → global [pp, M, mb, T, D]
+        return outputs[None]
+
+    outs = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(P("pp"), P()),
+        out_specs=P("pp"),
+        # manual over pp ONLY: dp/fsdp/tp stay GSPMD-auto inside the body
+        # (without this, shard_map is manual over every mesh axis — stage
+        # params would be all-gathered and each dp device would redo the
+        # full batch)
+        axis_names={"pp"},
+    )(pparams["stages"], x)
+    y = outs[pp - 1].reshape(B, T, cfg.model_dim)
+
+    # final norm + head: after the pipeline region, plain GSPMD
+    return lm_head(pparams, y, cfg)
+
+
+def pipeline_loss_fn(
+    pparams, tokens, targets, cfg: TransformerConfig, mesh, num_microbatches
+) -> jnp.ndarray:
+    logits = pipeline_forward(pparams, tokens, cfg, mesh, num_microbatches)
+    return token_nll(logits, targets)
+
+
+# ---------------------------------------------------------------------------
+# training
+# ---------------------------------------------------------------------------
+def pipeline_state_shardings(
+    cfg: TransformerConfig, mesh, tx, rules=None
+) -> TrainState:
+    pp = mesh.shape["pp"]
+    p_sh = pipeline_param_shardings(cfg, mesh, pp, rules)
+    replicated = NamedSharding(mesh, P())
+    params_shape = jax.eval_shape(
+        lambda: stack_pipeline_params(
+            init_params(jax.random.PRNGKey(0), cfg), pp
+        )
+    )
+    opt_sh = opt_state_shardings(params_shape, p_sh, tx, mesh)
+    return TrainState(step=replicated, params=p_sh, opt_state=opt_sh)
+
+
+def init_pipeline_state(
+    key, cfg: TransformerConfig, mesh, tx, rules=None
+) -> Tuple[TrainState, TrainState]:
+    """Initialize stacked pipeline params/opt state directly into their
+    shardings (stage s's rows materialize on stage s's devices)."""
+    pp = mesh.shape["pp"]
+    _check_pipeline_cfg(cfg, pp)
+    sh = pipeline_state_shardings(cfg, mesh, tx, rules)
+
+    def _init(key):
+        return stack_pipeline_params(init_params(key, cfg), pp)
+
+    params = jax.jit(_init, out_shardings=sh.params)(key)
+    opt_state = jax.jit(tx.init, out_shardings=sh.opt_state)(params)
+    step = jax.device_put(jnp.zeros((), jnp.int32), sh.step)
+    return TrainState(step=step, params=params, opt_state=opt_state), sh
+
+
+def build_pipeline_train_step(
+    cfg: TransformerConfig,
+    mesh,
+    tx,
+    num_microbatches: int,
+    rules: Optional[ShardingRules] = None,
+    donate: bool = True,
+):
+    """jitted (state, tokens, targets) → (state, metrics), GPipe over pp."""
+    import optax
+
+    def train_step(state: TrainState, tokens, targets):
+        def lf(p):
+            return pipeline_loss_fn(
+                p, tokens, targets, cfg, mesh, num_microbatches
+            )
+
+        loss, grads = jax.value_and_grad(lf)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(
+                step=state.step + 1, params=new_params, opt_state=new_opt
+            ),
+            {"loss": loss, "grad_norm": optax.global_norm(grads)},
+        )
+
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(train_step, donate_argnums=donate_argnums)

@@ -1,0 +1,33 @@
+"""Strategy search: the ``auto_accelerate`` analog.
+
+Parity: the reference's signature capability — ATorch ``auto_accelerate``
+(atorch/atorch/auto/accelerate.py:406) runs a task loop
+(ANALYSE/TUNE/DRYRUN/FINISH) against a rank-0 gRPC AccelerationEngine
+(auto/engine/acceleration_engine.py:13) that generates candidate
+``Strategy`` objects over an optimization library, scores them with real
+profiled runs (auto/dry_runner/dry_runner.py) and a MIP tensor-parallel
+planner (auto/opt_lib/shard_planners/mip_tp_planner.py), then applies the
+winner by wrapping the model (FSDP/TP/PP/AMP module surgery).
+
+The TPU-native design collapses almost all of that: a strategy is just
+**mesh shape × sharding rules × remat × dtype × microbatching** — no
+module surgery, no process-group setup, no MIP placement (GSPMD does
+intra-op placement). What remains worth searching is the mesh
+factorization and the memory/throughput trade (remat, microbatches),
+which ``auto_accelerate`` here scores with XLA's own compile-time cost
+and memory analysis (``jit(step).lower().compile()``) plus short timed
+runs of the finalists — the same measure-then-commit shape as the
+reference's dry-runner, without the gRPC service (the search is
+deterministic, so every host computes the same winner; for elastic jobs
+the winner is also published via the master KV store, see
+``agree_strategy``).
+"""
+
+from dlrover_tpu.accel.strategy import Strategy  # noqa: F401
+from dlrover_tpu.accel.candidates import candidate_strategies  # noqa: F401
+from dlrover_tpu.accel.dry_runner import DryRunReport, dry_run  # noqa: F401
+from dlrover_tpu.accel.accelerate import (  # noqa: F401
+    AccelerateResult,
+    agree_strategy,
+    auto_accelerate,
+)

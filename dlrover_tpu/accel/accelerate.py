@@ -1,0 +1,140 @@
+"""``auto_accelerate`` driver: candidates → dry run → winning step fn.
+
+Parity: atorch accelerate.py:406 (``auto_accelerate``) and :34
+(``model_transform``). The reference needs a rank-0 gRPC engine so every
+torch process applies the same wrapper stack; here the search is a pure
+function of (config, device count), so each host derives the same winner
+independently — ``agree_strategy`` additionally pins it through the
+master KV store so an elastic restart with a *different* device count
+can reuse (or deliberately re-run) the search.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional
+
+from dlrover_tpu.accel.candidates import candidate_strategies
+from dlrover_tpu.accel.dry_runner import DryRunReport, _build, dry_run
+from dlrover_tpu.accel.strategy import Strategy
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.models.config import TransformerConfig
+
+
+@dataclass
+class AccelerateResult:
+    strategy: Strategy
+    cfg: TransformerConfig  # config with the strategy's dtype/remat applied
+    mesh: Any
+    step_fn: Callable
+    init_fn: Callable  # key -> sharded TrainState
+    reports: List[DryRunReport]
+
+
+def auto_accelerate(
+    cfg: TransformerConfig,
+    tx,
+    batch: int,
+    seq: int,
+    devices=None,
+    hbm_budget: Optional[float] = None,
+    max_candidates: int = 16,
+    max_timed: int = 3,
+    strategy: Optional[Strategy] = None,
+    donate: bool = True,
+) -> AccelerateResult:
+    """Pick (or apply) a strategy and return the compiled artifacts.
+
+    ``strategy`` short-circuits the search (the reference's
+    ``load_strategy=`` path); otherwise candidates are generated, scored
+    by compile-time cost/memory analysis, the finalists timed, and the
+    winner rebuilt.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    reports: List[DryRunReport] = []
+    if strategy is None:
+        t0 = time.time()
+        cands = candidate_strategies(
+            cfg, len(devices), batch, seq, max_candidates=max_candidates
+        )
+        if not cands:
+            raise ValueError(
+                f"no valid mesh factorization for {len(devices)} devices, "
+                f"batch={batch}, seq={seq}"
+            )
+        reports = dry_run(
+            cands, cfg, tx, batch, seq, devices,
+            hbm_budget=hbm_budget, max_timed=max_timed,
+        )
+        best = reports[0]
+        if not (best.ok and best.fits):
+            over = [r for r in reports if r.ok and not r.fits]
+            detail = (
+                f"smallest candidate needs {min(r.mem_bytes for r in over):.3e} "
+                f"bytes vs budget {hbm_budget:.3e}"
+                if over
+                else f"best compile error: {best.error}"
+            )
+            raise RuntimeError(
+                f"no candidate strategy compiled within budget; {detail}"
+            )
+        strategy = best.strategy
+        logger.info(
+            f"auto_accelerate: picked {strategy.describe()} from "
+            f"{len(cands)} candidates in {time.time() - t0:.1f}s "
+            f"(measured {best.step_s}, est {best.est_step_s:.4f}s/step)"
+        )
+
+    # production build: donate the old state's buffers each step (the dry
+    # runs use donate=False because they reuse state across timings);
+    # pass donate=False when something else reads the state after the
+    # step, e.g. async flash-ckpt staging
+    cfg2, mesh, step_fn, init_fn, _, _ = _build(
+        strategy, cfg, tx, devices, donate=donate
+    )
+    return AccelerateResult(
+        strategy=strategy,
+        cfg=cfg2,
+        mesh=mesh,
+        step_fn=step_fn,
+        init_fn=init_fn,
+        reports=reports,
+    )
+
+
+_STRATEGY_KEY = "auto_accelerate/strategy"
+
+
+def agree_strategy(
+    master_client,
+    cfg: TransformerConfig,
+    tx,
+    batch: int,
+    seq: int,
+    timeout: float = 600.0,
+    **kwargs,
+) -> Strategy:
+    """Cross-host agreement: process 0 searches and publishes, the rest
+    wait for the published winner (parity: the reference's rank-0
+    AccelerationEngine service with clients polling get_task,
+    accelerate.py:194)."""
+    import jax
+
+    key = f"{_STRATEGY_KEY}/{len(jax.devices())}"
+    if jax.process_index() == 0:
+        result = auto_accelerate(cfg, tx, batch, seq, **kwargs)
+        master_client.kv_store_set(
+            key, result.strategy.to_json().encode()
+        )
+        return result.strategy
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        raw = master_client.kv_store_get(key)
+        if raw:
+            return Strategy.from_json(raw.decode())
+        time.sleep(1.0)
+    raise TimeoutError(f"no strategy published under {key}")

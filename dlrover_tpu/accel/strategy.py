@@ -1,0 +1,54 @@
+"""Strategy = the complete recipe for turning a model config into a
+sharded, compiled train step.
+
+Parity: atorch ``Strategy`` (auto/strategy.py) is an ordered list of
+(optimization_name, config, tunable) module transforms. Here the whole
+space is four orthogonal knobs; ``to_json``/``from_json`` replace the
+reference's pickled strategy files (``load_strategy=`` path,
+accelerate.py:246) for caching and for cross-host agreement through the
+master KV store.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field, replace
+from typing import Optional, Tuple
+
+from dlrover_tpu.parallel.mesh import MeshConfig
+
+
+@dataclass(frozen=True)
+class Strategy:
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    remat: bool = False
+    dtype: str = "bfloat16"
+    # >1 runs the GPipe schedule over the mesh's pp axis
+    num_microbatches: int = 1
+
+    def describe(self) -> str:
+        axes = {
+            a: s for a, s in self.mesh.axis_sizes().items() if s > 1
+        } or {"dp": 1}
+        bits = ["x".join(f"{a}{s}" for a, s in axes.items())]
+        if self.num_microbatches > 1:
+            bits.append(f"mb{self.num_microbatches}")
+        if self.remat:
+            bits.append("remat")
+        bits.append(self.dtype)
+        return "/".join(bits)
+
+    def to_json(self) -> str:
+        d = asdict(self)
+        d["mesh"]["dcn_axes"] = list(self.mesh.dcn_axes)
+        return json.dumps(d, sort_keys=True)
+
+    @staticmethod
+    def from_json(s: str) -> "Strategy":
+        d = json.loads(s)
+        mesh_d = d.pop("mesh")
+        mesh_d["dcn_axes"] = tuple(mesh_d.get("dcn_axes", ()))
+        return Strategy(mesh=MeshConfig(**mesh_d), **d)
+
+    def with_remat(self, remat: bool = True) -> "Strategy":
+        return replace(self, remat=remat)

@@ -156,7 +156,9 @@ class CheckpointEngine:
         if self._shm is not None:
             try:
                 self._shm.close(unlink=False)
-            except OSError as e:
+            except (OSError, BufferError) as e:
+                # BufferError = a wedged staging thread still holds a view
+                # into the shm buffer (the case warned about above)
                 logger.warning(f"shm close failed: {e!r}")
 
     def _stage_and_notify(

@@ -33,7 +33,6 @@ pipelines are separate optimizations).
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Optional, Tuple
 
 import jax
@@ -161,7 +160,6 @@ def pipeline_forward(
     _check_pipeline_cfg(cfg, pp)
     if mesh.shape.get("sp", 1) > 1:
         raise ValueError("sp (ring attention) inside pp stages not supported")
-    dt = jnp.dtype(cfg.dtype)
     B, T = tokens.shape
     if B % M != 0:
         raise ValueError(f"batch {B} must divide into {M} microbatches")

@@ -118,15 +118,26 @@ def agree_strategy(
     timeout: float = 600.0,
     **kwargs,
 ) -> Strategy:
-    """Cross-host agreement: process 0 searches and publishes, the rest
-    wait for the published winner (parity: the reference's rank-0
+    """Cross-host agreement on the winning strategy.
+
+    Multi-controller JAX means EVERY process must issue the same
+    computations over the global device set — a rank-0-only search would
+    deadlock the collectives inside the dry runs. So all processes run
+    the identical search together (candidate order is deterministic, so
+    they issue the same compiles and the same timed steps in lockstep);
+    only the *decision* is centralized: per-host wall-clock jitter could
+    tie-break finalists differently, so process 0's winner is published
+    through the master KV store and every other host adopts it,
+    discarding its own pick. (Parity: the reference's rank-0
     AccelerationEngine service with clients polling get_task,
-    accelerate.py:194)."""
+    accelerate.py:194 — same shape, but here the "clients" do the work
+    too because SPMD requires it.)
+    """
     import jax
 
     key = f"{_STRATEGY_KEY}/{len(jax.devices())}"
+    result = auto_accelerate(cfg, tx, batch, seq, **kwargs)
     if jax.process_index() == 0:
-        result = auto_accelerate(cfg, tx, batch, seq, **kwargs)
         master_client.kv_store_set(
             key, result.strategy.to_json().encode()
         )

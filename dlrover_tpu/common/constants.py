@@ -46,6 +46,9 @@ class NodeExitReason:
     HARDWARE_ERROR = "hardware_error"
     UNKNOWN_ERROR = "unknown_error"
     RELAUNCHED = "relaunched"
+    # deliberately removed by a scale-down; the rank may come back later
+    # with a fresh relaunch budget
+    SCALED_DOWN = "scaled_down"
 
 
 class JobExitReason:

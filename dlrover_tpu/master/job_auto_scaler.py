@@ -1,0 +1,193 @@
+"""Auto-scaler: closes the loop from monitoring to ScalePlans.
+
+Parity: dlrover/python/master/node/job_auto_scaler.py —
+``new_job_auto_scaler:40`` picks the variant,
+``AllreduceTrainingAutoScaler:254`` periodically counts alive workers
+and replaces dead ones, ``PSTrainingAutoScaler:98`` additionally
+consumes resource-optimizer plans. The TPU job is the allreduce shape
+(one SPMD world over ICI/DCN): the scaler's duties are
+
+- replace nodes that died unrecoverably (exhausted relaunch budget,
+  heartbeat-timeout) so the world can return to target size;
+- honor node-unit granularity (whole TPU slices, SURVEY §5: slice-level
+  failure means all hosts of the slice restart together);
+- expose ``scale_to`` for explicit resizes (API / operator / Brain), the
+  seam the resource optimizer plugs into.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.job_manager import JobManager
+from dlrover_tpu.master.scaler import ScalePlan, Scaler
+
+
+class JobAutoScaler:
+    def __init__(
+        self,
+        job_manager: JobManager,
+        speed_monitor=None,
+        scaler: Optional[Scaler] = None,
+        node_type: str = NodeType.WORKER,
+        target_nodes: int = 0,
+        node_unit: int = 1,
+        interval: float = 15.0,
+    ):
+        self._job_manager = job_manager
+        self._speed_monitor = speed_monitor
+        self._scaler = scaler
+        self._node_type = node_type
+        self._target = target_nodes or len(
+            job_manager.get_nodes(node_type)
+        )
+        self._node_unit = max(1, node_unit)
+        self._interval = interval
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="job-auto-scaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self):
+        while not self._stopped.wait(self._interval):
+            try:
+                self.check_and_scale()
+            except Exception as e:
+                logger.error(f"auto-scale pass failed: {e!r}")
+
+    # -- core -----------------------------------------------------------
+    def alive_nodes(self):
+        return [
+            n
+            for n in self._job_manager.get_nodes(self._node_type)
+            if not n.is_released
+            and n.status
+            in (
+                NodeStatus.INITIAL,
+                NodeStatus.PENDING,
+                NodeStatus.RUNNING,
+            )
+        ]
+
+    def check_and_scale(self) -> ScalePlan:
+        """One pass (parity: AllreduceTrainingAutoScaler
+        ``_periodic_adjust_worker`` job_auto_scaler.py:254): release
+        heartbeat-dead nodes, then top the group back up to target.
+        Runs under the job manager's scale lock so it cannot race the
+        servicer's failure-relaunch path into duplicate ranks."""
+        plan = ScalePlan()
+        with self._job_manager.scale_lock:
+            for node in self._job_manager.get_heartbeat_timeout_nodes():
+                logger.warning(
+                    f"{node.name}: no heartbeat; marking failed for "
+                    f"replacement"
+                )
+                node.is_released = True
+                node.update_status(NodeStatus.FAILED)
+                plan.remove_nodes.append(node)
+                if self._speed_monitor:
+                    self._speed_monitor.remove_running_worker(node.id)
+
+            # the target is already node-unit aligned, so restoring it
+            # keeps whole slices (unit rounding applies to scale_to
+            # targets, not to replacement)
+            missing = self._target - len(self.alive_nodes())
+            for _ in range(max(0, missing)):
+                new_node = self._create_replacement()
+                if new_node is None:
+                    break  # rank out of relaunch budget — stop churning
+                plan.launch_nodes.append(new_node)
+        if not plan.empty():
+            plan.node_group[self._node_type] = self._target
+            logger.info(
+                f"auto-scale plan: +{len(plan.launch_nodes)} "
+                f"-{len(plan.remove_nodes)} (target {self._target})"
+            )
+            if self._scaler is not None:
+                self._scaler.scale(plan)
+        return plan
+
+    def _create_replacement(self) -> Optional[Node]:
+        """Replacement for the lowest missing rank. Inherits the dead
+        node's resources and relaunch budget (the OOM memory bump from
+        _handle_node_failure must survive this path too); a rank whose
+        budget is exhausted is not replaced."""
+        used = {n.rank_index for n in self.alive_nodes()}
+        rank = next(i for i in range(self._target) if i not in used)
+        prior = [
+            n
+            for n in self._job_manager.get_nodes(self._node_type)
+            if n.rank_index == rank
+        ]
+        new_id = self._job_manager.allocate_node_id(self._node_type)
+        last = max(prior, key=lambda n: n.id) if prior else None
+        if last is not None and last.exit_reason == NodeExitReason.SCALED_DOWN:
+            last = None  # deliberate removal: come back with a fresh budget
+        if last is not None:
+            if (
+                not last.relaunchable
+                or last.relaunch_count >= last.max_relaunch_count
+            ):
+                logger.warning(
+                    f"rank {rank} is out of relaunch budget "
+                    f"({last.relaunch_count}); not replacing"
+                )
+                return None
+            node = last.get_relaunch_node_info(new_id)
+        else:
+            node = Node(
+                node_type=self._node_type,
+                node_id=new_id,
+                rank_index=rank,
+                group=rank // self._node_unit,
+                group_size=self._node_unit,
+            )
+        self._job_manager.add_node(node)
+        return node
+
+    def scale_to(self, count: int) -> ScalePlan:
+        """Explicit resize (operator / Brain / API seam). Parity:
+        job_auto_scaler.py ``execute_job_optimization_plan``. Non-unit
+        counts round UP to a whole node unit (a partial slice cannot
+        join, and rounding down could silently scale to zero)."""
+        if count < 0:
+            raise ValueError(f"cannot scale to {count}")
+        if count % self._node_unit:
+            count += self._node_unit - count % self._node_unit
+        plan = ScalePlan()
+        with self._job_manager.scale_lock:
+            alive = sorted(self.alive_nodes(), key=lambda n: n.rank_index)
+            if count < len(alive):
+                for node in alive[count:]:
+                    node.is_released = True
+                    node.relaunchable = False
+                    node.exit_reason = NodeExitReason.SCALED_DOWN
+                    plan.remove_nodes.append(node)
+            self._target = count
+        if not plan.empty() and self._scaler is not None:
+            self._scaler.scale(plan)
+        if count > len(alive):
+            # top-up handled by the same path as failure replacement
+            plan2 = self.check_and_scale()
+            plan.launch_nodes.extend(plan2.launch_nodes)
+        plan.node_group[self._node_type] = count
+        return plan

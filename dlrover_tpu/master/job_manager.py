@@ -43,6 +43,10 @@ class JobManager:
         max_relaunch_count: int = 3,
     ):
         self._lock = threading.Lock()
+        # serializes replacement decisions between the servicer's event
+        # path (_relaunch_node) and the auto-scaler thread, so a node in
+        # the released-but-not-yet-replaced window isn't replaced twice
+        self.scale_lock = threading.Lock()
         self._job_nodes: Dict[str, Dict[int, Node]] = {}
         self._speed_monitor = speed_monitor
         self._scaler = scaler
@@ -174,15 +178,20 @@ class JobManager:
                 f"{node.exit_reason}"
             )
 
+    def allocate_node_id(self, node_type: str) -> int:
+        with self._lock:
+            new_id = self._next_node_id.get(node_type, 0)
+            self._next_node_id[node_type] = new_id + 1
+        return new_id
+
     def _relaunch_node(self, node: Node):
         """Parity: dist_job_manager.py:605."""
-        node.is_released = True
-        with self._lock:
-            new_id = self._next_node_id.get(node.type, 0)
-            self._next_node_id[node.type] = new_id + 1
-        new_node = node.get_relaunch_node_info(new_id)
-        new_node.exit_reason = NodeExitReason.RELAUNCHED
-        self.add_node(new_node)
+        with self.scale_lock:
+            node.is_released = True
+            new_id = self.allocate_node_id(node.type)
+            new_node = node.get_relaunch_node_info(new_id)
+            new_node.exit_reason = NodeExitReason.RELAUNCHED
+            self.add_node(new_node)
         logger.info(
             f"relaunch {node.name} -> {new_node.name} "
             f"(attempt {new_node.relaunch_count}/{node.max_relaunch_count})"
@@ -225,6 +234,21 @@ class JobManager:
         if self._speed_monitor is None:
             return False
         return self._speed_monitor.all_worker_hanged()
+
+    def restart_all_workers(self) -> int:
+        """Order every running node's agent to restart its training procs
+        via the heartbeat action channel (parity: the reference's hang path
+        relaunches through the agent, dist_job_manager.py hang handling —
+        it does NOT kill the job). Returns the number of nodes signalled."""
+        nodes = self.get_running_nodes()
+        for node in nodes:
+            node.restart_training = True
+        if self._speed_monitor is not None:
+            self._speed_monitor.reset_running_speed_monitor()
+        logger.warning(
+            f"ordered restart of {len(nodes)} running nodes (hang recovery)"
+        )
+        return len(nodes)
 
     def stop(self):
         self._stopped = True

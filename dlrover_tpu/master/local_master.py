@@ -16,6 +16,7 @@ from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import JobExitReason, RendezvousName
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.elastic_ps import ElasticPsService
+from dlrover_tpu.master.job_auto_scaler import JobAutoScaler
 from dlrover_tpu.master.job_manager import LocalJobManager
 from dlrover_tpu.master.kv_store import KVStoreService
 from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
@@ -30,11 +31,26 @@ from dlrover_tpu.master.sync_service import SyncService
 
 
 class LocalJobMaster:
-    def __init__(self, port: int = 0, node_num: int = 1):
+    def __init__(
+        self,
+        port: int = 0,
+        node_num: int = 1,
+        scaler=None,
+        node_unit: int = 1,
+    ):
         self.port = port or comm.find_free_port()
         self.speed_monitor = SpeedMonitor()
-        self.job_manager = LocalJobManager(speed_monitor=self.speed_monitor)
+        self.job_manager = LocalJobManager(
+            speed_monitor=self.speed_monitor, scaler=scaler
+        )
         self.job_manager.create_initial_nodes(node_num)
+        self.auto_scaler = JobAutoScaler(
+            self.job_manager,
+            speed_monitor=self.speed_monitor,
+            scaler=scaler,
+            target_nodes=node_num,
+            node_unit=node_unit,
+        )
         self.task_manager = TaskManager(self.speed_monitor)
         self.rdzv_managers = {
             RendezvousName.ELASTIC_TRAINING: ElasticTrainingRendezvousManager(),
@@ -63,22 +79,54 @@ class LocalJobMaster:
 
     def prepare(self):
         self._server = create_master_service(self.port, self.servicer)
+        self.auto_scaler.start()
         logger.info(f"local master serving on {self.addr}")
 
-    def run(self) -> str:
-        """Block until the job finishes; returns the exit reason."""
+    def run(self, max_hang_recoveries: int = 3) -> str:
+        """Block until the job finishes; returns the exit reason.
+
+        A hang first triggers worker restarts through the agents'
+        heartbeat action channel (parity: the reference relaunches on
+        hang, dist_job_manager.py, rather than failing the job); only
+        after ``max_hang_recoveries`` fruitless restarts does the job
+        exit with HANG_ERROR.
+        """
+        hang_recoveries = 0
+        step_at_last_hang = -1
         while not self._stopped.is_set():
             if self.task_manager.finished():
                 logger.info("all dataset tasks completed")
                 return JobExitReason.SUCCEEDED
             if self.job_manager.all_running_node_hanged():
-                logger.error("job hanged; stopping")
-                return JobExitReason.HANG_ERROR
+                # only *fruitless* restarts count: progress since the last
+                # hang resets the budget, so transient hangs days apart on
+                # a long job never add up to a kill
+                step = self.speed_monitor.completed_global_step
+                if step > step_at_last_hang >= 0:
+                    hang_recoveries = 0
+                step_at_last_hang = step
+                if hang_recoveries >= max_hang_recoveries:
+                    logger.error(
+                        f"job still hanged after {hang_recoveries} "
+                        f"restart rounds; stopping"
+                    )
+                    return JobExitReason.HANG_ERROR
+                hang_recoveries += 1
+                logger.error(
+                    f"job hanged; restarting workers (recovery "
+                    f"{hang_recoveries}/{max_hang_recoveries})"
+                )
+                self.job_manager.restart_all_workers()
             time.sleep(2)
         return JobExitReason.SUCCEEDED
 
+    def scale_to(self, count: int):
+        """Explicit resize API (operator / Brain seam)."""
+        return self.auto_scaler.scale_to(count)
+
     def stop(self):
         self._stopped.set()
+        self.auto_scaler.stop()
         if self._server is not None:
             self._server.stop(grace=1)
             self._server = None

@@ -1,0 +1,142 @@
+"""Agent-side daemons: resource monitor, training monitor, paral-config
+tuner.
+
+Parity:
+- ``ResourceMonitor`` — dlrover/python/elastic_agent/monitor/resource.py:86
+  (psutil/pynvml usage reported to the master; feeds heartbeats, the
+  auto-scaler and the future Brain collector). TPU chips expose no pynvml
+  analog from the host, so chip stats stay zero unless a runtime metrics
+  file provides them.
+- ``TrainingMonitor`` — monitor/training.py:77 (reads the metrics file the
+  training process appends, reports global step to the master's
+  SpeedMonitor — the signal hang detection and auto-scaling run on).
+- ``ParalConfigTuner`` — config/paral_config_tuner.py:30: polls the
+  master's tuned ParallelConfig over RPC and (re)writes the JSON file
+  ``ElasticDataLoader`` re-reads, completing the master → agent →
+  dataloader retune loop.
+
+The training process's side of the metrics file is
+``report_runtime_metrics(step)`` — call it from the train loop (the
+``ElasticTrainer`` facade does it automatically).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+from dlrover_tpu.common.constants import ConfigPath
+from dlrover_tpu.common.daemon import PollingDaemon
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def _metrics_path() -> str:
+    return os.getenv(
+        ConfigPath.ENV_RUNTIME_METRICS, ConfigPath.RUNTIME_METRICS
+    )
+
+
+def report_runtime_metrics(step: int, path: str = "", **extra) -> None:
+    """Train-proc side: atomically publish the latest global step (plus
+    optional metrics like loss/tpu stats) for the agent's
+    TrainingMonitor."""
+    path = path or _metrics_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {"global_step": int(step), "timestamp": time.time(), **extra}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def read_runtime_metrics(path: str = "") -> dict:
+    path = path or _metrics_path()
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+class ResourceMonitor(PollingDaemon):
+    """Report host CPU/memory usage of this node's process tree to the
+    master (parity: resource.py:86)."""
+
+    def __init__(self, client, interval: float = 15.0):
+        super().__init__("resource-monitor", interval)
+        self._client = client
+        import psutil
+
+        self._proc = psutil.Process()
+        self._proc.cpu_percent(None)  # prime the percent baseline
+
+    def current_usage(self):
+        import psutil
+
+        procs = [self._proc] + self._proc.children(recursive=True)
+        cpu = 0.0
+        rss = 0
+        for p in procs:
+            try:
+                cpu += p.cpu_percent(None)
+                rss += p.memory_info().rss
+            except psutil.Error:
+                continue
+        return cpu, rss // (1024 * 1024)
+
+    def _tick(self):
+        cpu, mem_mb = self.current_usage()
+        metrics = read_runtime_metrics()
+        self._client.report_resource_stats(
+            cpu_percent=cpu,
+            used_memory_mb=mem_mb,
+            tpu_duty_cycle=float(metrics.get("tpu_duty_cycle", 0.0)),
+        )
+
+
+class TrainingMonitor(PollingDaemon):
+    """Forward the training procs' global step to the master
+    (parity: training.py:77)."""
+
+    def __init__(self, client, interval: float = 10.0):
+        super().__init__("training-monitor", interval)
+        self._client = client
+        self._last_step = -1
+
+    def _tick(self):
+        metrics = read_runtime_metrics()
+        step = int(metrics.get("global_step", -1))
+        if step > self._last_step:
+            self._last_step = step
+            self._client.report_global_step(step)
+
+
+class ParalConfigTuner(PollingDaemon):
+    """Poll the master's tuned config and rewrite the JSON file the
+    ElasticDataLoader re-reads (parity: paral_config_tuner.py:30)."""
+
+    def __init__(self, client, interval: float = 10.0, path: str = ""):
+        super().__init__("paral-config-tuner", interval)
+        self._client = client
+        self._path = path or os.getenv(
+            ConfigPath.ENV_PARAL_CONFIG, ConfigPath.PARAL_CONFIG
+        )
+        self._last_version = -1
+
+    def _tick(self):
+        config = self._client.get_paral_config()
+        version = getattr(config.dataloader, "version", 0)
+        if version == self._last_version:
+            return
+        self._last_version = version
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        tmp = f"{self._path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(dataclasses.asdict(config), f)
+        os.replace(tmp, self._path)
+        logger.info(
+            f"paral config v{version} written to {self._path} "
+            f"(batch_size={config.dataloader.batch_size})"
+        )

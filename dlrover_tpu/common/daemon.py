@@ -1,0 +1,44 @@
+"""Polling-daemon base shared by master and agent background loops
+(auto-scaler, resource/training monitors, config tuner)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class PollingDaemon:
+    """A named polling thread with clean start/stop; subclasses implement
+    ``_tick``. Exceptions in a tick are logged and do not kill the loop."""
+
+    def __init__(self, name: str, interval: float):
+        self._name = name
+        self._interval = interval
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name=self._name, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self):
+        while not self._stopped.wait(self._interval):
+            try:
+                self._tick()
+            except Exception as e:
+                logger.warning(f"{self._name} tick failed: {e!r}")
+
+    def _tick(self):
+        raise NotImplementedError

@@ -17,8 +17,6 @@ consumes resource-optimizer plans. The TPU job is the allreduce shape
 
 from __future__ import annotations
 
-import threading
-import time
 from typing import Dict, Optional
 
 from dlrover_tpu.common.constants import (
@@ -26,13 +24,14 @@ from dlrover_tpu.common.constants import (
     NodeStatus,
     NodeType,
 )
+from dlrover_tpu.common.daemon import PollingDaemon
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.node import Node
 from dlrover_tpu.master.job_manager import JobManager
 from dlrover_tpu.master.scaler import ScalePlan, Scaler
 
 
-class JobAutoScaler:
+class JobAutoScaler(PollingDaemon):
     def __init__(
         self,
         job_manager: JobManager,
@@ -43,6 +42,7 @@ class JobAutoScaler:
         node_unit: int = 1,
         interval: float = 15.0,
     ):
+        super().__init__("job-auto-scaler", interval)
         self._job_manager = job_manager
         self._speed_monitor = speed_monitor
         self._scaler = scaler
@@ -51,28 +51,13 @@ class JobAutoScaler:
             job_manager.get_nodes(node_type)
         )
         self._node_unit = max(1, node_unit)
-        self._interval = interval
-        self._stopped = threading.Event()
-        self._thread: Optional[threading.Thread] = None
 
-    # -- lifecycle ------------------------------------------------------
-    def start(self):
-        self._thread = threading.Thread(
-            target=self._loop, name="job-auto-scaler", daemon=True
-        )
-        self._thread.start()
+    @property
+    def has_scaler(self) -> bool:
+        return self._scaler is not None
 
-    def stop(self):
-        self._stopped.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-
-    def _loop(self):
-        while not self._stopped.wait(self._interval):
-            try:
-                self.check_and_scale()
-            except Exception as e:
-                logger.error(f"auto-scale pass failed: {e!r}")
+    def _tick(self):
+        self.check_and_scale()
 
     # -- core -----------------------------------------------------------
     def alive_nodes(self):
@@ -109,13 +94,17 @@ class JobAutoScaler:
 
             # the target is already node-unit aligned, so restoring it
             # keeps whole slices (unit rounding applies to scale_to
-            # targets, not to replacement)
-            missing = self._target - len(self.alive_nodes())
-            for _ in range(max(0, missing)):
-                new_node = self._create_replacement()
-                if new_node is None:
-                    break  # rank out of relaunch budget — stop churning
-                plan.launch_nodes.append(new_node)
+            # targets, not to replacement). Ranks out of relaunch budget
+            # are skipped individually — one poisoned rank must not starve
+            # replacement of the others.
+            used = {n.rank_index for n in self.alive_nodes()}
+            missing_ranks = [
+                r for r in range(self._target) if r not in used
+            ]
+            for rank in missing_ranks:
+                new_node = self._create_replacement(rank)
+                if new_node is not None:
+                    plan.launch_nodes.append(new_node)
         if not plan.empty():
             plan.node_group[self._node_type] = self._target
             logger.info(
@@ -126,13 +115,11 @@ class JobAutoScaler:
                 self._scaler.scale(plan)
         return plan
 
-    def _create_replacement(self) -> Optional[Node]:
-        """Replacement for the lowest missing rank. Inherits the dead
-        node's resources and relaunch budget (the OOM memory bump from
+    def _create_replacement(self, rank: int) -> Optional[Node]:
+        """Replacement node for ``rank``. Inherits the dead node's
+        resources and relaunch budget (the OOM memory bump from
         _handle_node_failure must survive this path too); a rank whose
         budget is exhausted is not replaced."""
-        used = {n.rank_index for n in self.alive_nodes()}
-        rank = next(i for i in range(self._target) if i not in used)
         prior = [
             n
             for n in self._job_manager.get_nodes(self._node_type)
@@ -174,6 +161,7 @@ class JobAutoScaler:
         if count % self._node_unit:
             count += self._node_unit - count % self._node_unit
         plan = ScalePlan()
+        plan.node_group[self._node_type] = count
         with self._job_manager.scale_lock:
             alive = sorted(self.alive_nodes(), key=lambda n: n.rank_index)
             if count < len(alive):
@@ -189,5 +177,4 @@ class JobAutoScaler:
             # top-up handled by the same path as failure replacement
             plan2 = self.check_and_scale()
             plan.launch_nodes.extend(plan2.launch_nodes)
-        plan.node_group[self._node_type] = count
         return plan

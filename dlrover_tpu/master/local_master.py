@@ -79,7 +79,12 @@ class LocalJobMaster:
 
     def prepare(self):
         self._server = create_master_service(self.port, self.servicer)
-        self.auto_scaler.start()
+        # Without a platform scaler the periodic pass would fabricate
+        # replacement Node entries nothing ever launches — ghosts that
+        # make the world look full while it is short. The table is then
+        # maintained only by the event/relaunch path.
+        if self.auto_scaler.has_scaler:
+            self.auto_scaler.start()
         logger.info(f"local master serving on {self.addr}")
 
     def run(self, max_hang_recoveries: int = 3) -> str:

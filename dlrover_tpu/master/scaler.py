@@ -117,9 +117,9 @@ class LocalProcessScaler(Scaler):
             ]
             for name in dead:
                 del self._procs[name]
-        for p in self._terminated:
-            p.poll()
-        self._terminated = [p for p in self._terminated if p.poll() is None]
+            self._terminated = [
+                p for p in self._terminated if p.poll() is None
+            ]
 
     def scale(self, plan: ScalePlan) -> None:
         from dlrover_tpu.utils.env import child_env
@@ -131,7 +131,8 @@ class LocalProcessScaler(Scaler):
             if proc is not None and proc.poll() is None:
                 logger.info(f"scaler terminating {node.name}")
                 proc.terminate()
-                self._terminated.append(proc)
+                with self._lock:
+                    self._terminated.append(proc)
         for node in plan.launch_nodes:
             if self._spawn_fn is not None:
                 self._spawn_fn(node)

@@ -182,6 +182,7 @@ def run(args) -> int:
             )
         )
 
+    monitors = []
     try:
         if args.network_check:
             ok = _run_network_check(args, client)
@@ -204,6 +205,22 @@ def run(args) -> int:
         saver = AsyncCheckpointSaver.start_async_saving_ckpt(
             local_shard_num=args.nproc_per_node, node_rank=args.node_rank
         )
+        # agent-side daemons (parity: launch_agent starts the monitors at
+        # training.py:721): resource usage + global step to the master,
+        # master-tuned paral config to the dataloader's file
+        from dlrover_tpu.agent.monitor import (
+            ParalConfigTuner,
+            ResourceMonitor,
+            TrainingMonitor,
+        )
+
+        monitors += [
+            ResourceMonitor(client),
+            TrainingMonitor(client),
+            ParalConfigTuner(client),
+        ]
+        for m in monitors:
+            m.start()
         agent = ElasticTrainingAgent(
             node_rank=args.node_rank, spec=spec, client=client
         )
@@ -215,6 +232,8 @@ def run(args) -> int:
         )
         return 0 if result.state == WorkerState.SUCCEEDED else 1
     finally:
+        for m in monitors:
+            m.stop()
         AsyncCheckpointSaver.reset()
         client.close()
         if master_proc is not None:

@@ -77,6 +77,23 @@ class TestAutoScaler:
         assert plan.launch_nodes == []
         assert len(master.auto_scaler.alive_nodes()) == 2
 
+    def test_poisoned_rank_does_not_starve_others(self, master3):
+        """Rank 1 out of budget, rank 2 entitled: rank 2 must still be
+        replaced (a break on the first exhausted rank would starve it)."""
+        master, _ = master3
+        for i in range(3):
+            _set_running(master, i)
+        poisoned = master.job_manager.get_node("worker", 1)
+        poisoned.relaunchable = False
+        poisoned.is_released = True
+        poisoned.update_status(NodeStatus.FAILED)
+        entitled = master.job_manager.get_node("worker", 2)
+        entitled.is_released = True
+        entitled.update_status(NodeStatus.FAILED)
+
+        plan = master.auto_scaler.check_and_scale()
+        assert [n.rank_index for n in plan.launch_nodes] == [2]
+
     def test_replacement_inherits_oom_memory_bump(self, master3):
         master, _ = master3
         for i in range(3):

@@ -274,6 +274,20 @@ class MasterClient:
         )
         return bool(resp and resp.done)
 
+    # -- streaming data / metrics --------------------------------------
+    def report_streaming_data(
+        self, dataset_name: str, new_records: int = 0, end: bool = False
+    ):
+        return self.report(
+            comm.StreamingDataReport(
+                dataset_name=dataset_name, new_records=new_records, end=end
+            )
+        )
+
+    def get_job_metrics(self, last_n: int = 0) -> comm.JobMetrics:
+        resp = self.get(comm.JobMetricsRequest(last_n=last_n))
+        return resp if resp else comm.JobMetrics()
+
     # -- paral config / misc -------------------------------------------
     def get_paral_config(self) -> comm.ParallelConfig:
         resp = self.get(comm.ParallelConfigRequest(node_id=self._node_id))

@@ -11,10 +11,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Optional
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import TaskType
 
 
 class ShardingClient:
@@ -46,13 +48,25 @@ class ShardingClient:
         )
         self._current_task: Optional[comm.Task] = None
 
-    def fetch_shard(self) -> Optional[comm.Shard]:
-        """Get the next shard; None when the dataset is exhausted."""
-        task = self._client.get_task(self.dataset_name)
-        if task.is_empty:
-            return None
-        self._current_task = task
-        return task.shard
+    def fetch_shard(
+        self, wait_interval: float = 0.5, timeout: float = 0.0
+    ) -> Optional[comm.Shard]:
+        """Get the next shard; None when the dataset is exhausted.
+        Streaming datasets answer WAIT while the producer is ahead of the
+        consumer — retry until a shard lands or ``timeout`` (0 = forever)
+        expires."""
+        deadline = time.time() + timeout if timeout else None
+        while True:
+            task = self._client.get_task(self.dataset_name)
+            if task.task_type == TaskType.WAIT:
+                if deadline and time.time() > deadline:
+                    return None
+                time.sleep(wait_interval)
+                continue
+            if task.is_empty:
+                return None
+            self._current_task = task
+            return task.shard
 
     def report_shard_done(self):
         if self._current_task is not None:
@@ -89,19 +103,28 @@ class IndexShardingClient(ShardingClient):
         self._uncredited = 0
 
     def _fill(self):
+        waiting = False
         with self._lock:
             if self._exhausted:
                 return
             task = self._client.get_task(self.dataset_name)
-            if task.is_empty:
+            if task.task_type == TaskType.WAIT:
+                waiting = True  # streaming producer behind; retry later
+            elif task.is_empty:
                 self._exhausted = True
                 self._index_queue.put(None)
-                return
-            shard = task.shard
-            indices = shard.record_indices or range(shard.start, shard.end)
-            for idx in indices:
-                self._index_queue.put(int(idx))
-            self._pending_tasks.put(task)
+            else:
+                shard = task.shard
+                indices = shard.record_indices or range(
+                    shard.start, shard.end
+                )
+                for idx in indices:
+                    self._index_queue.put(int(idx))
+                self._pending_tasks.put(task)
+        if waiting:
+            # back off OUTSIDE the lock: report_batch_done must not be
+            # starved while the producer is behind
+            time.sleep(0.2)
 
     def fetch_sample_index(self) -> int:
         while True:

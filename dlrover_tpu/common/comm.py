@@ -135,6 +135,17 @@ class DatasetShardParams(Message):
 
 
 @dataclass
+class StreamingDataReport(Message):
+    """Producer → master: advance a streaming dataset's watermark or
+    close the stream (parity: the message-queue offsets feeding the
+    reference's StreamingDatasetSplitter, dataset_splitter.py:359)."""
+
+    dataset_name: str = ""
+    new_records: int = 0
+    end: bool = False
+
+
+@dataclass
 class ShardCheckpointRequest(Message):
     dataset_name: str = ""
 
@@ -295,6 +306,29 @@ class GlobalStepReport(Message):
     node_id: int = 0
     step: int = 0
     timestamp: float = 0.0
+
+
+@dataclass
+class JobMetricsSample(Message):
+    """One point of the job metric series (parity: the stats the
+    reference's JobMetricCollector hands its reporter)."""
+
+    timestamp: float = 0.0
+    global_step: int = 0
+    steps_per_sec: float = 0.0
+    alive_nodes: int = 0
+    total_cpu_percent: float = 0.0
+    total_memory_mb: int = 0
+
+
+@dataclass
+class JobMetricsRequest(Message):
+    last_n: int = 0  # 0 = whole retained series
+
+
+@dataclass
+class JobMetrics(Message):
+    samples: List[JobMetricsSample] = field(default_factory=list)
 
 
 @dataclass

@@ -32,6 +32,13 @@ class NodeStatus:
     BREAKDOWN = "breakdown"  # hardware fault detected by health check
 
 
+class TaskType:
+    TRAIN = "train"
+    EVAL = "eval"
+    # streaming: no shard ready yet, worker should retry (not exhausted)
+    WAIT = "wait"
+
+
 class NodeEventType:
     ADDED = "added"
     MODIFIED = "modified"

@@ -27,6 +27,7 @@ from dlrover_tpu.master.rdzv_manager import (
 )
 from dlrover_tpu.master.servicer import MasterServicer, create_master_service
 from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.master.stats.collector import JobMetricCollector
 from dlrover_tpu.master.sync_service import SyncService
 
 
@@ -60,6 +61,9 @@ class LocalJobMaster:
         self.sync_service = SyncService(self.job_manager)
         self.elastic_ps_service = ElasticPsService()
         self.paral_config_service = ParalConfigService()
+        self.metric_collector = JobMetricCollector(
+            self.job_manager, self.speed_monitor
+        )
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             job_manager=self.job_manager,
@@ -69,6 +73,7 @@ class LocalJobMaster:
             speed_monitor=self.speed_monitor,
             elastic_ps_service=self.elastic_ps_service,
             paral_config_service=self.paral_config_service,
+            metric_collector=self.metric_collector,
         )
         self._server = None
         self._stopped = threading.Event()
@@ -85,6 +90,7 @@ class LocalJobMaster:
         # maintained only by the event/relaunch path.
         if self.auto_scaler.has_scaler:
             self.auto_scaler.start()
+        self.metric_collector.start()
         logger.info(f"local master serving on {self.addr}")
 
     def run(self, max_hang_recoveries: int = 3) -> str:
@@ -132,6 +138,7 @@ class LocalJobMaster:
     def stop(self):
         self._stopped.set()
         self.auto_scaler.stop()
+        self.metric_collector.stop()
         if self._server is not None:
             self._server.stop(grace=1)
             self._server = None

@@ -45,6 +45,7 @@ class MasterServicer:
         speed_monitor=None,
         elastic_ps_service=None,
         paral_config_service=None,
+        metric_collector=None,
     ):
         self._task_manager = task_manager
         self._job_manager = job_manager
@@ -54,6 +55,7 @@ class MasterServicer:
         self._speed_monitor = speed_monitor
         self._elastic_ps_service = elastic_ps_service
         self._paral_config_service = paral_config_service
+        self._metric_collector = metric_collector
         self._lock = threading.Lock()
         self._node_addrs: dict = {}  # node_type -> {rank: addr}
         self._ckpt_steps: dict = {}  # node_id -> latest in-memory ckpt step
@@ -105,6 +107,10 @@ class MasterServicer:
         if isinstance(message, comm.KeyValueQuery):
             value = self._kv_store.get(message.key) if self._kv_store else b""
             return comm.KeyValuePair(key=message.key, value=value)
+        if isinstance(message, comm.JobMetricsRequest):
+            if self._metric_collector is None:
+                return comm.JobMetrics()
+            return self._metric_collector.snapshot(message.last_n)
         if isinstance(message, comm.KeyValueWait):
             ok = (
                 self._kv_store.wait(message.keys, message.timeout)
@@ -271,6 +277,12 @@ class MasterServicer:
                     req.node_type or "worker", message.node_id
                 )
             return comm.HeartbeatResponse(action=action)
+        if isinstance(message, comm.StreamingDataReport):
+            if self._task_manager:
+                return self._task_manager.report_streaming_data(
+                    message.dataset_name, message.new_records, message.end
+                )
+            return False
         if isinstance(message, comm.ResourceStats):
             if self._job_manager:
                 self._job_manager.update_node_resource_usage(

@@ -110,6 +110,65 @@ class TextDatasetSplitter(DatasetSplitter):
         return shards
 
 
+class StreamingDatasetSplitter(DatasetSplitter):
+    """Unbounded dataset: shards are carved up to a watermark that grows
+    as the producer reports new records (parity:
+    dataset_splitter.py:359 StreamingDatasetSplitter, whose partition
+    offsets come from a message queue; here the producer reports counts
+    over the same RPC the rest of the shard machinery uses).
+
+    A partial tail shard is only emitted after ``end_stream()`` — until
+    then it may still fill up.
+    """
+
+    def __init__(
+        self,
+        dataset_name: str,
+        shard_size: int,
+        dataset_size: int = -1,
+    ):
+        super().__init__(dataset_name, dataset_size, shard_size, 1)
+        self._watermark = max(0, dataset_size)
+        self._next = 0
+        self._ended = False
+
+    def add_records(self, count: int):
+        if count > 0:
+            self._watermark += count
+
+    def end_stream(self):
+        self._ended = True
+
+    @property
+    def ended(self) -> bool:
+        return self._ended
+
+    def create_shards(self) -> List[Shard]:
+        shards = []
+        while self._next + self.shard_size <= self._watermark:
+            shards.append(
+                Shard(
+                    name=self.dataset_name,
+                    start=self._next,
+                    end=self._next + self.shard_size,
+                )
+            )
+            self._next += self.shard_size
+        if self._ended and self._next < self._watermark:
+            shards.append(
+                Shard(
+                    name=self.dataset_name,
+                    start=self._next,
+                    end=self._watermark,
+                )
+            )
+            self._next = self._watermark
+        return shards
+
+    def epoch_finished(self) -> bool:
+        return self._ended and self._next >= self._watermark
+
+
 def new_dataset_splitter(
     shuffle: bool,
     shard_size: int,
@@ -122,6 +181,10 @@ def new_dataset_splitter(
     if storage_type == "table":
         return TableDatasetSplitter(
             dataset_name, dataset_size, shard_size, num_epochs, shuffle
+        )
+    if storage_type == "stream":
+        return StreamingDatasetSplitter(
+            dataset_name, shard_size, dataset_size
         )
     return TextDatasetSplitter(
         dataset_name, dataset_size, shard_size, num_epochs, shuffle

@@ -19,9 +19,11 @@ from dlrover_tpu.common.comm import (
     Shard,
     Task,
 )
+from dlrover_tpu.common.constants import TaskType
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.shard.dataset_splitter import (
     DatasetSplitter,
+    StreamingDatasetSplitter,
     new_dataset_splitter,
 )
 
@@ -48,10 +50,8 @@ class BatchDatasetManager:
     def dataset_name(self) -> str:
         return self._splitter.dataset_name
 
-    def _create_tasks_of_epoch(self) -> bool:
-        if self._splitter.epoch_finished():
-            return False
-        for shard in self._splitter.create_shards():
+    def _enqueue_shards(self, shards):
+        for shard in shards:
             self.todo.append(
                 Task(
                     task_id=self._task_id,
@@ -60,6 +60,11 @@ class BatchDatasetManager:
                 )
             )
             self._task_id += 1
+
+    def _create_tasks_of_epoch(self) -> bool:
+        if self._splitter.epoch_finished():
+            return False
+        self._enqueue_shards(self._splitter.create_shards())
         return True
 
     def get_task(self, node_id: int) -> Task:
@@ -142,6 +147,57 @@ class BatchDatasetManager:
             self._task_id += 1
 
 
+class StreamingDatasetManager(BatchDatasetManager):
+    """Unbounded dataset fed by a producer (parity:
+    streaming_dataset_manager.py:204). Differences from the batch
+    manager: shards materialize as the watermark advances, and a dry
+    todo queue while the stream is open yields a WAIT task (retry
+    signal) instead of the empty task that means "exhausted"."""
+
+    @property
+    def splitter(self) -> StreamingDatasetSplitter:
+        return self._splitter  # typed accessor
+
+    def add_records(self, count: int):
+        self._splitter.add_records(count)
+
+    def end_stream(self):
+        self._splitter.end_stream()
+
+    def get_task(self, node_id: int) -> Task:
+        if not self.todo:
+            self._enqueue_shards(self._splitter.create_shards())
+        if not self.todo:
+            if self._splitter.epoch_finished():
+                # stream closed and fully carved: exhausted for consumers
+                # (in-flight shards may still be recovered into todo if
+                # their worker dies, same as the batch manager)
+                return Task()
+            return Task(task_type=TaskType.WAIT)
+        task = self.todo.pop(0)
+        self.doing[task.task_id] = _DoingTask(task, node_id)
+        return task
+
+    # -- shard checkpoint ----------------------------------------------
+    def checkpoint(self) -> Dict:
+        ckpt = super().checkpoint()
+        ckpt["stream"] = {
+            "next": self._splitter._next,
+            "watermark": self._splitter._watermark,
+            "ended": self._splitter._ended,
+        }
+        return ckpt
+
+    def restore_checkpoint(self, ckpt: Dict):
+        super().restore_checkpoint(ckpt)
+        stream = ckpt.get("stream", {})
+        self._splitter._next = stream.get("next", 0)
+        self._splitter._watermark = stream.get(
+            "watermark", self._splitter._watermark
+        )
+        self._splitter._ended = stream.get("ended", False)
+
+
 class TaskManager:
     """All datasets of a job (parity: task_manager.py:37)."""
 
@@ -150,6 +206,9 @@ class TaskManager:
         self._datasets: Dict[str, BatchDatasetManager] = {}
         self._speed_monitor = speed_monitor
         self._worker_start_task_time: Dict[int, float] = {}
+        # producer reports that arrived before the consumer registered the
+        # streaming dataset: (records, ended) buffered per name
+        self._pending_stream: Dict[str, Tuple[int, bool]] = {}
 
     def new_dataset(self, params: DatasetShardParams):
         with self._lock:
@@ -166,9 +225,48 @@ class TaskManager:
                 dataset_name=params.dataset_name,
                 storage_type=params.storage_type,
             )
-            self._datasets[params.dataset_name] = BatchDatasetManager(
-                splitter, params.task_type or "train"
+            manager_cls = (
+                StreamingDatasetManager
+                if isinstance(splitter, StreamingDatasetSplitter)
+                else BatchDatasetManager
             )
+            ds = manager_cls(splitter, params.task_type or TaskType.TRAIN)
+            self._datasets[params.dataset_name] = ds
+            if isinstance(ds, StreamingDatasetManager):
+                records, ended = self._pending_stream.pop(
+                    params.dataset_name, (0, False)
+                )
+                if records:
+                    ds.add_records(records)
+                if ended:
+                    ds.end_stream()
+
+    def report_streaming_data(
+        self, dataset_name: str, new_records: int = 0, end: bool = False
+    ) -> bool:
+        """Producer side of a streaming dataset: advance the watermark /
+        close the stream. Reports that race ahead of the consumer's
+        dataset registration are buffered, not rejected (a rejected
+        report would surface as an error on the producer and lose the
+        records)."""
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                records, ended = self._pending_stream.get(
+                    dataset_name, (0, False)
+                )
+                self._pending_stream[dataset_name] = (
+                    records + max(0, new_records),
+                    ended or end,
+                )
+                return True
+            if not isinstance(ds, StreamingDatasetManager):
+                return False
+            if new_records:
+                ds.add_records(new_records)
+            if end:
+                ds.end_stream()
+            return True
 
     def get_dataset_task(self, node_id: int, dataset_name: str) -> Task:
         with self._lock:

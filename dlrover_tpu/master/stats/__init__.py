@@ -1,0 +1,5 @@
+"""Master-side job statistics (parity: dlrover/python/master/stats/)."""
+
+from dlrover_tpu.master.stats.collector import (  # noqa: F401
+    JobMetricCollector,
+)

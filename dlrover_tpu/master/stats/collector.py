@@ -1,0 +1,71 @@
+"""Job metric collector: runtime stats series for operators and the
+Brain seam.
+
+Parity: dlrover/python/master/stats/job_collector.py:177
+(JobMetricCollector periodically collects node resource usage + training
+speed and hands them to a reporter) and reporter.py:233 (LocalStatsReporter
+vs BrainReporter). The TPU build keeps the same two pieces:
+
+- ``JobMetricCollector`` samples the SpeedMonitor and the job manager's
+  node table on a cadence into a bounded in-memory series, queryable over
+  the master RPC (``JobMetricsRequest``);
+- the ``reporter`` callable is the Brain seam — by default it stores
+  locally; a Brain-backed reporter would POST the same samples to the
+  cluster service (reference brain.proto:196 persist_metrics).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Deque, Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.daemon import PollingDaemon
+
+
+class JobMetricCollector(PollingDaemon):
+    def __init__(
+        self,
+        job_manager,
+        speed_monitor,
+        interval: float = 30.0,
+        max_samples: int = 512,
+        reporter: Optional[Callable[[comm.JobMetricsSample], None]] = None,
+    ):
+        super().__init__("job-metric-collector", interval)
+        self._job_manager = job_manager
+        self._speed_monitor = speed_monitor
+        self._samples: Deque[comm.JobMetricsSample] = deque(
+            maxlen=max_samples
+        )
+        self._reporter = reporter
+
+    def collect(self) -> comm.JobMetricsSample:
+        nodes = self._job_manager.get_nodes() if self._job_manager else []
+        running = [n for n in nodes if not n.is_released]
+        sample = comm.JobMetricsSample(
+            timestamp=time.time(),
+            global_step=self._speed_monitor.completed_global_step,
+            steps_per_sec=self._speed_monitor.running_speed(),
+            alive_nodes=len(running),
+            total_cpu_percent=sum(
+                n.used_resource.cpu for n in running
+            ),
+            total_memory_mb=sum(
+                n.used_resource.memory_mb for n in running
+            ),
+        )
+        self._samples.append(sample)
+        if self._reporter is not None:
+            self._reporter(sample)
+        return sample
+
+    def _tick(self):
+        self.collect()
+
+    def snapshot(self, last_n: int = 0) -> comm.JobMetrics:
+        samples = list(self._samples)
+        if last_n:
+            samples = samples[-last_n:]
+        return comm.JobMetrics(samples=samples)

@@ -1,0 +1,192 @@
+"""Streaming dataset dispatch + job metric collection.
+
+Parity: the reference's streaming_dataset_manager tests (watermark-driven
+shard creation, wait-vs-exhausted semantics) and job_collector tests.
+"""
+
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.sharding_client import ShardingClient
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.master.local_master import start_local_master
+from dlrover_tpu.master.shard.dataset_splitter import (
+    StreamingDatasetSplitter,
+)
+from dlrover_tpu.master.shard.task_manager import (
+    StreamingDatasetManager,
+    TaskManager,
+)
+
+
+class TestStreamingSplitter:
+    def test_watermark_carving(self):
+        sp = StreamingDatasetSplitter("s", shard_size=10)
+        assert sp.create_shards() == []
+        sp.add_records(25)
+        shards = sp.create_shards()
+        assert [(s.start, s.end) for s in shards] == [(0, 10), (10, 20)]
+        # partial tail is held back until the stream ends
+        assert sp.create_shards() == []
+        assert not sp.epoch_finished()
+        sp.end_stream()
+        shards = sp.create_shards()
+        assert [(s.start, s.end) for s in shards] == [(20, 25)]
+        assert sp.epoch_finished()
+
+
+class TestStreamingManager:
+    def _manager(self, shard_size=10):
+        return StreamingDatasetManager(
+            StreamingDatasetSplitter("s", shard_size=shard_size)
+        )
+
+    def test_wait_then_dispatch_then_complete(self):
+        m = self._manager()
+        task = m.get_task(node_id=0)
+        assert task.task_type == TaskType.WAIT and task.is_empty
+        assert not m.completed()
+
+        m.add_records(10)
+        task = m.get_task(node_id=0)
+        assert (task.shard.start, task.shard.end) == (0, 10)
+        m.end_stream()
+        # in-flight shard keeps the dataset incomplete
+        assert not m.completed()
+        nxt = m.get_task(node_id=0)
+        assert nxt.task_type != TaskType.WAIT and nxt.is_empty
+        m.report_task_done(task.task_id)
+        assert m.completed()
+
+    def test_checkpoint_preserves_stream_state(self):
+        """Master restart mid-stream must not recarve old offsets or
+        forget that the stream ended."""
+        m = self._manager()
+        m.add_records(25)
+        t1 = m.get_task(node_id=0)
+        m.report_task_done(t1.task_id)
+        m.end_stream()
+        ckpt = m.checkpoint()
+
+        m2 = self._manager()
+        m2.restore_checkpoint(ckpt)
+        got = []
+        while True:
+            t = m2.get_task(node_id=0)
+            if t.is_empty and t.task_type != TaskType.WAIT:
+                break
+            got.append((t.shard.start, t.shard.end))
+            m2.report_task_done(t.task_id)
+        # shard (0,10) was already done before the restart; the rest,
+        # including the tail unlocked by the remembered end_stream, flows
+        assert got == [(10, 20), (20, 25)]
+        assert m2.completed()
+
+    def test_report_before_registration_is_buffered(self):
+        tm = TaskManager()
+        assert tm.report_streaming_data("early", new_records=7)
+        assert tm.report_streaming_data("early", new_records=3, end=True)
+        from dlrover_tpu.common.comm import DatasetShardParams
+
+        tm.new_dataset(
+            DatasetShardParams(
+                dataset_name="early",
+                batch_size=5,
+                num_minibatches_per_shard=1,
+                storage_type="stream",
+            )
+        )
+        t = tm.get_dataset_task(0, "early")
+        assert (t.shard.start, t.shard.end) == (0, 5)
+        t2 = tm.get_dataset_task(0, "early")
+        assert (t2.shard.start, t2.shard.end) == (5, 10)
+
+    def test_dead_node_shard_recovered(self):
+        m = self._manager()
+        m.add_records(10)
+        task = m.get_task(node_id=3)
+        m.recover_tasks_of_node(3)
+        again = m.get_task(node_id=4)
+        assert (again.shard.start, again.shard.end) == (
+            task.shard.start,
+            task.shard.end,
+        )
+
+
+class TestStreamingEndToEnd:
+    def test_producer_consumer_over_rpc(self):
+        master = start_local_master(node_num=1)
+        client = MasterClient(master.addr, node_id=0)
+        try:
+            sc = ShardingClient(
+                client,
+                dataset_name="stream-ds",
+                batch_size=5,
+                storage_type="stream",
+                num_minibatches_per_shard=1,
+            )
+            # producer (could be any node) feeds the watermark over RPC
+            client.report_streaming_data("stream-ds", new_records=10)
+            got = []
+            shard = sc.fetch_shard(timeout=10)
+            assert shard is not None
+            got.append((shard.start, shard.end))
+            sc.report_shard_done()
+            client.report_streaming_data("stream-ds", new_records=3)
+            client.report_streaming_data("stream-ds", end=True)
+            while True:
+                shard = sc.fetch_shard(timeout=10)
+                if shard is None:
+                    break
+                got.append((shard.start, shard.end))
+                sc.report_shard_done()
+            assert got == [(0, 5), (5, 10), (10, 13)]
+            assert master.task_manager.finished()
+        finally:
+            client.close()
+            master.stop()
+
+
+class TestJobMetrics:
+    def test_collector_snapshot_over_rpc(self):
+        master = start_local_master(node_num=2)
+        client = MasterClient(master.addr, node_id=0)
+        try:
+            master.speed_monitor.collect_global_step(5, time.time() - 1)
+            master.speed_monitor.collect_global_step(25)
+            node = master.job_manager.get_node("worker", 0)
+            node.used_resource.cpu = 120.0
+            node.used_resource.memory_mb = 2048
+            master.metric_collector.collect()
+
+            metrics = client.get_job_metrics()
+            assert len(metrics.samples) == 1
+            s = metrics.samples[-1]
+            assert s.global_step == 25
+            assert s.steps_per_sec > 0
+            assert s.alive_nodes == 2
+            assert s.total_memory_mb == 2048
+        finally:
+            client.close()
+            master.stop()
+
+    def test_reporter_seam(self):
+        """The Brain seam: a custom reporter receives every sample."""
+        from dlrover_tpu.master.stats.collector import JobMetricCollector
+
+        received = []
+
+        class _SM:
+            completed_global_step = 3
+
+            def running_speed(self):
+                return 1.5
+
+        c = JobMetricCollector(
+            None, _SM(), reporter=received.append
+        )
+        c.collect()
+        assert len(received) == 1 and received[0].global_step == 3

@@ -52,15 +52,19 @@ class ShardingClient:
         self, wait_interval: float = 0.5, timeout: float = 0.0
     ) -> Optional[comm.Shard]:
         """Get the next shard; None when the dataset is exhausted.
-        Streaming datasets answer WAIT while the producer is ahead of the
+        Streaming datasets answer WAIT while the producer is behind the
         consumer — retry until a shard lands or ``timeout`` (0 = forever)
-        expires."""
+        expires, then raise TimeoutError: a slow producer must not be
+        mistaken for end-of-dataset."""
         deadline = time.time() + timeout if timeout else None
         while True:
             task = self._client.get_task(self.dataset_name)
             if task.task_type == TaskType.WAIT:
                 if deadline and time.time() > deadline:
-                    return None
+                    raise TimeoutError(
+                        f"no shard of {self.dataset_name} within "
+                        f"{timeout}s (stream producer stalled?)"
+                    )
                 time.sleep(wait_interval)
                 continue
             if task.is_empty:

@@ -24,6 +24,9 @@ class Context:
         self.seconds_to_wait_pending_pod: int = 900
         self.seconds_huge_training_threshold: int = 1800
         self.hang_detection_secs: int = 1800
+        # how long a streaming-data WAIT may suppress hang handling; past
+        # this, a silent producer is treated like any other stall
+        self.data_starvation_timeout_secs: int = 3600
         self.rdzv_timeout_secs: int = 600
         self.network_check_timeout_secs: int = 300
         self.straggler_time_ratio: float = 2.0

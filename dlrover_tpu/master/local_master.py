@@ -14,6 +14,7 @@ from typing import Optional
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import JobExitReason, RendezvousName
+from dlrover_tpu.common.global_context import Context
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.elastic_ps import ElasticPsService
 from dlrover_tpu.master.job_auto_scaler import JobAutoScaler
@@ -29,6 +30,8 @@ from dlrover_tpu.master.servicer import MasterServicer, create_master_service
 from dlrover_tpu.master.shard.task_manager import TaskManager
 from dlrover_tpu.master.stats.collector import JobMetricCollector
 from dlrover_tpu.master.sync_service import SyncService
+
+_ctx = Context.singleton_instance()
 
 
 class LocalJobMaster:
@@ -108,7 +111,16 @@ class LocalJobMaster:
             if self.task_manager.finished():
                 logger.info("all dataset tasks completed")
                 return JobExitReason.SUCCEEDED
-            if self.job_manager.all_running_node_hanged():
+            if self.job_manager.all_running_node_hanged() and not (
+                # data starvation is not a hang: consumers parked on a
+                # streaming WAIT make no step progress by design. Bounded:
+                # a producer dead past the starvation timeout surfaces as
+                # a stall again.
+                self.task_manager.waiting_for_data(
+                    _ctx.hang_detection_secs,
+                    _ctx.data_starvation_timeout_secs,
+                )
+            ):
                 # only *fruitless* restarts count: progress since the last
                 # hang resets the budget, so transient hangs days apart on
                 # a long job never add up to a kill

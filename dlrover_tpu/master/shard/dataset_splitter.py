@@ -139,10 +139,6 @@ class StreamingDatasetSplitter(DatasetSplitter):
     def end_stream(self):
         self._ended = True
 
-    @property
-    def ended(self) -> bool:
-        return self._ended
-
     def create_shards(self) -> List[Shard]:
         shards = []
         while self._next + self.shard_size <= self._watermark:

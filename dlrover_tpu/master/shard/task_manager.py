@@ -154,10 +154,6 @@ class StreamingDatasetManager(BatchDatasetManager):
     todo queue while the stream is open yields a WAIT task (retry
     signal) instead of the empty task that means "exhausted"."""
 
-    @property
-    def splitter(self) -> StreamingDatasetSplitter:
-        return self._splitter  # typed accessor
-
     def add_records(self, count: int):
         self._splitter.add_records(count)
 
@@ -190,12 +186,16 @@ class StreamingDatasetManager(BatchDatasetManager):
 
     def restore_checkpoint(self, ckpt: Dict):
         super().restore_checkpoint(ckpt)
+        # defaults are the splitter's CURRENT values: a checkpoint without
+        # stream state (written under a non-stream registration, or an
+        # older build) must not reset _next to 0 and re-carve consumed
+        # offsets on top of the restored todo shards
         stream = ckpt.get("stream", {})
-        self._splitter._next = stream.get("next", 0)
+        self._splitter._next = stream.get("next", self._splitter._next)
         self._splitter._watermark = stream.get(
             "watermark", self._splitter._watermark
         )
-        self._splitter._ended = stream.get("ended", False)
+        self._splitter._ended = stream.get("ended", self._splitter._ended)
 
 
 class TaskManager:
@@ -209,6 +209,9 @@ class TaskManager:
         # producer reports that arrived before the consumer registered the
         # streaming dataset: (records, ended) buffered per name
         self._pending_stream: Dict[str, Tuple[int, bool]] = {}
+        # per-dataset (first, last) WAIT timestamps of the CURRENT
+        # continuous starvation period; cleared when a real shard ships
+        self._wait_spans: Dict[str, Tuple[float, float]] = {}
 
     def new_dataset(self, params: DatasetShardParams):
         with self._lock:
@@ -232,14 +235,19 @@ class TaskManager:
             )
             ds = manager_cls(splitter, params.task_type or TaskType.TRAIN)
             self._datasets[params.dataset_name] = ds
+            pending = self._pending_stream.pop(params.dataset_name, None)
             if isinstance(ds, StreamingDatasetManager):
-                records, ended = self._pending_stream.pop(
-                    params.dataset_name, (0, False)
-                )
+                records, ended = pending or (0, False)
                 if records:
                     ds.add_records(records)
                 if ended:
                     ds.end_stream()
+            elif pending is not None:
+                logger.warning(
+                    f"dataset {params.dataset_name} registered as "
+                    f"{params.storage_type!r} but has buffered streaming "
+                    f"reports ({pending[0]} records) — dropping them"
+                )
 
     def report_streaming_data(
         self, dataset_name: str, new_records: int = 0, end: bool = False
@@ -252,6 +260,15 @@ class TaskManager:
         with self._lock:
             ds = self._datasets.get(dataset_name)
             if ds is None:
+                if (
+                    dataset_name not in self._pending_stream
+                    and len(self._pending_stream) >= 256
+                ):
+                    logger.warning(
+                        f"dropping streaming report for {dataset_name}: "
+                        f"pre-registration buffer full"
+                    )
+                    return False
                 records, ended = self._pending_stream.get(
                     dataset_name, (0, False)
                 )
@@ -274,7 +291,37 @@ class TaskManager:
             if ds is None:
                 return Task()
             self._worker_start_task_time[node_id] = time.time()
-            return ds.get_task(node_id)
+            task = ds.get_task(node_id)
+            now = time.time()
+            if task.task_type == TaskType.WAIT:
+                first, _ = self._wait_spans.get(dataset_name, (now, now))
+                self._wait_spans[dataset_name] = (first, now)
+            else:
+                self._wait_spans.pop(dataset_name, None)
+            return task
+
+    def waiting_for_data(
+        self, within_secs: float, max_starvation_secs: float = 0.0
+    ) -> bool:
+        """True if a consumer was recently told WAIT on some dataset:
+        data-starved (streaming producer behind), which must not read as
+        a training hang. The suppression is BOUNDED: once a dataset's
+        continuous starvation exceeds ``max_starvation_secs`` (0 = no
+        bound) it no longer counts — a producer that died silently must
+        eventually surface as a stall, not idle the job forever."""
+        now = time.time()
+        for name, (first, last) in list(self._wait_spans.items()):
+            if now - last >= within_secs:
+                continue
+            if max_starvation_secs and now - first > max_starvation_secs:
+                logger.warning(
+                    f"dataset {name} data-starved for {now - first:.0f}s "
+                    f"(> {max_starvation_secs:.0f}s); no longer "
+                    f"suppressing hang handling"
+                )
+                continue
+            return True
+        return False
 
     def report_dataset_task(
         self, dataset_name: str, task_id: int, success: bool = True

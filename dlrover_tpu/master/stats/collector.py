@@ -42,8 +42,11 @@ class JobMetricCollector(PollingDaemon):
         self._reporter = reporter
 
     def collect(self) -> comm.JobMetricsSample:
-        nodes = self._job_manager.get_nodes() if self._job_manager else []
-        running = [n for n in nodes if not n.is_released]
+        running = (
+            self._job_manager.get_running_nodes()
+            if self._job_manager
+            else []
+        )
         sample = comm.JobMetricsSample(
             timestamp=time.time(),
             global_step=self._speed_monitor.completed_global_step,

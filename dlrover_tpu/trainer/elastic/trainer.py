@@ -1,0 +1,161 @@
+"""ElasticTrainer: the user-facing training loop.
+
+Parity: dlrover/trainer/torch/elastic/trainer.py:48 (ElasticTrainer
+wrapping model/optimizer/dataloader for elasticity) and ATorch's
+HF-style ``AtorchTrainer`` (atorch/trainer/atorch_trainer.py:127). One
+facade owns the full elastic story so a user train script collapses to
+~30 lines:
+
+- strategy: an explicit ``Strategy`` or the auto_accelerate search picks
+  the mesh/remat/microbatching (donation off — flash staging reads the
+  state after the step);
+- data: ``ElasticDataLoader`` + ``ElasticDistributedSampler`` (resumes
+  mid-epoch across world-size changes, honors master-retuned batch size);
+- checkpoint: flash save every ``save_memory_interval`` steps (ms-scale,
+  shm), persisted every ``save_storage_interval`` steps; sampler state
+  rides the train state so restore is exactly-once over the data;
+- monitoring: every step publishes the global step for the agent's
+  TrainingMonitor (feeds master hang detection / auto-scaling).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from dlrover_tpu.accel.accelerate import AccelerateResult, auto_accelerate
+from dlrover_tpu.accel.strategy import Strategy
+from dlrover_tpu.agent.monitor import report_runtime_metrics
+from dlrover_tpu.ckpt.checkpointer import FlashCheckpointer, StorageType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.models.config import TransformerConfig
+from dlrover_tpu.models.train import shard_batch
+from dlrover_tpu.trainer.elastic.dataloader import ElasticDataLoader
+from dlrover_tpu.trainer.elastic.sampler import ElasticDistributedSampler
+
+
+@dataclass
+class TrainerConfig:
+    batch_size: int = 8
+    seq_len: int = 128
+    ckpt_dir: str = ""
+    save_memory_interval: int = 50
+    save_storage_interval: int = 500
+    report_metrics: bool = True
+    log_interval: int = 10
+
+
+class ElasticTrainer:
+    def __init__(
+        self,
+        model_cfg: TransformerConfig,
+        tx,
+        dataset,
+        trainer_cfg: Optional[TrainerConfig] = None,
+        strategy: Optional[Strategy] = None,
+        devices=None,
+        collate_fn: Optional[Callable] = None,
+        metrics_hook: Optional[Callable[[int, Dict], None]] = None,
+    ):
+        import jax
+
+        self.tcfg = trainer_cfg or TrainerConfig()
+        self._metrics_hook = metrics_hook
+        # async flash staging reads state buffers after the step returns,
+        # so the production step must NOT donate them
+        self.accel: AccelerateResult = auto_accelerate(
+            model_cfg,
+            tx,
+            batch=self.tcfg.batch_size,
+            seq=self.tcfg.seq_len,
+            devices=devices,
+            strategy=strategy,
+            donate=False,
+        )
+        self.cfg = self.accel.cfg
+        self.mesh = self.accel.mesh
+        self._step_fn = self.accel.step_fn
+        self.state = self.accel.init_fn(jax.random.PRNGKey(0))
+
+        self.sampler = ElasticDistributedSampler(
+            len(dataset), shuffle=True
+        )
+        self.dataloader = ElasticDataLoader(
+            dataset,
+            batch_size=self.tcfg.batch_size,
+            sampler=self.sampler,
+            collate_fn=collate_fn,
+        )
+        self._ckptr: Optional[FlashCheckpointer] = None
+        if self.tcfg.ckpt_dir:
+            self._ckptr = FlashCheckpointer(self.tcfg.ckpt_dir)
+            self._maybe_restore()
+
+    # -- checkpoint ----------------------------------------------------
+    def _ckpt_state(self):
+        return {"train": self.state, "sampler": self.sampler.state_dict()}
+
+    def _maybe_restore(self):
+        step, restored = self._ckptr.load_checkpoint(self._ckpt_state())
+        if restored is not None and step >= 0:
+            self.state = restored["train"]
+            self.sampler.load_state_dict(restored["sampler"])
+            logger.info(f"resumed from flash checkpoint step {step}")
+
+    def save(self, storage: StorageType = StorageType.MEMORY) -> bool:
+        if self._ckptr is None:
+            return False
+        return self._ckptr.save_checkpoint(
+            self.global_step, self._ckpt_state(), storage
+        )
+
+    # -- loop ----------------------------------------------------------
+    @property
+    def global_step(self) -> int:
+        return int(self.state.step)
+
+    def _device_batch(self, batch):
+        if self.accel.strategy.mesh.pp > 1:
+            return batch["x"], batch["y"]  # pipeline step takes host arrays
+        sharded = shard_batch(batch, self.mesh)
+        return sharded["x"], sharded["y"]
+
+    def train(self, num_steps: int) -> Any:
+        """Run up to ``num_steps`` optimizer steps (across epochs)."""
+        import jax
+
+        t0 = time.time()
+        while self.global_step < num_steps:
+            self.dataloader.load_config()  # master-retuned batch size
+            for batch in self.dataloader:
+                if self.global_step >= num_steps:
+                    break
+                x, y = self._device_batch(batch)
+                self.state, metrics = self._step_fn(self.state, x, y)
+                step = self.global_step
+                if self.tcfg.report_metrics:
+                    report_runtime_metrics(
+                        step, loss=float(metrics["loss"])
+                    )
+                if self._metrics_hook is not None:
+                    self._metrics_hook(step, metrics)
+                if step % self.tcfg.log_interval == 0:
+                    logger.info(
+                        f"step {step}: loss={float(metrics['loss']):.4f} "
+                        f"({step / max(time.time() - t0, 1e-9):.2f} it/s)"
+                    )
+                if self._ckptr is not None:
+                    if step % self.tcfg.save_storage_interval == 0:
+                        self.save(StorageType.DISK)
+                    elif step % self.tcfg.save_memory_interval == 0:
+                        self.save(StorageType.MEMORY)
+            self.sampler.set_epoch(self.sampler.epoch + 1)
+        jax.block_until_ready(self.state.params)
+        return self.state
+
+    def close(self):
+        if self._ckptr is not None:
+            self._ckptr.engine.close()

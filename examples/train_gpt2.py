@@ -1,0 +1,47 @@
+"""Minimal elastic GPT-2 training with dlrover-tpu.
+
+Run single-host:
+    dlrover-tpu-run --nproc-per-node=1 examples/train_gpt2.py
+
+Everything elastic — strategy search, sharding, flash checkpointing,
+mid-epoch resume, master-driven batch-size retuning, hang/failure
+recovery — lives behind ElasticTrainer.
+"""
+
+import numpy as np
+import optax
+
+from dlrover_tpu.models import gpt2_small
+from dlrover_tpu.trainer.elastic.trainer import ElasticTrainer, TrainerConfig
+
+
+class RandomTokens:
+    """Stand-in corpus: replace with your tokenized dataset."""
+
+    def __init__(self, n=4096, seq=128, vocab=50257, seed=0):
+        self.rng = np.random.default_rng(seed)
+        self.data = self.rng.integers(0, vocab, (n, seq + 1), dtype=np.int32)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        row = self.data[i]
+        return {"x": row[:-1], "y": row[1:]}
+
+
+def main():
+    trainer = ElasticTrainer(
+        model_cfg=gpt2_small(),
+        tx=optax.adamw(3e-4, weight_decay=0.01),
+        dataset=RandomTokens(),
+        trainer_cfg=TrainerConfig(
+            batch_size=8, seq_len=128, ckpt_dir="/tmp/gpt2_flash_ckpt"
+        ),
+    )
+    trainer.train(num_steps=1000)
+    trainer.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -155,8 +155,14 @@ class TestJobMetrics:
         master = start_local_master(node_num=2)
         client = MasterClient(master.addr, node_id=0)
         try:
+            from dlrover_tpu.common.constants import NodeStatus
+
             master.speed_monitor.collect_global_step(5, time.time() - 1)
             master.speed_monitor.collect_global_step(25)
+            for i in range(2):
+                master.job_manager.get_node("worker", i).update_status(
+                    NodeStatus.RUNNING
+                )
             node = master.job_manager.get_node("worker", 0)
             node.used_resource.cpu = 120.0
             node.used_resource.memory_mb = 2048

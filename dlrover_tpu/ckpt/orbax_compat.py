@@ -33,23 +33,28 @@ def export_to_orbax(state: Any, path: str, force: bool = True) -> None:
     logger.info(f"exported orbax checkpoint to {path}")
 
 
+def _abstract_tree(target: Any):
+    """target pytree → ShapeDtypeStructs carrying the leaves' shardings
+    (concrete or abstract arrays both work); drives restore placement."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+        ),
+        target,
+    )
+
+
 def load_from_orbax(path: str, target: Any) -> Any:
     """Restore an orbax checkpoint into ``target``'s structure and
     shardings (pass abstract arrays or concrete arrays; their shardings
     drive placement)."""
-    import jax
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
-
-    def as_abstract(x):
-        return jax.ShapeDtypeStruct(
-            x.shape, x.dtype, sharding=getattr(x, "sharding", None)
-        )
-
-    abstract = jax.tree_util.tree_map(as_abstract, target)
     with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(path, abstract)
+        return ckptr.restore(path, _abstract_tree(target))
 
 
 class OrbaxCheckpointer(Checkpointer):
@@ -84,21 +89,13 @@ class OrbaxCheckpointer(Checkpointer):
         return bool(ok)
 
     def load_checkpoint(self, target: Any) -> Tuple[int, Optional[Any]]:
-        import jax
         import orbax.checkpoint as ocp
 
         step = self._manager.latest_step()
         if step is None:
             return -1, None
-
-        def as_abstract(x):
-            return jax.ShapeDtypeStruct(
-                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
-            )
-
-        abstract = jax.tree_util.tree_map(as_abstract, target)
         state = self._manager.restore(
-            step, args=ocp.args.StandardRestore(abstract)
+            step, args=ocp.args.StandardRestore(_abstract_tree(target))
         )
         return step, state
 

@@ -310,7 +310,9 @@ class TaskManager:
         bound) it no longer counts — a producer that died silently must
         eventually surface as a stall, not idle the job forever."""
         now = time.time()
-        for name, (first, last) in list(self._wait_spans.items()):
+        with self._lock:
+            spans = list(self._wait_spans.items())
+        for name, (first, last) in spans:
             if now - last >= within_secs:
                 continue
             if max_starvation_secs and now - first > max_starvation_secs:

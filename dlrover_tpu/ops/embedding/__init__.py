@@ -1,0 +1,10 @@
+"""Native (C++) hash-table embedding store for elastic sparse training.
+
+Parity: TFPlus KvVariable stack (SURVEY §2.4) — see store.py and
+kv_store.cc for the component mapping.
+"""
+
+from dlrover_tpu.ops.embedding.store import (  # noqa: F401
+    KvEmbeddingStore,
+    ShardedKvEmbedding,
+)

@@ -1,0 +1,332 @@
+// KvEmbeddingStore: native hash-table embedding store for elastic sparse
+// training on TPU hosts.
+//
+// Parity: tfplus KvVariable (tfplus/tfplus/kv_variable/kernels/
+// kv_variable_ops.cc:1164, kv_variable.h:1021, hashmap.h:1030) and its
+// fused sparse optimizers (kernels/training_ops.cc). Re-designed for the
+// TPU recommender shape: the table lives in HOST memory (TPU HBM holds
+// the dense model; embedding rows are gathered host-side and fed to the
+// chip per step), so the native layer is a plain shared library driven
+// through ctypes — no TF op registry, no resource-variable machinery.
+//
+// Design:
+// - NUM_BUCKETS internal shards, each its own mutex + open hash map:
+//   concurrent gathers/updates from data-loader threads don't serialize.
+// - A row = [value(dim) | slot_0(dim) | ... ]: optimizer slots
+//   (Adagrad/Momentum accumulators) live beside the value, so a fused
+//   sparse update touches one cache-resident row (the reference keeps
+//   slots in separate KvVariables and pays two lookups).
+// - Every row carries frequency, last-access timestamp and the global
+//   mutation version at its last write: full export = export(since=0),
+//   delta export = export(since=v) (parity: FullOrDeltaImport/Export
+//   ops, kv_variable_ops.cc:733) — the primitive elastic resharding and
+//   incremental checkpoints are built on.
+// - Missing keys on gather are initialized from a splitmix64 hash of
+//   (seed, key): deterministic across shards/restarts, no RNG state.
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kNumBuckets = 64;
+
+struct Row {
+  std::vector<float> data;  // dim * (1 + num_slots)
+  int64_t freq = 0;
+  int64_t ts = 0;
+  uint64_t version = 0;
+};
+
+struct Bucket {
+  std::mutex mu;
+  std::unordered_map<int64_t, Row> map;
+};
+
+struct Store {
+  int64_t dim;
+  int num_slots;
+  uint64_t seed;
+  float init_scale;
+  Bucket buckets[kNumBuckets];
+  std::mutex version_mu;
+  uint64_t version = 0;  // global mutation counter
+
+  uint64_t next_version() {
+    std::lock_guard<std::mutex> g(version_mu);
+    return ++version;
+  }
+  int64_t row_floats() const { return dim * (1 + num_slots); }
+  Bucket& bucket(int64_t key) {
+    // splitmix-style mix so sequential ids spread across buckets
+    uint64_t h = static_cast<uint64_t>(key) * 0x9E3779B97F4A7C15ULL;
+    return buckets[(h >> 32) % kNumBuckets];
+  }
+};
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+void init_row(const Store* s, int64_t key, float* out) {
+  // deterministic pseudo-normal init (sum of uniforms), scaled
+  uint64_t state = splitmix64(s->seed ^ static_cast<uint64_t>(key));
+  for (int64_t i = 0; i < s->dim; ++i) {
+    float acc = 0.f;
+    for (int k = 0; k < 4; ++k) {
+      state = splitmix64(state);
+      acc += static_cast<float>(state >> 40) /
+             static_cast<float>(1ULL << 24);  // [0,1)
+    }
+    out[i] = (acc - 2.0f) * 1.7320508f * s->init_scale;  // ~N(0, scale)
+  }
+  std::memset(out + s->dim, 0, sizeof(float) * s->dim * s->num_slots);
+}
+
+Row& find_or_create(Store* s, Bucket& b, int64_t key, int64_t now,
+                    bool* created) {
+  auto it = b.map.find(key);
+  if (it == b.map.end()) {
+    Row row;
+    row.data.resize(s->row_floats());
+    init_row(s, key, row.data.data());
+    row.ts = now;
+    row.version = s->next_version();
+    it = b.map.emplace(key, std::move(row)).first;
+    if (created) *created = true;
+  } else if (created) {
+    *created = false;
+  }
+  return it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kv_create(int64_t dim, int num_slots, uint64_t seed,
+                float init_scale) {
+  Store* s = new Store();
+  s->dim = dim;
+  s->num_slots = num_slots;
+  s->seed = seed;
+  s->init_scale = init_scale;
+  return s;
+}
+
+void kv_free(void* h) { delete static_cast<Store*>(h); }
+
+int64_t kv_size(void* h) {
+  Store* s = static_cast<Store*>(h);
+  int64_t n = 0;
+  for (auto& b : s->buckets) {
+    std::lock_guard<std::mutex> g(b.mu);
+    n += static_cast<int64_t>(b.map.size());
+  }
+  return n;
+}
+
+uint64_t kv_version(void* h) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->version_mu);
+  return s->version;
+}
+
+// Gather values (NOT slots) for n keys into out[n*dim]. insert_missing:
+// initialize absent keys (GatherOrInsert); otherwise absent keys read 0.
+// Bumps freq and ts of every touched key.
+void kv_gather(void* h, const int64_t* keys, int64_t n, float* out,
+               int insert_missing, int64_t now) {
+  Store* s = static_cast<Store*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    Bucket& b = s->bucket(keys[i]);
+    std::lock_guard<std::mutex> g(b.mu);
+    if (insert_missing) {
+      Row& row = find_or_create(s, b, keys[i], now, nullptr);
+      row.freq++;
+      row.ts = now;
+      std::memcpy(out + i * s->dim, row.data.data(),
+                  sizeof(float) * s->dim);
+    } else {
+      auto it = b.map.find(keys[i]);
+      if (it == b.map.end()) {
+        std::memset(out + i * s->dim, 0, sizeof(float) * s->dim);
+      } else {
+        it->second.freq++;
+        it->second.ts = now;
+        std::memcpy(out + i * s->dim, it->second.data.data(),
+                    sizeof(float) * s->dim);
+      }
+    }
+  }
+}
+
+// op: 0=update 1=add 2=sub 3=mul 4=div 5=min 6=max   (parity:
+// KvVariableScatter{Update,Add,Sub,Mul,Div,Min,Max}V2)
+void kv_scatter(void* h, const int64_t* keys, int64_t n,
+                const float* vals, int op, int64_t now) {
+  Store* s = static_cast<Store*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    Bucket& b = s->bucket(keys[i]);
+    std::lock_guard<std::mutex> g(b.mu);
+    Row& row = find_or_create(s, b, keys[i], now, nullptr);
+    float* v = row.data.data();
+    const float* u = vals + i * s->dim;
+    for (int64_t d = 0; d < s->dim; ++d) {
+      switch (op) {
+        case 0: v[d] = u[d]; break;
+        case 1: v[d] += u[d]; break;
+        case 2: v[d] -= u[d]; break;
+        case 3: v[d] *= u[d]; break;
+        case 4: v[d] /= u[d]; break;
+        case 5: v[d] = v[d] < u[d] ? v[d] : u[d]; break;
+        case 6: v[d] = v[d] > u[d] ? v[d] : u[d]; break;
+      }
+    }
+    row.ts = now;
+    row.version = s->next_version();
+  }
+}
+
+// Fused sparse Adagrad (parity: training_ops.cc KvSparseApplyAdagrad):
+// slot0 += g^2 ; value -= lr * g / (sqrt(slot0) + eps). Requires
+// num_slots >= 1. Duplicate keys in one batch accumulate sequentially
+// (same as the reference's row-locked apply).
+void kv_sparse_adagrad(void* h, const int64_t* keys, int64_t n,
+                       const float* grads, float lr, float eps,
+                       int64_t now) {
+  Store* s = static_cast<Store*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    Bucket& b = s->bucket(keys[i]);
+    std::lock_guard<std::mutex> g(b.mu);
+    Row& row = find_or_create(s, b, keys[i], now, nullptr);
+    float* v = row.data.data();
+    float* acc = v + s->dim;
+    const float* gr = grads + i * s->dim;
+    for (int64_t d = 0; d < s->dim; ++d) {
+      acc[d] += gr[d] * gr[d];
+      v[d] -= lr * gr[d] / (__builtin_sqrtf(acc[d]) + eps);
+    }
+    row.ts = now;
+    row.version = s->next_version();
+  }
+}
+
+// Fused sparse momentum-SGD: slot0 = momentum*slot0 + g;
+// value -= lr*slot0. Requires num_slots >= 1.
+void kv_sparse_momentum(void* h, const int64_t* keys, int64_t n,
+                        const float* grads, float lr, float momentum,
+                        int64_t now) {
+  Store* s = static_cast<Store*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    Bucket& b = s->bucket(keys[i]);
+    std::lock_guard<std::mutex> g(b.mu);
+    Row& row = find_or_create(s, b, keys[i], now, nullptr);
+    float* v = row.data.data();
+    float* m = v + s->dim;
+    const float* gr = grads + i * s->dim;
+    for (int64_t d = 0; d < s->dim; ++d) {
+      m[d] = momentum * m[d] + gr[d];
+      v[d] -= lr * m[d];
+    }
+    row.ts = now;
+    row.version = s->next_version();
+  }
+}
+
+// Export rows whose version > since (0 = full export). Two-phase: count,
+// then fill caller-allocated buffers. Rows: full row incl. slots.
+int64_t kv_export_count(void* h, uint64_t since) {
+  Store* s = static_cast<Store*>(h);
+  int64_t n = 0;
+  for (auto& b : s->buckets) {
+    std::lock_guard<std::mutex> g(b.mu);
+    for (auto& kv : b.map)
+      if (kv.second.version > since) ++n;
+  }
+  return n;
+}
+
+int64_t kv_export(void* h, uint64_t since, int64_t* keys_out,
+                  float* rows_out, int64_t* freq_out, int64_t* ts_out,
+                  int64_t capacity) {
+  Store* s = static_cast<Store*>(h);
+  int64_t rf = s->row_floats();
+  int64_t n = 0;
+  for (auto& b : s->buckets) {
+    std::lock_guard<std::mutex> g(b.mu);
+    for (auto& kv : b.map) {
+      if (kv.second.version <= since) continue;
+      if (n >= capacity) return -1;  // caller raced a writer; retry
+      keys_out[n] = kv.first;
+      std::memcpy(rows_out + n * rf, kv.second.data.data(),
+                  sizeof(float) * rf);
+      freq_out[n] = kv.second.freq;
+      ts_out[n] = kv.second.ts;
+      ++n;
+    }
+  }
+  return n;
+}
+
+// Import rows (full row incl. slots). Overwrites existing keys.
+void kv_import(void* h, const int64_t* keys, int64_t n,
+               const float* rows, const int64_t* freq,
+               const int64_t* ts) {
+  Store* s = static_cast<Store*>(h);
+  int64_t rf = s->row_floats();
+  for (int64_t i = 0; i < n; ++i) {
+    Bucket& b = s->bucket(keys[i]);
+    std::lock_guard<std::mutex> g(b.mu);
+    Row& row = b.map[keys[i]];
+    row.data.assign(rows + i * rf, rows + (i + 1) * rf);
+    row.freq = freq ? freq[i] : 0;
+    row.ts = ts ? ts[i] : 0;
+    row.version = s->next_version();
+  }
+}
+
+// Evict rows last touched before ts_limit (parity:
+// KvVariableDeleteWithTimestamp). Returns evicted count.
+int64_t kv_delete_before_timestamp(void* h, int64_t ts_limit) {
+  Store* s = static_cast<Store*>(h);
+  int64_t n = 0;
+  for (auto& b : s->buckets) {
+    std::lock_guard<std::mutex> g(b.mu);
+    for (auto it = b.map.begin(); it != b.map.end();) {
+      if (it->second.ts < ts_limit) {
+        it = b.map.erase(it);
+        ++n;
+      } else {
+        ++it;
+      }
+    }
+  }
+  return n;
+}
+
+// Read freq/ts metadata for keys (absent keys: -1).
+void kv_meta(void* h, const int64_t* keys, int64_t n, int64_t* freq_out,
+             int64_t* ts_out) {
+  Store* s = static_cast<Store*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    Bucket& b = s->bucket(keys[i]);
+    std::lock_guard<std::mutex> g(b.mu);
+    auto it = b.map.find(keys[i]);
+    if (it == b.map.end()) {
+      freq_out[i] = -1;
+      ts_out[i] = -1;
+    } else {
+      freq_out[i] = it->second.freq;
+      ts_out[i] = it->second.ts;
+    }
+  }
+}
+
+}  // extern "C"

@@ -118,9 +118,13 @@ class ElasticTrainer:
         return int(self.state.step)
 
     def _device_batch(self, batch):
+        if isinstance(batch, dict):
+            bx, by = batch["x"], batch["y"]
+        else:  # tuple/list samples from the default collate
+            bx, by = batch[0], batch[1]
         if self.accel.strategy.mesh.pp > 1:
-            return batch["x"], batch["y"]  # pipeline step takes host arrays
-        sharded = shard_batch(batch, self.mesh)
+            return bx, by  # pipeline step takes host arrays
+        sharded = shard_batch({"x": bx, "y": by}, self.mesh)
         return sharded["x"], sharded["y"]
 
     def train(self, num_steps: int) -> Any:
@@ -128,31 +132,39 @@ class ElasticTrainer:
         import jax
 
         t0 = time.time()
+        start_step = self.global_step
         while self.global_step < num_steps:
             self.dataloader.load_config()  # master-retuned batch size
+            # epoch rollover and mid-epoch position both live in the
+            # sampler (its iterator advances completed_num and bumps the
+            # epoch on exhaustion) — the trainer never touches them, so a
+            # num_steps stop mid-epoch checkpoints the exact position
             for batch in self.dataloader:
-                if self.global_step >= num_steps:
-                    break
                 x, y = self._device_batch(batch)
                 self.state, metrics = self._step_fn(self.state, x, y)
                 step = self.global_step
-                if self.tcfg.report_metrics:
-                    report_runtime_metrics(
-                        step, loss=float(metrics["loss"])
-                    )
                 if self._metrics_hook is not None:
                     self._metrics_hook(step, metrics)
                 if step % self.tcfg.log_interval == 0:
+                    # the only host sync in the loop: loss is materialized
+                    # at log cadence, not every step (async dispatch stays
+                    # ahead of the host otherwise)
+                    loss = float(metrics["loss"])
+                    if self.tcfg.report_metrics:
+                        report_runtime_metrics(step, loss=loss)
+                    rate = (step - start_step) / max(
+                        time.time() - t0, 1e-9
+                    )
                     logger.info(
-                        f"step {step}: loss={float(metrics['loss']):.4f} "
-                        f"({step / max(time.time() - t0, 1e-9):.2f} it/s)"
+                        f"step {step}: loss={loss:.4f} ({rate:.2f} it/s)"
                     )
                 if self._ckptr is not None:
                     if step % self.tcfg.save_storage_interval == 0:
                         self.save(StorageType.DISK)
                     elif step % self.tcfg.save_memory_interval == 0:
                         self.save(StorageType.MEMORY)
-            self.sampler.set_epoch(self.sampler.epoch + 1)
+                if step >= num_steps:
+                    break
         jax.block_until_ready(self.state.params)
         return self.state
 

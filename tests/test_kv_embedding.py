@@ -1,0 +1,257 @@
+"""Native KvEmbeddingStore: correctness, fused sparse optimizers,
+metadata, delta export, and elastic resharding round-trips.
+
+Parity: tfplus kv_variable_test.cc:458 exercises gather/insert/scatter/
+import-export against the C++ kernels; here the same contracts are
+driven through the ctypes binding.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.master.elastic_ps import ElasticPsService
+from dlrover_tpu.ops.embedding import KvEmbeddingStore, ShardedKvEmbedding
+
+
+@pytest.fixture(scope="module")
+def dim():
+    return 8
+
+
+class TestKvStore:
+    def test_gather_or_insert_deterministic(self, dim):
+        s1 = KvEmbeddingStore(dim, seed=7)
+        s2 = KvEmbeddingStore(dim, seed=7)
+        keys = [3, 99, 12345678901]
+        np.testing.assert_array_equal(s1.gather(keys), s2.gather(keys))
+        # init is per-key deterministic, not ordering-dependent
+        np.testing.assert_array_equal(
+            s1.gather([99]), s2.gather([1, 99])[1:]
+        )
+        assert len(s1) == 3
+        # different seed → different init
+        s3 = KvEmbeddingStore(dim, seed=8)
+        assert not np.allclose(s1.gather([3]), s3.gather([3]))
+
+    def test_gather_without_insert_reads_zeros(self, dim):
+        s = KvEmbeddingStore(dim)
+        out = s.gather([42], insert_missing=False)
+        np.testing.assert_array_equal(out, np.zeros((1, dim), np.float32))
+        assert len(s) == 0
+
+    def test_scatter_ops(self, dim):
+        s = KvEmbeddingStore(dim)
+        k = [1, 2]
+        ones = np.ones((2, dim), np.float32)
+        s.scatter(k, ones * 3, op="update")
+        np.testing.assert_array_equal(s.gather(k), ones * 3)
+        s.scatter(k, ones, op="add")
+        np.testing.assert_array_equal(s.gather(k), ones * 4)
+        s.scatter(k, ones * 2, op="mul")
+        np.testing.assert_array_equal(s.gather(k), ones * 8)
+        s.scatter(k, ones * 5, op="min")
+        np.testing.assert_array_equal(s.gather(k), ones * 5)
+
+    def test_sparse_adagrad_matches_numpy(self, dim):
+        s = KvEmbeddingStore(dim, num_slots=1, seed=0)
+        keys = np.array([10, 20], np.int64)
+        w0 = s.gather(keys).copy()
+        rng = np.random.default_rng(0)
+        acc = np.zeros((2, dim), np.float32)
+        w = w0.copy()
+        lr, eps = 0.1, 1e-8
+        for _ in range(5):
+            g = rng.normal(size=(2, dim)).astype(np.float32)
+            s.sparse_adagrad(keys, g, lr=lr, eps=eps)
+            acc += g * g
+            w -= lr * g / (np.sqrt(acc) + eps)
+        np.testing.assert_allclose(s.gather(keys), w, rtol=1e-5, atol=1e-6)
+
+    def test_sparse_momentum(self, dim):
+        s = KvEmbeddingStore(dim, num_slots=1)
+        keys = [5]
+        w0 = s.gather(keys).copy()
+        g = np.ones((1, dim), np.float32)
+        s.sparse_momentum(keys, g, lr=0.1, momentum=0.5)
+        s.sparse_momentum(keys, g, lr=0.1, momentum=0.5)
+        # m1 = 1, m2 = 1.5 → w = w0 - 0.1*(1 + 1.5)
+        np.testing.assert_allclose(
+            s.gather(keys), w0 - 0.25, rtol=1e-6, atol=1e-7
+        )
+
+    def test_freq_and_ts_metadata(self, dim):
+        s = KvEmbeddingStore(dim)
+        s.gather([7])
+        s.gather([7])
+        freq, ts = s.meta([7, 8])
+        assert freq[0] == 2 and ts[0] > 0
+        assert freq[1] == -1 and ts[1] == -1
+
+    def test_eviction_by_timestamp(self, dim):
+        s = KvEmbeddingStore(dim)
+        s.gather([1, 2, 3])
+        assert s.evict_older_than(0) == 0
+        evicted = s.evict_older_than(2**62)
+        assert evicted == 3 and len(s) == 0
+
+    def test_delta_export(self, dim):
+        s = KvEmbeddingStore(dim)
+        s.gather([1, 2])
+        v = s.version
+        s.scatter([2], np.ones((1, dim), np.float32))
+        s.gather([3])
+        keys, rows, freq, ts = s.export(since_version=v)
+        assert sorted(keys.tolist()) == [2, 3]  # only rows touched after v
+        keys_full, *_ = s.export()
+        assert sorted(keys_full.tolist()) == [1, 2, 3]
+
+    def test_export_import_roundtrip(self, dim):
+        a = KvEmbeddingStore(dim, num_slots=1, seed=1)
+        keys = np.arange(100, dtype=np.int64)
+        a.gather(keys)
+        a.sparse_adagrad(keys, np.ones((100, dim), np.float32), lr=0.1)
+        b = KvEmbeddingStore(dim, num_slots=1, seed=999)
+        b.import_rows(*a.export())
+        np.testing.assert_array_equal(
+            a.gather(keys, insert_missing=False),
+            b.gather(keys, insert_missing=False),
+        )
+        # slots (adagrad accumulators) travel too: next update identical
+        g = np.full((100, dim), 0.5, np.float32)
+        a.sparse_adagrad(keys, g, lr=0.1)
+        b.sparse_adagrad(keys, g, lr=0.1)
+        np.testing.assert_array_equal(a.gather(keys), b.gather(keys))
+
+    def test_concurrent_access(self, dim):
+        s = KvEmbeddingStore(dim, num_slots=1)
+        errs = []
+
+        def work(tid):
+            try:
+                rng = np.random.default_rng(tid)
+                for _ in range(50):
+                    keys = rng.integers(0, 1000, 32)
+                    s.gather(keys)
+                    s.sparse_adagrad(
+                        keys,
+                        rng.normal(size=(32, dim)).astype(np.float32),
+                        lr=0.01,
+                    )
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=work, args=(t,)) for t in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert 0 < len(s) <= 1000
+
+
+class TestShardedKvEmbedding:
+    def test_routing_consistency(self, dim):
+        e = ShardedKvEmbedding(4, dim, seed=3)
+        keys = np.arange(500, dtype=np.int64)
+        first = e.gather(keys)
+        np.testing.assert_array_equal(first, e.gather(keys))
+        assert len(e) == 500
+        # all shards participate (hash routing spreads keys)
+        assert all(len(s) > 0 for s in e.shards)
+
+    def test_reshard_roundtrip_no_loss_no_dup(self, dim):
+        """N → M → N with training in between: every row preserved
+        exactly once (the VERDICT done-criterion)."""
+        svc = ElasticPsService()
+        e = ShardedKvEmbedding(3, dim, seed=5, version_service=svc)
+        keys = np.arange(1000, dtype=np.int64)
+        e.gather(keys)
+        e.sparse_adagrad(
+            keys, np.ones((1000, dim), np.float32), lr=0.05
+        )
+        before = e.gather(keys, insert_missing=False)
+        total_before = len(e)
+
+        e.reshard(5)
+        assert svc.get_version("global", "", 0) == 1
+        assert len(e) == total_before  # no loss, no duplication
+        np.testing.assert_array_equal(
+            e.gather(keys, insert_missing=False), before
+        )
+
+        e.reshard(2)
+        assert len(e) == total_before
+        np.testing.assert_array_equal(
+            e.gather(keys, insert_missing=False), before
+        )
+        # optimizer slots survived both reshards: updates stay identical
+        ref = ShardedKvEmbedding(1, dim, seed=5)
+        ref.import_state(e.export_state())
+        g = np.full((1000, dim), 0.3, np.float32)
+        e.sparse_adagrad(keys, g, lr=0.05)
+        ref.sparse_adagrad(keys, g, lr=0.05)
+        np.testing.assert_array_equal(
+            e.gather(keys, insert_missing=False),
+            ref.gather(keys, insert_missing=False),
+        )
+
+    def test_state_checkpoint_roundtrip(self, dim, tmp_path):
+        e = ShardedKvEmbedding(2, dim, seed=6)
+        keys = np.arange(64, dtype=np.int64)
+        e.gather(keys)
+        state = e.export_state()
+        np.savez(tmp_path / "emb.npz", **state)
+        loaded = dict(np.load(tmp_path / "emb.npz"))
+        e2 = ShardedKvEmbedding(4, dim, seed=0)
+        e2.import_state(loaded)
+        np.testing.assert_array_equal(
+            e.gather(keys, insert_missing=False),
+            e2.gather(keys, insert_missing=False),
+        )
+
+
+class TestSparseTraining:
+    def test_embedding_classifier_learns(self, dim):
+        """End-to-end sparse training: host-side embedding + fused
+        sparse Adagrad + a jax dense head — the TPU recommender shape."""
+        import jax
+        import jax.numpy as jnp
+
+        emb = ShardedKvEmbedding(2, 16, seed=0)
+        rng = np.random.default_rng(0)
+        n_ids = 50
+        ids = rng.integers(0, n_ids, 512)
+        labels = (ids % 2).astype(np.float32)  # parity of the id
+
+        w = jnp.zeros((16,))
+
+        @jax.jit
+        def loss_and_grads(w, rows, y):
+            logits = rows @ w
+            p = jax.nn.sigmoid(logits)
+            loss = -jnp.mean(
+                y * jnp.log(p + 1e-7) + (1 - y) * jnp.log(1 - p + 1e-7)
+            )
+            return loss, jax.grad(
+                lambda w, r: -jnp.mean(
+                    y * jnp.log(jax.nn.sigmoid(r @ w) + 1e-7)
+                    + (1 - y)
+                    * jnp.log(1 - jax.nn.sigmoid(r @ w) + 1e-7)
+                ),
+                argnums=(0, 1),
+            )(w, rows)
+
+        losses = []
+        for epoch in range(30):
+            batch_ids = ids[:128]
+            y = labels[:128]
+            rows = jnp.asarray(emb.gather(batch_ids))
+            loss, (gw, grows) = loss_and_grads(w, rows, y)
+            losses.append(float(loss))
+            w = w - 0.5 * gw
+            emb.sparse_adagrad(batch_ids, np.asarray(grows), lr=0.5)
+        assert losses[-1] < losses[0] * 0.5, losses[::10]

@@ -101,6 +101,7 @@ class TestElasticTrainer:
                 save_memory_interval=2,
                 save_storage_interval=4,
                 report_metrics=False,
+                log_interval=1,
                 **overrides,
             ),
             strategy=Strategy(mesh=MeshConfig(dp=8), dtype="float32"),

@@ -1,0 +1,14 @@
+"""Kubernetes control plane (parity: dlrover/go/operator + python
+scheduler/watcher/scaler layers, SURVEY §2.5).
+
+Pieces:
+- ``crds/``: ElasticJob + ScalePlan CRD manifests (the contract).
+- ``client.K8sApi``: narrow API seam; ``RealK8sApi`` (kubernetes SDK,
+  import-gated) or ``FakeK8sApi`` (tests/simulation).
+- ``scaler.PodScaler`` / ``scaler.ElasticJobScaler``: the master-side
+  Scaler implementations.
+- ``watcher.PodWatcher``: pod lifecycle → NodeEvents.
+- ``operator.ElasticJobOperator``: the reconciler (runs in-cluster or
+  simulated).
+- ``dist_master.DistributedJobMaster``: LocalJobMaster + scaler+watcher.
+"""

@@ -1,0 +1,275 @@
+"""K8s API seam: the narrow surface the scalers/watcher/operator need.
+
+Parity: dlrover/python/scheduler/kubernetes.py:121 (k8sClient wrapper).
+The real implementation is gated on the ``kubernetes`` SDK (not part of
+the base image); ``FakeK8sApi`` is a complete in-memory double — the
+same test strategy as the reference (SURVEY §4: "K8s faked, not spoken
+to", mock_k8s_client in test_utils.py) — and also powers local
+simulation runs of the operator.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+GROUP = "elastic.dlrover-tpu.org"
+VERSION = "v1alpha1"
+MASTER_PORT = 51651  # deterministic master port so worker env can be
+# stamped before the master pod exists (service DNS + this port)
+
+
+class AlreadyExists(Exception):
+    """Create raced an existing object (HTTP 409) — usually benign for
+    idempotent reconcilers."""
+
+
+class K8sApi:
+    """What the control plane needs from a cluster.
+
+    ``create_pod``/``create_custom_object`` raise :class:`AlreadyExists`
+    on name collision (mirroring the API server's 409) so reconcilers
+    stay idempotent."""
+
+    # pods
+    def create_pod(self, namespace: str, body: dict) -> dict:
+        raise NotImplementedError
+
+    def create_service(self, namespace: str, body: dict) -> dict:
+        raise NotImplementedError
+
+    def list_services(self, namespace: str) -> List[dict]:
+        raise NotImplementedError
+
+    def delete_pod(self, namespace: str, name: str) -> bool:
+        raise NotImplementedError
+
+    def list_pods(self, namespace: str, label_selector: str = "") -> List[dict]:
+        raise NotImplementedError
+
+    # custom objects (ElasticJob / ScalePlan)
+    def get_custom_object(
+        self, namespace: str, plural: str, name: str
+    ) -> Optional[dict]:
+        raise NotImplementedError
+
+    def list_custom_objects(
+        self, namespace: str, plural: str
+    ) -> List[dict]:
+        raise NotImplementedError
+
+    def create_custom_object(
+        self, namespace: str, plural: str, body: dict
+    ) -> dict:
+        raise NotImplementedError
+
+    def patch_custom_object_status(
+        self, namespace: str, plural: str, name: str, status: dict
+    ) -> None:
+        raise NotImplementedError
+
+    def delete_custom_object(
+        self, namespace: str, plural: str, name: str
+    ) -> bool:
+        raise NotImplementedError
+
+
+class RealK8sApi(K8sApi):
+    """Backed by the official SDK (import gated)."""
+
+    def __init__(self, namespace: str = "default", in_cluster: bool = True):
+        try:
+            from kubernetes import client, config
+        except ImportError as e:  # pragma: no cover - sdk not in image
+            raise ImportError(
+                "the 'kubernetes' package is required for the k8s "
+                "platform (pip install kubernetes)"
+            ) from e
+        if in_cluster:
+            config.load_incluster_config()
+        else:
+            config.load_kube_config()
+        self._core = client.CoreV1Api()
+        self._objs = client.CustomObjectsApi()
+        self.namespace = namespace
+
+    def create_pod(self, namespace, body):  # pragma: no cover - needs cluster
+        from kubernetes.client.rest import ApiException
+
+        try:
+            return self._core.create_namespaced_pod(namespace, body)
+        except ApiException as e:
+            if e.status == 409:
+                raise AlreadyExists(body["metadata"]["name"]) from e
+            raise
+
+    def create_service(self, namespace, body):  # pragma: no cover
+        from kubernetes.client.rest import ApiException
+
+        try:
+            return self._core.create_namespaced_service(namespace, body)
+        except ApiException as e:
+            if e.status == 409:
+                raise AlreadyExists(body["metadata"]["name"]) from e
+            raise
+
+    def list_services(self, namespace):  # pragma: no cover
+        ret = self._core.list_namespaced_service(namespace)
+        return [s.to_dict() for s in ret.items]
+
+    def delete_pod(self, namespace, name):  # pragma: no cover
+        from kubernetes.client.rest import ApiException
+
+        try:
+            self._core.delete_namespaced_pod(name, namespace)
+            return True
+        except ApiException as e:
+            return e.status == 404
+
+    def list_pods(self, namespace, label_selector=""):  # pragma: no cover
+        ret = self._core.list_namespaced_pod(
+            namespace, label_selector=label_selector
+        )
+        return [p.to_dict() for p in ret.items]
+
+    def get_custom_object(self, namespace, plural, name):  # pragma: no cover
+        from kubernetes.client.rest import ApiException
+
+        try:
+            return self._objs.get_namespaced_custom_object(
+                GROUP, VERSION, namespace, plural, name
+            )
+        except ApiException as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def list_custom_objects(self, namespace, plural):  # pragma: no cover
+        ret = self._objs.list_namespaced_custom_object(
+            GROUP, VERSION, namespace, plural
+        )
+        return ret.get("items", [])
+
+    def create_custom_object(self, namespace, plural, body):  # pragma: no cover
+        from kubernetes.client.rest import ApiException
+
+        try:
+            return self._objs.create_namespaced_custom_object(
+                GROUP, VERSION, namespace, plural, body
+            )
+        except ApiException as e:
+            if e.status == 409:
+                raise AlreadyExists(body["metadata"]["name"]) from e
+            raise
+
+    def patch_custom_object_status(
+        self, namespace, plural, name, status
+    ):  # pragma: no cover
+        self._objs.patch_namespaced_custom_object_status(
+            GROUP, VERSION, namespace, plural, name, {"status": status}
+        )
+
+    def delete_custom_object(self, namespace, plural, name):  # pragma: no cover
+        from kubernetes.client.rest import ApiException
+
+        try:
+            self._objs.delete_namespaced_custom_object(
+                GROUP, VERSION, namespace, plural, name
+            )
+            return True
+        except ApiException as e:
+            return e.status == 404
+
+
+class FakeK8sApi(K8sApi):
+    """In-memory cluster double for tests and local simulation."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pods: Dict[str, dict] = {}  # name -> pod body
+        self.services: Dict[str, dict] = {}
+        self.objects: Dict[str, Dict[str, dict]] = {}  # plural -> name -> obj
+        self.events: List[str] = []
+
+    def create_pod(self, namespace, body):
+        with self._lock:
+            name = body["metadata"]["name"]
+            if name in self.pods:
+                raise AlreadyExists(name)  # mirror the API server's 409
+            body = copy.deepcopy(body)
+            body.setdefault("status", {})["phase"] = "Pending"
+            self.pods[name] = body
+            self.events.append(f"create_pod:{name}")
+            return body
+
+    def create_service(self, namespace, body):
+        with self._lock:
+            name = body["metadata"]["name"]
+            if name in self.services:
+                raise AlreadyExists(name)
+            self.services[name] = copy.deepcopy(body)
+            return body
+
+    def list_services(self, namespace):
+        with self._lock:
+            return copy.deepcopy(list(self.services.values()))
+
+    def delete_pod(self, namespace, name):
+        with self._lock:
+            self.events.append(f"delete_pod:{name}")
+            return self.pods.pop(name, None) is not None
+
+    def list_pods(self, namespace, label_selector=""):
+        with self._lock:
+            pods = list(self.pods.values())
+        if not label_selector:
+            return copy.deepcopy(pods)
+        want = dict(
+            kv.split("=", 1) for kv in label_selector.split(",") if kv
+        )
+        out = []
+        for p in pods:
+            labels = p["metadata"].get("labels", {})
+            if all(labels.get(k) == v for k, v in want.items()):
+                out.append(copy.deepcopy(p))
+        return out
+
+    def set_pod_phase(self, name: str, phase: str):
+        """Test hook: drive pod lifecycle."""
+        with self._lock:
+            if name in self.pods:
+                self.pods[name].setdefault("status", {})["phase"] = phase
+
+    def get_custom_object(self, namespace, plural, name):
+        with self._lock:
+            obj = self.objects.get(plural, {}).get(name)
+            return copy.deepcopy(obj) if obj else None
+
+    def list_custom_objects(self, namespace, plural):
+        with self._lock:
+            return copy.deepcopy(list(self.objects.get(plural, {}).values()))
+
+    def create_custom_object(self, namespace, plural, body):
+        with self._lock:
+            name = body["metadata"]["name"]
+            if name in self.objects.get(plural, {}):
+                raise AlreadyExists(name)
+            self.objects.setdefault(plural, {})[name] = copy.deepcopy(body)
+            self.events.append(f"create_{plural}:{name}")
+            return body
+
+    def patch_custom_object_status(self, namespace, plural, name, status):
+        with self._lock:
+            obj = self.objects.get(plural, {}).get(name)
+            if obj is not None:
+                obj.setdefault("status", {}).update(status)
+
+    def delete_custom_object(self, namespace, plural, name):
+        with self._lock:
+            return (
+                self.objects.get(plural, {}).pop(name, None) is not None
+            )

@@ -1,0 +1,195 @@
+"""Minimal ElasticJob/ScalePlan operator (controller loop).
+
+Parity: the reference's Go operator
+(dlrover/go/operator/pkg/controllers/elasticjob_controller.go:287 —
+reconciles ElasticJob into a master pod; scaleplan_controller.go:199 —
+converges pods to a ScalePlan the master wrote; master pod construction
+pkg/controllers/master/master.go:289). This is the same reconcile
+logic in Python on the ``K8sApi`` seam: it runs in-cluster against the
+real API, or against ``FakeK8sApi`` for tests/simulation. A Go rewrite
+is mechanical once the semantics are pinned here (the CRDs in
+dlrover_tpu/k8s/crds/ are the contract).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dlrover_tpu.common.daemon import PollingDaemon
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.k8s.client import MASTER_PORT, AlreadyExists, K8sApi
+from dlrover_tpu.k8s.scaler import JOB_LABEL, build_worker_pod
+
+MASTER_SUFFIX = "-master"
+
+
+def master_service_addr(job_name: str, namespace: str) -> str:
+    """The DNS address workers use to reach the master — stable across
+    master pod restarts (parity: master.go creates a Service)."""
+    return f"{job_name}{MASTER_SUFFIX}.{namespace}.svc:{MASTER_PORT}"
+
+
+def build_master_service(job_name: str, namespace: str) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"{job_name}{MASTER_SUFFIX}",
+            "namespace": namespace,
+            "labels": {JOB_LABEL: job_name},
+        },
+        "spec": {
+            "selector": {
+                JOB_LABEL: job_name,
+                "elastic.dlrover-tpu.org/role": "master",
+            },
+            "ports": [{"port": MASTER_PORT, "targetPort": MASTER_PORT}],
+        },
+    }
+
+
+def build_master_pod(job: dict, namespace: str) -> dict:
+    """Master pod for an ElasticJob (parity: master.go:289 NewMasterPod)."""
+    name = job["metadata"]["name"]
+    spec = job.get("spec", {})
+    workers = spec.get("replicaSpecs", {}).get("worker", {})
+    image = (
+        workers.get("template", {})
+        .get("spec", {})
+        .get("containers", [{}])[0]
+        .get("image", "dlrover-tpu:latest")
+    )
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"{name}{MASTER_SUFFIX}",
+            "namespace": namespace,
+            "labels": {
+                JOB_LABEL: name,
+                "elastic.dlrover-tpu.org/role": "master",
+            },
+        },
+        "spec": {
+            "restartPolicy": "OnFailure",
+            "containers": [
+                {
+                    "name": "master",
+                    "image": image,
+                    "command": [
+                        "python",
+                        "-m",
+                        "dlrover_tpu.master.main",
+                        "--platform=k8s",
+                        f"--port={MASTER_PORT}",
+                        f"--job_name={name}",
+                        "--node_num="
+                        + str(workers.get("replicas", 1)),
+                    ],
+                }
+            ],
+        },
+    }
+
+
+class ElasticJobOperator(PollingDaemon):
+    """Reconciles ElasticJobs (ensure master pod) and executes pending
+    ScalePlans (create/remove worker pods)."""
+
+    def __init__(
+        self, api: K8sApi, namespace: str = "default", interval: float = 5.0
+    ):
+        super().__init__("elasticjob-operator", interval)
+        self._api = api
+        self._ns = namespace
+
+    def _tick(self):
+        self.reconcile_jobs()
+        self.reconcile_scaleplans()
+
+    # -- ElasticJob → master pod + service -----------------------------
+    def reconcile_jobs(self):
+        pods = {
+            p["metadata"]["name"] for p in self._api.list_pods(self._ns)
+        }
+        services = {
+            s["metadata"]["name"]
+            for s in self._api.list_services(self._ns)
+        }
+        for job in self._api.list_custom_objects(self._ns, "elasticjobs"):
+            name = job["metadata"]["name"]
+            master = f"{name}{MASTER_SUFFIX}"
+            try:
+                if master not in services:
+                    self._api.create_service(
+                        self._ns, build_master_service(name, self._ns)
+                    )
+                if master not in pods:
+                    logger.info(f"operator creating master pod {master}")
+                    self._api.create_pod(
+                        self._ns, build_master_pod(job, self._ns)
+                    )
+                    self._api.patch_custom_object_status(
+                        self._ns, "elasticjobs", name, {"phase": "Starting"}
+                    )
+            except AlreadyExists:
+                pass  # raced our own previous tick; converged
+            except Exception as e:
+                logger.error(f"reconcile of job {name} failed: {e!r}")
+
+    # -- ScalePlan → pods ----------------------------------------------
+    def reconcile_scaleplans(self):
+        for plan in self._api.list_custom_objects(self._ns, "scaleplans"):
+            if plan.get("status", {}).get("phase") == "Succeeded":
+                continue
+            try:
+                self._apply_scaleplan(plan)
+            except Exception as e:
+                # a wedged plan must not block the others or wedge _tick
+                logger.error(
+                    f"applying {plan['metadata']['name']} failed: {e!r}"
+                )
+
+    def _apply_scaleplan(self, plan: dict):
+        name = plan["metadata"]["name"]
+        spec = plan.get("spec", {})
+        job = spec.get("ownerJob", "")
+        # one template lookup per plan, not per pod
+        jobobj = self._api.get_custom_object(self._ns, "elasticjobs", job)
+        for meta in spec.get("removePods", []):
+            self._api.delete_pod(self._ns, meta["name"])
+        for meta in spec.get("createPods", []):
+            rtype = meta.get("type", "worker")
+            template = (
+                (jobobj or {})
+                .get("spec", {})
+                .get("replicaSpecs", {})
+                .get(rtype, {})
+                .get("template")
+            )
+            node = Node(
+                node_type=rtype,
+                node_id=meta.get("id", 0),
+                rank_index=meta.get("rankIndex", meta.get("id", 0)),
+                group=meta.get("group", 0),
+                group_size=meta.get("groupSize", 1),
+            )
+            # same pod factory as the direct PodScaler path: identity
+            # labels + master-address/rank env are stamped identically
+            body = build_worker_pod(
+                job,
+                node,
+                template=template,
+                master_addr=master_service_addr(job, self._ns),
+                namespace=self._ns,
+            )
+            body["metadata"]["name"] = meta["name"]
+            logger.info(f"operator creating pod {meta['name']}")
+            try:
+                self._api.create_pod(self._ns, body)
+            except AlreadyExists:
+                pass  # re-applied plan after a crash; idempotent
+        self._api.patch_custom_object_status(
+            self._ns, "scaleplans", name, {"phase": "Succeeded"}
+        )

@@ -1,0 +1,180 @@
+"""K8s scalers: PodScaler (direct) and ElasticJobScaler (via ScalePlan CR).
+
+Parity: dlrover/python/master/scaler/pod_scaler.py:76 and
+elasticjob_scaler.py:153. Both implement the same ``Scaler`` seam the
+auto-scaler and job manager already speak (master/scaler.py), so the
+platform choice is one constructor swap.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.k8s.client import K8sApi
+from dlrover_tpu.master.scaler import ScalePlan, Scaler
+
+JOB_LABEL = "elastic.dlrover-tpu.org/job"
+TYPE_LABEL = "elastic.dlrover-tpu.org/replica-type"
+RANK_LABEL = "elastic.dlrover-tpu.org/rank-index"
+NODE_ID_LABEL = "elastic.dlrover-tpu.org/node-id"
+
+
+def pod_name(job: str, node: Node) -> str:
+    return f"{job}-{node.type}-{node.id}"
+
+
+def build_worker_pod(
+    job_name: str,
+    node: Node,
+    template: Optional[dict] = None,
+    master_addr: str = "",
+    namespace: str = "default",
+) -> dict:
+    """Worker pod body from the replica template (parity: pod_scaler
+    _create_pod + resource.go NewPod). The template comes from the
+    ElasticJob replicaSpec; we stamp identity labels + env."""
+    body = json.loads(json.dumps(template)) if template else {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [
+                {"name": "worker", "image": "dlrover-tpu:latest"}
+            ],
+        },
+    }
+    meta = body.setdefault("metadata", {})
+    meta["name"] = pod_name(job_name, node)
+    meta["namespace"] = namespace
+    labels = meta.setdefault("labels", {})
+    labels[JOB_LABEL] = job_name
+    labels[TYPE_LABEL] = node.type
+    labels[RANK_LABEL] = str(node.rank_index)
+    labels[NODE_ID_LABEL] = str(node.id)
+    container = body["spec"]["containers"][0]
+    env = container.setdefault("env", [])
+    env += [
+        {"name": "DLROVER_TPU_MASTER_ADDR", "value": master_addr},
+        {"name": "NODE_RANK", "value": str(node.rank_index)},
+        {"name": "NODE_ID", "value": str(node.id)},
+    ]
+    res = node.config_resource
+    if res and (res.cpu or res.memory_mb):
+        limits = container.setdefault("resources", {}).setdefault(
+            "limits", {}
+        )
+        if res.cpu:
+            limits["cpu"] = str(res.cpu)
+        if res.memory_mb:
+            limits["memory"] = f"{res.memory_mb}Mi"
+    if res and res.tpu_type:
+        sel = body["spec"].setdefault("nodeSelector", {})
+        sel["cloud.google.com/gke-tpu-accelerator"] = res.tpu_type
+        if res.tpu_topology:
+            sel["cloud.google.com/gke-tpu-topology"] = res.tpu_topology
+    return body
+
+
+class PodScaler(Scaler):
+    """Creates/deletes worker pods directly (parity: pod_scaler.py:76).
+    Used when the master has pod permissions and no operator is
+    deployed."""
+
+    def __init__(
+        self,
+        api: K8sApi,
+        job_name: str,
+        namespace: str = "default",
+        pod_template: Optional[dict] = None,
+        master_addr: str = "",
+    ):
+        self._api = api
+        self._job = job_name
+        self._ns = namespace
+        self._template = pod_template
+        self._master_addr = master_addr
+
+    def set_master_addr(self, addr: str):
+        """The master learns its bound address after construction; it
+        must be stamped into every worker pod's env."""
+        self._master_addr = addr
+
+    def scale(self, plan: ScalePlan) -> None:
+        for node in plan.remove_nodes:
+            name = pod_name(self._job, node)
+            logger.info(f"pod scaler deleting {name}")
+            self._api.delete_pod(self._ns, name)
+        for node in plan.launch_nodes:
+            body = build_worker_pod(
+                self._job,
+                node,
+                template=self._template,
+                master_addr=self._master_addr,
+                namespace=self._ns,
+            )
+            logger.info(f"pod scaler creating {body['metadata']['name']}")
+            self._api.create_pod(self._ns, body)
+
+
+class ElasticJobScaler(Scaler):
+    """Writes a ScalePlan custom resource and lets the operator converge
+    pods (parity: elasticjob_scaler.py:153) — the production path: the
+    master needs only CR write permission, not pod admin."""
+
+    def __init__(
+        self, api: K8sApi, job_name: str, namespace: str = "default"
+    ):
+        self._api = api
+        self._job = job_name
+        self._ns = namespace
+        self._serial = 0
+        # names must be unique across master restarts (an in-memory
+        # serial alone would 409 against surviving CRs); ms timestamp +
+        # serial disambiguates both restarts and same-ms bursts
+        self._epoch_ms = int(time.time() * 1000)
+
+    @staticmethod
+    def _pod_meta(job: str, node: Node) -> dict:
+        return {
+            "name": pod_name(job, node),
+            "id": node.id,
+            "type": node.type,
+            "rankIndex": node.rank_index,
+            "group": node.group,
+            "groupSize": node.group_size,
+        }
+
+    def scale(self, plan: ScalePlan) -> None:
+        self._serial += 1
+        body = {
+            "apiVersion": "elastic.dlrover-tpu.org/v1alpha1",
+            "kind": "ScalePlan",
+            "metadata": {
+                "name": (
+                    f"{self._job}-scaleplan-{self._epoch_ms}-{self._serial}"
+                ),
+                "namespace": self._ns,
+                "labels": {JOB_LABEL: self._job},
+            },
+            "spec": {
+                "ownerJob": self._job,
+                "replicaResourceSpecs": {
+                    t: {"replicas": n} for t, n in plan.node_group.items()
+                },
+                "createPods": [
+                    self._pod_meta(self._job, n) for n in plan.launch_nodes
+                ],
+                "removePods": [
+                    self._pod_meta(self._job, n) for n in plan.remove_nodes
+                ],
+            },
+        }
+        logger.info(
+            f"writing ScalePlan {body['metadata']['name']}: "
+            f"+{len(plan.launch_nodes)} -{len(plan.remove_nodes)}"
+        )
+        self._api.create_custom_object(self._ns, "scaleplans", body)

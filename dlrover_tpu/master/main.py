@@ -31,12 +31,15 @@ def run(args) -> int:
         # same servicer; see dlrover_tpu/k8s.
         try:
             from dlrover_tpu.k8s.dist_master import DistributedJobMaster
-        except ImportError as e:
+
+            master = DistributedJobMaster(
+                port=args.port,
+                node_num=args.node_num,
+                job_name=args.job_name,
+            )
+        except ImportError as e:  # kubernetes SDK not installed
             logger.error(f"k8s platform unavailable: {e}")
             return 2
-        master = DistributedJobMaster(
-            port=args.port, node_num=args.node_num, job_name=args.job_name
-        )
     else:
         master = LocalJobMaster(port=args.port, node_num=args.node_num)
     master.prepare()

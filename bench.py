@@ -35,27 +35,11 @@ import numpy as np
 
 REF_GOODPUT_PCT = 95.0  # reference's published goodput (README.md:54-55)
 
-# bf16 peak TFLOP/s per chip by device kind (public TPU specs)
-_PEAK_TFLOPS = {
-    "v2": 46.0,
-    "v3": 123.0,
-    "v4": 275.0,
-    "v5 lite": 197.0,
-    "v5e": 197.0,
-    "v5p": 459.0,
-    "v6 lite": 918.0,
-    "v6e": 918.0,
-}
-
 
 def _chip_peak_tflops(device) -> float | None:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, peak in sorted(
-        _PEAK_TFLOPS.items(), key=lambda kv: -len(kv[0])
-    ):
-        if key in kind:
-            return peak
-    return None
+    from dlrover_tpu.accel.profiler import chip_peak_tflops
+
+    return chip_peak_tflops(device)
 
 
 def _probe_link_bw(jax) -> float:

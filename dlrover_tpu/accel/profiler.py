@@ -1,0 +1,186 @@
+"""Model profiler: per-module flops/params/memory + measured step cost.
+
+Parity: ATorch ``AProfiler`` (atorch/atorch/utils/prof.py:38 — analytic
+per-module flops formulas at :489-650 plus timed profiles feeding the
+dry-runner) and the TF graph profile extractor. Two sources of truth:
+
+- ``profile_model``: analytic per-block accounting from the config (no
+  device needed) — params, fwd/bwd FLOPs, activation bytes. Useful for
+  capacity planning and sanity-checking the compiler numbers.
+- ``measure_step``: wall-clock of a compiled step + achieved TFLOP/s and
+  MFU against the chip's known peak (the number BASELINE.md row 9 is
+  quoted in). XLA's own per-program accounting comes from
+  ``dry_runner.compiled_cost``; this module is the human-facing layer.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from dlrover_tpu.models.config import TransformerConfig
+
+# bf16 peak TFLOP/s per chip (public specs); used for MFU
+PEAK_TFLOPS = {
+    "v2": 46.0,
+    "v3": 123.0,
+    "v4": 275.0,
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,
+    "v6e": 918.0,
+}
+
+
+def chip_peak_tflops(device) -> Optional[float]:
+    kind = getattr(device, "device_kind", "").lower()
+    for key in sorted(PEAK_TFLOPS, key=len, reverse=True):
+        if key in kind:
+            return PEAK_TFLOPS[key]
+    return None
+
+
+@dataclass
+class ModuleProfile:
+    name: str
+    params: int
+    fwd_flops: float  # per step at the given batch/seq
+    activation_bytes: int
+
+
+@dataclass
+class ModelProfile:
+    batch: int
+    seq: int
+    modules: List[ModuleProfile] = field(default_factory=list)
+
+    @property
+    def total_params(self) -> int:
+        return sum(m.params for m in self.modules)
+
+    @property
+    def fwd_flops(self) -> float:
+        return sum(m.fwd_flops for m in self.modules)
+
+    @property
+    def step_flops(self) -> float:
+        """fwd + bwd ≈ 3x fwd (the standard 6ND/2ND split)."""
+        return 3.0 * self.fwd_flops
+
+    @property
+    def activation_bytes(self) -> int:
+        return sum(m.activation_bytes for m in self.modules)
+
+    def report(self) -> str:
+        lines = [
+            f"{'module':<18}{'params':>12}{'fwd GFLOPs':>14}{'act MB':>10}"
+        ]
+        for m in self.modules:
+            lines.append(
+                f"{m.name:<18}{m.params:>12,}"
+                f"{m.fwd_flops / 1e9:>14.2f}"
+                f"{m.activation_bytes / 1e6:>10.1f}"
+            )
+        lines.append(
+            f"{'TOTAL':<18}{self.total_params:>12,}"
+            f"{self.fwd_flops / 1e9:>14.2f}"
+            f"{self.activation_bytes / 1e6:>10.1f}"
+        )
+        lines.append(
+            f"step (fwd+bwd) ≈ {self.step_flops / 1e12:.3f} TFLOPs @ "
+            f"batch={self.batch} seq={self.seq}"
+        )
+        return "\n".join(lines)
+
+
+def profile_model(
+    cfg: TransformerConfig, batch: int, seq: int, act_bytes: int = 2
+) -> ModelProfile:
+    """Analytic per-module accounting (parity: prof.py:489-650 flops
+    formulas, transformer-specialized)."""
+    d, f, v = cfg.model_dim, cfg.ffn_dim, cfg.vocab_size
+    h, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    T, B = seq, batch
+    tok = B * T
+    prof = ModelProfile(batch=batch, seq=seq)
+
+    emb_params = v * d + (0 if cfg.rope else cfg.max_seq_len * d)
+    prof.modules.append(
+        ModuleProfile("embed", emb_params, 0.0, tok * d * act_bytes)
+    )
+
+    for i in range(cfg.num_layers):
+        qkv_params = d * (h + 2 * kvh) * hd + h * hd * d
+        attn_flops = 2.0 * tok * d * (h + 2 * kvh) * hd  # projections
+        attn_flops += 2.0 * tok * h * hd * d  # output proj
+        attn_flops += 2.0 * B * h * T * T * hd  # qk^T, causal halves it
+        attn_flops += 2.0 * B * h * T * T * hd / 2  # softmax*v (causal)
+        attn_act = tok * (h + 2 * kvh) * hd * act_bytes + tok * d * act_bytes
+        prof.modules.append(
+            ModuleProfile(
+                f"block{i}.attn", qkv_params, attn_flops, attn_act
+            )
+        )
+        if cfg.num_experts and i % cfg.moe_every == cfg.moe_every - 1:
+            mlp_params = cfg.num_experts * 2 * d * f + d * cfg.num_experts
+            mlp_flops = 2.0 * tok * 2 * d * f  # top-1: same flops as dense
+        elif cfg.swiglu:
+            mlp_params = 3 * d * f
+            mlp_flops = 2.0 * tok * 3 * d * f
+        else:
+            mlp_params = 2 * d * f + f + d
+            mlp_flops = 2.0 * tok * 2 * d * f
+        prof.modules.append(
+            ModuleProfile(
+                f"block{i}.mlp", mlp_params, mlp_flops,
+                tok * f * act_bytes,
+            )
+        )
+
+    head_params = 0 if cfg.tie_embeddings else d * v
+    prof.modules.append(
+        ModuleProfile(
+            "lm_head", head_params, 2.0 * tok * d * v,
+            tok * v * 4,  # logits are fp32
+        )
+    )
+    return prof
+
+
+@dataclass
+class StepMeasurement:
+    step_seconds: float
+    achieved_tflops: float
+    mfu_pct: Optional[float]
+    device_kind: str
+
+
+def measure_step(
+    step_fn, state, args: tuple, model_flops: float, iters: int = 10
+) -> StepMeasurement:
+    """Time a compiled train step and report achieved TFLOP/s + MFU."""
+    import jax
+
+    state, _ = step_fn(state, *args)  # compile + warmup
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, _ = step_fn(state, *args)
+    jax.block_until_ready(state.params)
+    dt = (time.perf_counter() - t0) / iters
+    tflops = model_flops / dt / 1e12
+    dev = jax.devices()[0]
+    peak = chip_peak_tflops(dev)
+    n_dev = len(jax.devices())
+    return StepMeasurement(
+        step_seconds=dt,
+        achieved_tflops=tflops,
+        mfu_pct=(
+            round(100.0 * tflops / (peak * n_dev), 2) if peak else None
+        ),
+        device_kind=getattr(dev, "device_kind", "unknown"),
+    )

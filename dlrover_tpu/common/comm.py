@@ -442,6 +442,9 @@ class DataLoaderConfig(Message):
 class OptimizerConfig(Message):
     optimizer_name: str = ""
     learning_rate: float = 0.0
+    # multiply the LR by this when the master retunes the batch size
+    # (linear-scaling rule)
+    batch_size_factor: float = 1.0
     version: int = 0
 
 

@@ -64,7 +64,7 @@ class DistributedJobMaster(LocalJobMaster):
         plan = ScalePlan(
             node_group={"worker": len(nodes)}, launch_nodes=nodes
         )
-        self.auto_scaler._scaler.scale(plan)
+        self.auto_scaler.execute_plan(plan)
 
     def prepare(self):
         super().prepare()

@@ -139,9 +139,13 @@ class ElasticJobOperator(PollingDaemon):
                 logger.error(f"reconcile of job {name} failed: {e!r}")
 
     # -- ScalePlan → pods ----------------------------------------------
+    KEEP_SUCCEEDED = 5  # retained per tick for operator debugging
+
     def reconcile_scaleplans(self):
+        succeeded = []
         for plan in self._api.list_custom_objects(self._ns, "scaleplans"):
             if plan.get("status", {}).get("phase") == "Succeeded":
+                succeeded.append(plan["metadata"]["name"])
                 continue
             try:
                 self._apply_scaleplan(plan)
@@ -150,6 +154,11 @@ class ElasticJobOperator(PollingDaemon):
                 logger.error(
                     f"applying {plan['metadata']['name']} failed: {e!r}"
                 )
+        # GC: a long elastic job writes a CR per scaling action; without
+        # pruning, etcd grows and every tick rescans the backlog. Names
+        # embed (epoch_ms, serial), so lexicographic sort ≈ age.
+        for name in sorted(succeeded)[: -self.KEEP_SUCCEEDED or None]:
+            self._api.delete_custom_object(self._ns, "scaleplans", name)
 
     def _apply_scaleplan(self, plan: dict):
         name = plan["metadata"]["name"]

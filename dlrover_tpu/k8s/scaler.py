@@ -14,7 +14,7 @@ from typing import Dict, List, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.node import Node
-from dlrover_tpu.k8s.client import K8sApi
+from dlrover_tpu.k8s.client import AlreadyExists, K8sApi
 from dlrover_tpu.master.scaler import ScalePlan, Scaler
 
 JOB_LABEL = "elastic.dlrover-tpu.org/job"
@@ -38,8 +38,6 @@ def build_worker_pod(
     _create_pod + resource.go NewPod). The template comes from the
     ElasticJob replicaSpec; we stamp identity labels + env."""
     body = json.loads(json.dumps(template)) if template else {
-        "apiVersion": "v1",
-        "kind": "Pod",
         "spec": {
             "restartPolicy": "Never",
             "containers": [
@@ -47,6 +45,10 @@ def build_worker_pod(
             ],
         },
     }
+    # replica templates are podTemplateSpecs (metadata+spec only); the
+    # API server rejects a POST without apiVersion/kind
+    body["apiVersion"] = "v1"
+    body["kind"] = "Pod"
     meta = body.setdefault("metadata", {})
     meta["name"] = pod_name(job_name, node)
     meta["namespace"] = namespace
@@ -117,7 +119,14 @@ class PodScaler(Scaler):
                 namespace=self._ns,
             )
             logger.info(f"pod scaler creating {body['metadata']['name']}")
-            self._api.create_pod(self._ns, body)
+            try:
+                self._api.create_pod(self._ns, body)
+            except AlreadyExists:
+                # master restarted over surviving pods, or a re-applied
+                # plan: converged is converged — and an abort here would
+                # strand the REST of launch_nodes (their table entries
+                # already look alive, so nothing would retry them)
+                pass
 
 
 class ElasticJobScaler(Scaler):

@@ -41,6 +41,8 @@ class JobAutoScaler(PollingDaemon):
         target_nodes: int = 0,
         node_unit: int = 1,
         interval: float = 15.0,
+        resource_optimizer=None,
+        optimize_every_ticks: int = 20,
     ):
         super().__init__("job-auto-scaler", interval)
         self._job_manager = job_manager
@@ -51,6 +53,9 @@ class JobAutoScaler(PollingDaemon):
             job_manager.get_nodes(node_type)
         )
         self._node_unit = max(1, node_unit)
+        self._optimizer = resource_optimizer
+        self._optimize_every = max(1, optimize_every_ticks)
+        self._ticks = 0
 
     @property
     def has_scaler(self) -> bool:
@@ -58,6 +63,37 @@ class JobAutoScaler(PollingDaemon):
 
     def _tick(self):
         self.check_and_scale()
+        self._ticks += 1
+        if self._optimizer and self._ticks % self._optimize_every == 0:
+            self.run_optimization_pass()
+
+    def run_optimization_pass(self):
+        """Consult the resource optimizer (parity: PSTrainingAutoScaler
+        executing optimizer plans, job_auto_scaler.py:98). Only the
+        worker-count recommendation is acted on here; memory changes
+        apply at the next relaunch through node config_resource."""
+        plan = self._optimizer.generate_plan()
+        if plan.empty():
+            return
+        logger.info(f"resource plan: {plan}")
+        if plan.worker_count and plan.worker_count != self._target:
+            self.scale_to(plan.worker_count)
+        if plan.worker_memory_mb:
+            with self._job_manager.scale_lock:
+                for node in self.alive_nodes():
+                    # grow only: the OOM-doubled bump from the relaunch
+                    # path must never be trimmed back by a headroom
+                    # estimate computed from pre-OOM samples
+                    if plan.worker_memory_mb > node.config_resource.memory_mb:
+                        node.config_resource.memory_mb = (
+                            plan.worker_memory_mb
+                        )
+
+    def execute_plan(self, plan: ScalePlan):
+        """Public seam: hand a plan to the platform scaler (keeps other
+        components off the private _scaler)."""
+        if self._scaler is not None:
+            self._scaler.scale(plan)
 
     # -- core -----------------------------------------------------------
     def alive_nodes(self):

@@ -22,6 +22,7 @@ from dlrover_tpu.master.job_manager import LocalJobManager
 from dlrover_tpu.master.kv_store import KVStoreService
 from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.paral_config import ParalConfigService
+from dlrover_tpu.master.resource.optimizer import JobResourceOptimizer
 from dlrover_tpu.master.rdzv_manager import (
     ElasticTrainingRendezvousManager,
     NetworkCheckRendezvousManager,
@@ -48,12 +49,19 @@ class LocalJobMaster:
             speed_monitor=self.speed_monitor, scaler=scaler
         )
         self.job_manager.create_initial_nodes(node_num)
+        self.metric_collector = JobMetricCollector(
+            self.job_manager, self.speed_monitor
+        )
+        self.resource_optimizer = JobResourceOptimizer(
+            metric_collector=self.metric_collector, node_unit=node_unit
+        )
         self.auto_scaler = JobAutoScaler(
             self.job_manager,
             speed_monitor=self.speed_monitor,
             scaler=scaler,
             target_nodes=node_num,
             node_unit=node_unit,
+            resource_optimizer=self.resource_optimizer,
         )
         self.task_manager = TaskManager(self.speed_monitor)
         self.rdzv_managers = {
@@ -64,9 +72,6 @@ class LocalJobMaster:
         self.sync_service = SyncService(self.job_manager)
         self.elastic_ps_service = ElasticPsService()
         self.paral_config_service = ParalConfigService()
-        self.metric_collector = JobMetricCollector(
-            self.job_manager, self.speed_monitor
-        )
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             job_manager=self.job_manager,

@@ -34,11 +34,36 @@ class ParalConfigService:
             self._global_config = config
 
     def suggest_initial_config(
-        self, batch_size: int, num_workers: int = 0
+        self,
+        batch_size: int,
+        num_workers: int = 0,
+        node_cpu: float = 0.0,
+        node_memory_mb: int = 0,
+        used_memory_mb: int = 0,
     ) -> comm.ParallelConfig:
-        """Initial suggestion (parity: SimpleStrategyGenerator)."""
+        """Initial dataloader/optimizer suggestion from node resources
+        (parity: SimpleStrategyGenerator simple_strategy_generator.py:179
+        — dataloader workers from CPU, batch size bounded by memory
+        headroom, LR scaled with the global batch).
+
+        With no resource information the caller's values pass through.
+        """
         config = comm.ParallelConfig()
+        if num_workers <= 0 and node_cpu > 0:
+            # the reference reserves ~half the cores for the training
+            # proc; IO workers get the rest, at least 2
+            num_workers = max(2, int(node_cpu // 2))
+        requested = batch_size
+        if node_memory_mb and used_memory_mb:
+            # batch scales with free memory headroom, capped at 4x the
+            # requested batch (runaway suggestions churn the dataloader)
+            headroom = max(
+                1.0, (node_memory_mb - used_memory_mb) / max(used_memory_mb, 1)
+            )
+            batch_size = min(int(batch_size * headroom), batch_size * 4)
         config.dataloader.batch_size = batch_size
         config.dataloader.num_workers = num_workers
+        # linear-scaling rule: LR multiplier tracking the batch growth
+        config.optimizer.batch_size_factor = batch_size / max(requested, 1)
         self.set_global_config(config)
         return config

@@ -1,0 +1,6 @@
+"""Job-level resource optimization (parity: dlrover/python/master/resource/)."""
+
+from dlrover_tpu.master.resource.optimizer import (  # noqa: F401
+    JobResourceOptimizer,
+    ResourcePlan,
+)

@@ -117,8 +117,9 @@ def profile_model(
         qkv_params = d * (h + 2 * kvh) * hd + h * hd * d
         attn_flops = 2.0 * tok * d * (h + 2 * kvh) * hd  # projections
         attn_flops += 2.0 * tok * h * hd * d  # output proj
-        attn_flops += 2.0 * B * h * T * T * hd  # qk^T, causal halves it
-        attn_flops += 2.0 * B * h * T * T * hd / 2  # softmax*v (causal)
+        # qk^T and softmax*v have identical causal structure: half each
+        attn_flops += 2.0 * B * h * T * T * hd / 2
+        attn_flops += 2.0 * B * h * T * T * hd / 2
         attn_act = tok * (h + 2 * kvh) * hd * act_bytes + tok * d * act_bytes
         prof.modules.append(
             ModuleProfile(
@@ -162,15 +163,25 @@ class StepMeasurement:
 def measure_step(
     step_fn, state, args: tuple, model_flops: float, iters: int = 10
 ) -> StepMeasurement:
-    """Time a compiled train step and report achieved TFLOP/s + MFU."""
+    """Time a compiled train step and report achieved TFLOP/s + MFU.
+
+    The (state, metrics) chain is forced by materializing the LAST
+    iteration's metrics on the host — ``block_until_ready`` alone has
+    been observed returning before execution finished on tunneled
+    runtimes, inflating MFU past 100%.
+    """
     import jax
 
-    state, _ = step_fn(state, *args)  # compile + warmup
-    jax.block_until_ready(state.params)
+    def _force(metrics):
+        leaf = jax.tree_util.tree_leaves(metrics)[0]
+        return float(np.asarray(leaf).ravel()[0])
+
+    state, metrics = step_fn(state, *args)  # compile + warmup
+    _force(metrics)
     t0 = time.perf_counter()
     for _ in range(iters):
-        state, _ = step_fn(state, *args)
-    jax.block_until_ready(state.params)
+        state, metrics = step_fn(state, *args)
+    _force(metrics)  # last metrics depend on every step's params
     dt = (time.perf_counter() - t0) / iters
     tflops = model_flops / dt / 1e12
     dev = jax.devices()[0]

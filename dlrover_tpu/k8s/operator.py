@@ -13,7 +13,7 @@ dlrover_tpu/k8s/crds/ are the contract).
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, List, Optional
 
 from dlrover_tpu.common.daemon import PollingDaemon
 from dlrover_tpu.common.log import default_logger as logger
@@ -141,11 +141,24 @@ class ElasticJobOperator(PollingDaemon):
     # -- ScalePlan → pods ----------------------------------------------
     KEEP_SUCCEEDED = 5  # retained per tick for operator debugging
 
+    @staticmethod
+    def _plan_age_key(name: str):
+        """(epoch_ms, serial) parsed from '<job>-scaleplan-<ms>-<n>';
+        lexicographic name order is NOT age order (unpadded serial)."""
+        try:
+            ms, serial = name.rsplit("-", 2)[-2:]
+            return (int(ms), int(serial))
+        except ValueError:
+            return (0, 0)
+
     def reconcile_scaleplans(self):
-        succeeded = []
+        succeeded: Dict[str, List[str]] = {}
         for plan in self._api.list_custom_objects(self._ns, "scaleplans"):
             if plan.get("status", {}).get("phase") == "Succeeded":
-                succeeded.append(plan["metadata"]["name"])
+                job = plan.get("spec", {}).get("ownerJob", "")
+                succeeded.setdefault(job, []).append(
+                    plan["metadata"]["name"]
+                )
                 continue
             try:
                 self._apply_scaleplan(plan)
@@ -155,10 +168,15 @@ class ElasticJobOperator(PollingDaemon):
                     f"applying {plan['metadata']['name']} failed: {e!r}"
                 )
         # GC: a long elastic job writes a CR per scaling action; without
-        # pruning, etcd grows and every tick rescans the backlog. Names
-        # embed (epoch_ms, serial), so lexicographic sort ≈ age.
-        for name in sorted(succeeded)[: -self.KEEP_SUCCEEDED or None]:
-            self._api.delete_custom_object(self._ns, "scaleplans", name)
+        # pruning, etcd grows and every tick rescans the backlog. Keep
+        # the newest KEEP_SUCCEEDED per job (by parsed age, per job so
+        # one busy job cannot evict another's debugging trail).
+        for names in succeeded.values():
+            names.sort(key=self._plan_age_key)
+            for name in names[: -self.KEEP_SUCCEEDED or None]:
+                self._api.delete_custom_object(
+                    self._ns, "scaleplans", name
+                )
 
     def _apply_scaleplan(self, plan: dict):
         name = plan["metadata"]["name"]

@@ -76,8 +76,15 @@ class JobAutoScaler(PollingDaemon):
         if plan.empty():
             return
         logger.info(f"resource plan: {plan}")
-        if plan.worker_count and plan.worker_count != self._target:
-            self.scale_to(plan.worker_count)
+        if plan.worker_count:
+            # compare unit-rounded: a recommendation that rounds back to
+            # the current target is a no-op and must not emit a fresh
+            # ScalePlan every pass
+            want = plan.worker_count
+            if want % self._node_unit:
+                want += self._node_unit - want % self._node_unit
+            if want != self._target:
+                self.scale_to(want)
         if plan.worker_memory_mb:
             with self._job_manager.scale_lock:
                 for node in self.alive_nodes():

@@ -47,6 +47,10 @@ class ElasticDataLoader:
         )
         self._collate_fn = collate_fn or _default_collate
         self._config_file = config_file
+        # linear-scaling LR multiplier the master retunes alongside the
+        # batch size (optimizer.batch_size_factor); trainers with
+        # injected hyperparams apply it (ElasticTrainer does)
+        self.lr_scale = 1.0
         self.load_config()
 
     @property
@@ -61,11 +65,14 @@ class ElasticDataLoader:
             self._batch_size = batch_size
 
     def load_config(self):
-        """Pick up a master-tuned batch size if present."""
+        """Pick up a master-tuned batch size / LR scale if present."""
         config = read_paral_config(self._config_file)
         dl = config.get("dataloader", {})
         if dl.get("batch_size"):
             self.set_batch_size(int(dl["batch_size"]))
+        factor = config.get("optimizer", {}).get("batch_size_factor")
+        if factor and factor > 0:
+            self.lr_scale = float(factor)
 
     def __iter__(self) -> Iterator:
         batch = []

@@ -135,6 +135,7 @@ class ElasticTrainer:
         start_step = self.global_step
         while self.global_step < num_steps:
             self.dataloader.load_config()  # master-retuned batch size
+            self._apply_lr_scale(self.dataloader.lr_scale)
             # epoch rollover and mid-epoch position both live in the
             # sampler (its iterator advances completed_num and bumps the
             # epoch on exhaustion) — the trainer never touches them, so a
@@ -167,6 +168,28 @@ class ElasticTrainer:
                     break
         jax.block_until_ready(self.state.params)
         return self.state
+
+    def _apply_lr_scale(self, scale: float):
+        """Linear-scaling rule: when the master retunes the batch size it
+        also publishes optimizer.batch_size_factor; if the optimizer was
+        built with ``optax.inject_hyperparams`` the learning rate is
+        rescaled in place (otherwise a one-time warning is logged)."""
+        if scale == getattr(self, "_applied_lr_scale", 1.0):
+            return
+        hp = getattr(self.state.opt_state, "hyperparams", None)
+        if hp is None or "learning_rate" not in hp:
+            if not getattr(self, "_warned_lr_scale", False):
+                logger.warning(
+                    f"master suggests lr scale {scale} but the optimizer "
+                    "has no injected hyperparams; build tx with "
+                    "optax.inject_hyperparams to enable retuning"
+                )
+                self._warned_lr_scale = True
+            return
+        prev = getattr(self, "_applied_lr_scale", 1.0)
+        hp["learning_rate"] = hp["learning_rate"] * (scale / prev)
+        self._applied_lr_scale = scale
+        logger.info(f"learning rate rescaled x{scale} (linear scaling)")
 
     def close(self):
         if self._ckptr is not None:

@@ -107,6 +107,36 @@ class TestElasticTrainer:
             strategy=Strategy(mesh=MeshConfig(dp=8), dtype="float32"),
         )
 
+    def test_lr_scale_applied_with_injected_hyperparams(self, tmp_path):
+        """Master-published batch_size_factor rescales the LR when the
+        optimizer carries injected hyperparams (linear-scaling rule)."""
+        import json
+        import optax
+
+        cfg_file = tmp_path / "paral.json"
+        json.dump(
+            {
+                "dataloader": {"batch_size": 8, "version": 1},
+                "optimizer": {"batch_size_factor": 2.0},
+            },
+            open(cfg_file, "w"),
+        )
+        t = ElasticTrainer(
+            model_cfg=tiny(),
+            tx=optax.inject_hyperparams(optax.adamw)(learning_rate=1e-2),
+            dataset=_Tokens(),
+            trainer_cfg=TrainerConfig(
+                batch_size=8, seq_len=32, report_metrics=False,
+                log_interval=1,
+            ),
+            strategy=Strategy(mesh=MeshConfig(dp=8), dtype="float32"),
+        )
+        t.dataloader._config_file = str(cfg_file)
+        t.train(num_steps=1)
+        assert float(
+            t.state.opt_state.hyperparams["learning_rate"]
+        ) == pytest.approx(2e-2)
+
     def test_trains_and_resumes(self, tmp_path):
         ckpt_dir = str(tmp_path / "flash")
         t1 = self._trainer(ckpt_dir)

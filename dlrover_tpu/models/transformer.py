@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from dlrover_tpu.models.config import TransformerConfig
 from dlrover_tpu.parallel.moe import (
@@ -284,8 +285,15 @@ def forward(
     tokens: jnp.ndarray,
     cfg: TransformerConfig,
     mesh=None,
+    return_hidden: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """tokens [B,T] int32 → (logits [B,T,vocab] fp32, moe_aux_loss)."""
+    """tokens [B,T] int32 → (logits [B,T,vocab] fp32, moe_aux_loss).
+
+    ``return_hidden=True`` returns the final-norm'd residual stream
+    [B,T,D] instead of logits and skips the vocab projection entirely —
+    the trunk for value heads / probes (the RLHF critic uses this, so
+    trunk math can never drift from the LM path).
+    """
     B, T = tokens.shape
     x = embed_tokens(params, tokens, cfg)
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
@@ -303,6 +311,8 @@ def forward(
         x, aux = block(x, layer)
         aux_total = aux_total + aux
 
+    if return_hidden:
+        return _norm(x, params["final_norm"], cfg), aux_total
     return lm_head(params, x, cfg), aux_total
 
 
@@ -316,3 +326,88 @@ def loss_fn(
 ) -> jnp.ndarray:
     logits, aux = forward(params, tokens, cfg, mesh)
     return token_nll(logits, targets) + moe_aux_weight * aux
+
+
+# ---------------------------------------------------------------------------
+# cached autoregressive decoding (generation / RLHF rollouts)
+# ---------------------------------------------------------------------------
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int):
+    """Per-layer K/V buffers [L, B, S, kv_heads, head_dim]. Static shape:
+    the whole decode loop stays inside one compiled ``lax.scan``."""
+    dt = _dtype(cfg)
+    shape = (cfg.num_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def forward_step(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    cache,
+    cur_len,
+) -> Tuple[jnp.ndarray, Any]:
+    """Cached forward: ``tokens`` [B, t] occupy positions
+    ``cur_len .. cur_len+t-1`` (t>1 = prefill chunk, t=1 = decode step).
+    Returns (logits [B, t, vocab] fp32, updated cache). Same weights and
+    math as ``forward`` — attention just reads K/V from the cache buffer
+    instead of recomputing them, the standard decode memory/FLOPs trade.
+    """
+    dt = _dtype(cfg)
+    B, t = tokens.shape
+    S = cache["k"].shape[2]
+    g = cfg.num_heads // cfg.kv_heads
+
+    x = params["embed"]["tokens"].astype(dt)[tokens]
+    positions = cur_len + jnp.arange(t)[None, :]  # [1, t] broadcasts to B
+    positions = jnp.broadcast_to(positions, (B, t))
+    if not cfg.rope:
+        pos_emb = lax.dynamic_slice_in_dim(
+            params["embed"]["positions"].astype(dt), cur_len, t
+        )
+        x = x + pos_emb[None]
+
+    # key-position mask: a query at cur_len+i sees keys 0..cur_len+i
+    key_pos = jnp.arange(S)[None, None, :]  # [1, 1, S]
+    q_pos = positions[:, :, None]  # [B, t, 1]
+    mask = key_pos <= q_pos  # [B, t, S]
+
+    new_k, new_v = [], []
+    for i, layer in enumerate(params["layers"]):
+        h = _norm(x, layer["attn_norm"], cfg)
+        q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"].astype(dt))
+        k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"].astype(dt))
+        v = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wv"].astype(dt))
+        if cfg.rope:
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+        k_all = lax.dynamic_update_slice(
+            cache["k"][i], k.astype(cache["k"].dtype), (0, cur_len, 0, 0)
+        )
+        v_all = lax.dynamic_update_slice(
+            cache["v"][i], v.astype(cache["v"].dtype), (0, cur_len, 0, 0)
+        )
+        new_k.append(k_all)
+        new_v.append(v_all)
+        # GQA: fold the head group next to kv heads, no KV replication.
+        # fp32 accumulation throughout, matching the flash path's
+        # numerics (a bf16-accumulated decode would diverge from the
+        # teacher-forced re-scoring and bias PPO ratios)
+        qg = q.reshape(B, t, cfg.kv_heads, g, cfg.head_dim)
+        scores = jnp.einsum(
+            "btkgh,bskh->bkgts", qg, k_all,
+            preferred_element_type=jnp.float32,
+        ) * (cfg.head_dim**-0.5)
+        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum(
+            "bkgts,bskh->btkgh", probs, v_all,
+            preferred_element_type=jnp.float32,
+        ).astype(dt)
+        o = o.reshape(B, t, cfg.num_heads, cfg.head_dim)
+        x = x + jnp.einsum(
+            "bthk,hkd->btd", o, layer["attn"]["wo"].astype(dt)
+        )
+        x, _ = _mlp_block(x, layer, cfg, None)
+
+    logits = lm_head(params, x, cfg)
+    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}

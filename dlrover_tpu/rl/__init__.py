@@ -1,0 +1,15 @@
+"""RLHF engine (parity: atorch/atorch/rl/ — model engine, PPO trainer,
+replay buffer, generation backend).
+
+TPU-native re-design: the reference juggles four torch models across a
+DeepSpeed hybrid engine (train ↔ inference mode switches,
+ds_hybrid_engine/hybrid_engine.py:378) and an external vLLM-style
+backend. On TPU none of that split exists: generation is the same jitted
+program family as training (a ``lax.scan`` decode loop over a static
+KV cache, models/transformer.forward_step), so actor rollouts, reward
+scoring and PPO updates all run under one mesh with no weight shuttling.
+"""
+
+from dlrover_tpu.rl.generation import generate  # noqa: F401
+from dlrover_tpu.rl.buffer import ReplayBuffer  # noqa: F401
+from dlrover_tpu.rl.ppo import PPOConfig, RLHFEngine  # noqa: F401

@@ -1,0 +1,102 @@
+"""Batched autoregressive generation with a static KV cache.
+
+Parity: the reference's inference backend for RLHF rollouts
+(atorch/rl/model_engine/model_engine.py generation path + its
+vLLM-style backend). The TPU equivalent is a single compiled program:
+prefill the prompt in one ``forward_step`` call, then ``lax.scan`` the
+decode steps over a preallocated cache — static shapes throughout, so
+XLA pipelines the whole rollout with no host round-trips.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlrover_tpu.models.config import TransformerConfig
+from dlrover_tpu.models.transformer import (
+    Params,
+    forward_step,
+    init_kv_cache,
+)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "temperature", "greedy"),
+)
+def generate(
+    params: Params,
+    prompt: jnp.ndarray,
+    key,
+    cfg: TransformerConfig,
+    max_new_tokens: int = 32,
+    temperature: float = 1.0,
+    greedy: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """prompt [B, P] int32 → (tokens [B, P+N], logprobs [B, N]).
+
+    ``logprobs`` are the actor's log-probs of each sampled token — the
+    rollout statistics PPO needs, captured during generation instead of
+    with a second forward pass.
+    """
+    B, P = prompt.shape
+    N = max_new_tokens
+    cache = init_kv_cache(cfg, B, P + N)
+
+    # prefill: one chunked call for the whole prompt
+    logits, cache = forward_step(params, prompt, cfg, cache, 0)
+    last_logits = logits[:, -1]
+
+    def sample(logits, key):
+        if greedy:
+            tok = jnp.argmax(logits, axis=-1)
+            scaled = logits
+        else:
+            scaled = logits / temperature
+            tok = jax.random.categorical(key, scaled, axis=-1)
+        # logprobs under the ACTUAL sampling distribution (temperature-
+        # scaled): these are PPO's behavior-policy logprobs, and a
+        # mismatch here biases the importance ratio and KL estimate
+        logp = jax.nn.log_softmax(scaled, axis=-1)
+        tok_logp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
+        return tok.astype(jnp.int32), tok_logp
+
+    def step(carry, key):
+        cache, last_logits, pos = carry
+        tok, tok_logp = sample(last_logits, key)
+        logits, cache = forward_step(
+            params, tok[:, None], cfg, cache, pos
+        )
+        return (cache, logits[:, -1], pos + 1), (tok, tok_logp)
+
+    keys = jax.random.split(key, N)
+    (_, _, _), (toks, logps) = lax.scan(
+        step, (cache, last_logits, P), keys
+    )
+    tokens = jnp.concatenate([prompt, toks.T], axis=1)
+    return tokens, logps.T
+
+
+def sequence_logprobs(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    prompt_len: int,
+) -> jnp.ndarray:
+    """Teacher-forced per-token log-probs of the completion part of
+    ``tokens`` [B, P+N] → [B, N]. Used for the reference-policy KL and
+    for re-scoring under updated actor weights."""
+    from dlrover_tpu.models.transformer import forward
+
+    logits, _ = forward(params, tokens[:, :-1], cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    targets = tokens[:, 1:]
+    tok_logp = jnp.take_along_axis(logp, targets[..., None], axis=-1)[
+        ..., 0
+    ]
+    return tok_logp[:, prompt_len - 1 :]

@@ -1,0 +1,246 @@
+"""PPO for LM alignment: the RLHF engine.
+
+Parity: atorch/rl/trainer (PPO trainer), rl/model_engine/model_engine.py
+(actor/critic/ref/reward model management) and the DS hybrid engine's
+train↔generate switching — which TPU doesn't need: rollout and update
+are two jitted programs over the same mesh.
+
+Pieces:
+- actor = the trained LM; ref = frozen copy (KL anchor); critic = value
+  head over the actor's architecture (own params); reward_fn = any
+  callable scoring full sequences (a learned reward model or a
+  programmatic reward).
+- KL-shaped per-token rewards (reward at the last token, minus
+  kl_coef·KL everywhere), GAE(λ) advantages, clipped policy + value
+  losses — the standard InstructGPT/trlx recipe the reference implements.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.models.config import TransformerConfig
+from dlrover_tpu.models.transformer import forward, init_params
+from dlrover_tpu.rl.buffer import Experience, ReplayBuffer
+from dlrover_tpu.rl.generation import generate, sequence_logprobs
+
+
+@dataclass(frozen=True)
+class PPOConfig:
+    rollout_batch: int = 8
+    max_new_tokens: int = 16
+    temperature: float = 1.0
+    kl_coef: float = 0.1
+    gamma: float = 1.0
+    lam: float = 0.95
+    clip_ratio: float = 0.2
+    value_clip: float = 0.2
+    vf_coef: float = 0.5
+    ppo_epochs: int = 2
+    minibatch_size: int = 8
+    learning_rate: float = 1e-5
+
+
+def init_critic_params(key, cfg: TransformerConfig):
+    """Critic = transformer trunk + scalar value head."""
+    trunk = init_params(key, cfg)
+    head = (
+        jax.random.normal(jax.random.fold_in(key, 1), (cfg.model_dim,))
+        * cfg.model_dim**-0.5
+    )
+    return {"trunk": trunk, "value_head": head}
+
+
+def critic_values(cparams, tokens, cfg: TransformerConfig, prompt_len: int):
+    """Per-position values over the completion [B, N] (value of the
+    state *before* each generated token). The trunk IS the LM forward
+    (``return_hidden`` skips the vocab projection), so critic math can
+    never drift from the model path and remat applies."""
+    hidden, _ = forward(cparams["trunk"], tokens, cfg, return_hidden=True)
+    values = jnp.einsum(
+        "btd,d->bt", hidden.astype(jnp.float32), cparams["value_head"]
+    )
+    return values[:, prompt_len - 1 : -1]
+
+
+def gae_advantages(rewards, values, gamma: float, lam: float):
+    """[B, N] rewards/values → (advantages, returns), standard GAE(λ)."""
+    B, N = rewards.shape
+
+    def step(carry, t):
+        adv_next = carry
+        v_next = jnp.where(t + 1 < N, values[:, (t + 1) % N], 0.0)
+        delta = rewards[:, t] + gamma * v_next - values[:, t]
+        adv = delta + gamma * lam * adv_next
+        return adv, adv
+
+    _, advs = jax.lax.scan(
+        step, jnp.zeros(B), jnp.arange(N - 1, -1, -1)
+    )
+    advantages = advs[::-1].T  # [B, N]
+    return advantages, advantages + values
+
+
+class RLHFEngine:
+    """Owns actor/ref/critic state and the rollout→train cycle."""
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        reward_fn: Callable[[np.ndarray, int], np.ndarray],
+        ppo: Optional[PPOConfig] = None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.ppo = ppo or PPOConfig()
+        self.reward_fn = reward_fn
+        key = jax.random.PRNGKey(seed)
+        self.actor_params = init_params(key, cfg)
+        self.ref_params = jax.tree_util.tree_map(
+            lambda x: x, self.actor_params
+        )  # frozen copy
+        self.critic_params = init_critic_params(
+            jax.random.fold_in(key, 7), cfg
+        )
+        self.tx = optax.adamw(self.ppo.learning_rate)
+        self.opt_state = self.tx.init(
+            {"actor": self.actor_params, "critic": self.critic_params}
+        )
+        self.buffer = ReplayBuffer()
+        self._np_rng = np.random.default_rng(seed)
+        self._key = jax.random.fold_in(key, 99)
+        self._train_step = jax.jit(
+            functools.partial(
+                _ppo_update, cfg=cfg, ppo=self.ppo, tx=self.tx
+            ),
+            static_argnums=(3,),  # prompt_len slices the token axis
+        )
+        # rollout scoring is jitted too (two full forwards per rollout
+        # would otherwise dispatch op-by-op); prompt_len stays static
+        self._seq_logprobs = jax.jit(
+            functools.partial(sequence_logprobs, cfg=cfg),
+            static_argnames=("prompt_len",),
+        )
+        self._critic_values = jax.jit(
+            functools.partial(critic_values, cfg=cfg),
+            static_argnames=("prompt_len",),
+        )
+
+    # -- rollout --------------------------------------------------------
+    def make_experience(self, prompts: np.ndarray) -> Experience:
+        """Rollout + score + advantage (parity: trlx/atorch
+        make_experience): generate with the actor, KL-shape rewards
+        against the frozen ref, GAE with the critic."""
+        P = prompts.shape[1]
+        self._key, k = jax.random.split(self._key)
+        tokens, logprobs = generate(
+            self.actor_params,
+            jnp.asarray(prompts),
+            k,
+            self.cfg,
+            max_new_tokens=self.ppo.max_new_tokens,
+            temperature=self.ppo.temperature,
+        )
+        ref_logprobs = self._seq_logprobs(
+            self.ref_params, tokens, prompt_len=P
+        )
+        values = self._critic_values(
+            self.critic_params, tokens, prompt_len=P
+        )
+        tokens_np = np.asarray(tokens)
+        # sequence-level reward lands on the final token; per-token KL
+        # penalty shapes the rest (InstructGPT recipe)
+        seq_reward = np.asarray(
+            self.reward_fn(tokens_np, P), dtype=np.float32
+        )
+        kl = np.asarray(logprobs - ref_logprobs)
+        rewards = -self.ppo.kl_coef * kl
+        rewards[:, -1] += seq_reward
+        advantages, returns = gae_advantages(
+            jnp.asarray(rewards),
+            jnp.asarray(values),
+            self.ppo.gamma,
+            self.ppo.lam,
+        )
+        exp = Experience(
+            tokens=tokens_np,
+            logprobs=np.asarray(logprobs),
+            ref_logprobs=np.asarray(ref_logprobs),
+            values=np.asarray(values),
+            rewards=rewards,
+            advantages=np.asarray(advantages),
+            returns=np.asarray(returns),
+        )
+        self.buffer.add(exp)
+        return exp
+
+    # -- update ---------------------------------------------------------
+    def train(self, prompt_len: int) -> dict:
+        """PPO epochs over the buffer; returns last minibatch metrics."""
+        metrics = {}
+        params = {"actor": self.actor_params, "critic": self.critic_params}
+        for _ in range(self.ppo.ppo_epochs):
+            for mb in self.buffer.minibatches(
+                self.ppo.minibatch_size, self._np_rng
+            ):
+                params, self.opt_state, metrics = self._train_step(
+                    params,
+                    self.opt_state,
+                    {k: jnp.asarray(v) for k, v in mb.items()},
+                    prompt_len,
+                )
+        self.actor_params = params["actor"]
+        self.critic_params = params["critic"]
+        self.buffer.clear()
+        return {k: float(v) for k, v in metrics.items()}
+
+
+def _ppo_update(params, opt_state, mb, prompt_len, *, cfg, ppo, tx):
+    def loss_fn(params):
+        new_logprobs = sequence_logprobs(
+            params["actor"], mb["tokens"], cfg, prompt_len
+        )
+        ratio = jnp.exp(new_logprobs - mb["logprobs"])
+        adv = mb["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pg1 = ratio * adv
+        pg2 = jnp.clip(ratio, 1 - ppo.clip_ratio, 1 + ppo.clip_ratio) * adv
+        policy_loss = -jnp.mean(jnp.minimum(pg1, pg2))
+
+        values = critic_values(
+            params["critic"], mb["tokens"], cfg, prompt_len
+        )
+        v_clipped = mb["values"] + jnp.clip(
+            values - mb["values"], -ppo.value_clip, ppo.value_clip
+        )
+        vf_loss = 0.5 * jnp.mean(
+            jnp.maximum(
+                (values - mb["returns"]) ** 2,
+                (v_clipped - mb["returns"]) ** 2,
+            )
+        )
+        loss = policy_loss + ppo.vf_coef * vf_loss
+        return loss, {
+            "loss": loss,
+            "policy_loss": policy_loss,
+            "value_loss": vf_loss,
+            "approx_kl": jnp.mean(mb["logprobs"] - new_logprobs),
+            "clip_frac": jnp.mean(
+                (jnp.abs(ratio - 1) > ppo.clip_ratio).astype(jnp.float32)
+            ),
+        }
+
+    (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        params
+    )
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, metrics

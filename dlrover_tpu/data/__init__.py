@@ -1,0 +1,8 @@
+"""Host-side data plane (parity: atorch data/ — shm coworker feeds,
+elastic datasets)."""
+
+from dlrover_tpu.data.shm_feed import (  # noqa: F401
+    ShmBatchReader,
+    ShmBatchWriter,
+    ShmDataFeeder,
+)

@@ -240,6 +240,82 @@ void kv_sparse_momentum(void* h, const int64_t* keys, int64_t n,
   }
 }
 
+// Fused sparse Adam (parity: training_ops.cc group/sparse Adam family):
+// slot0 = m, slot1 = v; bias-corrected update using the caller's step
+// count. Requires num_slots >= 2.
+void kv_sparse_adam(void* h, const int64_t* keys, int64_t n,
+                    const float* grads, float lr, float beta1,
+                    float beta2, float eps, int64_t step, int64_t now) {
+  Store* s = static_cast<Store*>(h);
+  const float bc1 = 1.0f - __builtin_powf(beta1, (float)step);
+  const float bc2 = 1.0f - __builtin_powf(beta2, (float)step);
+  for (int64_t i = 0; i < n; ++i) {
+    Bucket& b = s->bucket(keys[i]);
+    std::lock_guard<std::mutex> g(b.mu);
+    Row& row = find_or_create(s, b, keys[i], now, nullptr);
+    float* w = row.data.data();
+    float* m = w + s->dim;
+    float* v = w + 2 * s->dim;
+    const float* gr = grads + i * s->dim;
+    for (int64_t d = 0; d < s->dim; ++d) {
+      m[d] = beta1 * m[d] + (1.0f - beta1) * gr[d];
+      v[d] = beta2 * v[d] + (1.0f - beta2) * gr[d] * gr[d];
+      const float mhat = m[d] / bc1;
+      const float vhat = v[d] / bc2;
+      w[d] -= lr * mhat / (__builtin_sqrtf(vhat) + eps);
+    }
+    row.ts = now;
+    row.version = s->next_version();
+  }
+}
+
+// Fused sparse group-lasso FTRL (parity: the "Group Adam/Adagrad" paper
+// ops in training_ops.cc / sparse_group_ftrl.py): per-coordinate FTRL
+// accumulators (slot0 = n, slot1 = z) with an L2,1 group penalty that
+// zeroes WHOLE embedding rows of rarely-useful keys — the sparsity the
+// reference's recommender workloads rely on. Requires num_slots >= 2.
+void kv_sparse_group_ftrl(void* h, const int64_t* keys, int64_t nkeys,
+                          const float* grads, float alpha, float beta,
+                          float l1, float l21, int64_t now) {
+  Store* s = static_cast<Store*>(h);
+  for (int64_t i = 0; i < nkeys; ++i) {
+    Bucket& b = s->bucket(keys[i]);
+    std::lock_guard<std::mutex> g(b.mu);
+    Row& row = find_or_create(s, b, keys[i], now, nullptr);
+    float* w = row.data.data();
+    float* acc = w + s->dim;  // n accumulator
+    float* z = w + 2 * s->dim;
+    const float* gr = grads + i * s->dim;
+    // accumulate, then solve the proximal step for the whole row
+    for (int64_t d = 0; d < s->dim; ++d) {
+      const float n_new = acc[d] + gr[d] * gr[d];
+      const float sigma =
+          (__builtin_sqrtf(n_new) - __builtin_sqrtf(acc[d])) / alpha;
+      z[d] += gr[d] - sigma * w[d];
+      acc[d] = n_new;
+    }
+    // per-coordinate soft threshold (l1), collect row norm of the
+    // thresholded pseudo-weights
+    float norm2 = 0.0f;
+    for (int64_t d = 0; d < s->dim; ++d) {
+      const float zd = z[d];
+      const float sgn = zd > 0.f ? 1.f : (zd < 0.f ? -1.f : 0.f);
+      const float mag = zd * sgn - l1;  // |z| - l1
+      const float u = mag > 0.f ? sgn * mag : 0.f;
+      w[d] = u;  // stash u; scaled below
+      norm2 += u * u;
+    }
+    const float norm = __builtin_sqrtf(norm2);
+    const float group = norm > l21 ? (1.0f - l21 / norm) : 0.0f;
+    for (int64_t d = 0; d < s->dim; ++d) {
+      const float denom = (beta + __builtin_sqrtf(acc[d])) / alpha;
+      w[d] = -group * w[d] / denom;
+    }
+    row.ts = now;
+    row.version = s->next_version();
+  }
+}
+
 // Export rows whose version > since (0 = full export). Two-phase: count,
 // then fill caller-allocated buffers. Rows: full row incl. slots.
 int64_t kv_export_count(void* h, uint64_t since) {

@@ -71,6 +71,12 @@ def _load_library() -> ctypes.CDLL:
         lib.kv_scatter.argtypes = [p, I64P, i64, F32P, ctypes.c_int, i64]
         lib.kv_sparse_adagrad.argtypes = [p, I64P, i64, F32P, f32, f32, i64]
         lib.kv_sparse_momentum.argtypes = [p, I64P, i64, F32P, f32, f32, i64]
+        lib.kv_sparse_adam.argtypes = [
+            p, I64P, i64, F32P, f32, f32, f32, f32, i64, i64,
+        ]
+        lib.kv_sparse_group_ftrl.argtypes = [
+            p, I64P, i64, F32P, f32, f32, f32, f32, i64,
+        ]
         lib.kv_export_count.restype = i64
         lib.kv_export_count.argtypes = [p, u64]
         lib.kv_export.restype = i64
@@ -163,6 +169,54 @@ class KvEmbeddingStore:
         )
         self._lib.kv_sparse_momentum(
             self._h, k, len(k), g, lr, momentum, _now()
+        )
+
+    def sparse_adam(
+        self,
+        keys,
+        grads,
+        lr: float,
+        step: int,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+        eps: float = 1e-8,
+    ):
+        """Fused sparse Adam (slots: m, v; needs num_slots >= 2).
+        ``step`` is the 1-based update count for bias correction."""
+        if self.num_slots < 2:
+            raise ValueError("sparse_adam needs num_slots >= 2 (m, v)")
+        if step < 1:
+            # step=0 would make the bias correction 1-beta^0 = 0 and
+            # divide every update into inf/NaN
+            raise ValueError(f"step must be >= 1 (got {step})")
+        k = self._keys(keys)
+        g = np.ascontiguousarray(grads, dtype=np.float32).reshape(
+            len(k), self.dim
+        )
+        self._lib.kv_sparse_adam(
+            self._h, k, len(k), g, lr, beta1, beta2, eps, step, _now()
+        )
+
+    def sparse_group_ftrl(
+        self,
+        keys,
+        grads,
+        alpha: float = 0.05,
+        beta: float = 1.0,
+        l1: float = 0.0,
+        l21: float = 0.0,
+    ):
+        """Fused group-lasso FTRL (slots: n, z; needs num_slots >= 2).
+        ``l21`` zeroes whole rows whose thresholded signal is weak —
+        the group sparsity of the reference's recommender optimizers."""
+        if self.num_slots < 2:
+            raise ValueError("sparse_group_ftrl needs num_slots >= 2")
+        k = self._keys(keys)
+        g = np.ascontiguousarray(grads, dtype=np.float32).reshape(
+            len(k), self.dim
+        )
+        self._lib.kv_sparse_group_ftrl(
+            self._h, k, len(k), g, alpha, beta, l1, l21, _now()
         )
 
     def meta(self, keys) -> Tuple[np.ndarray, np.ndarray]:
@@ -285,6 +339,33 @@ class ShardedKvEmbedding:
 
     def sparse_momentum(self, keys, grads, lr: float, momentum: float = 0.9):
         self._per_shard("sparse_momentum", keys, grads, lr, momentum)
+
+    def sparse_adam(
+        self,
+        keys,
+        grads,
+        lr: float,
+        step: int,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+        eps: float = 1e-8,
+    ):
+        self._per_shard(
+            "sparse_adam", keys, grads, lr, step, beta1, beta2, eps
+        )
+
+    def sparse_group_ftrl(
+        self,
+        keys,
+        grads,
+        alpha: float = 0.05,
+        beta: float = 1.0,
+        l1: float = 0.0,
+        l21: float = 0.0,
+    ):
+        self._per_shard(
+            "sparse_group_ftrl", keys, grads, alpha, beta, l1, l21
+        )
 
     # -- elastic resharding --------------------------------------------
     def reshard(self, new_num_shards: int) -> None:

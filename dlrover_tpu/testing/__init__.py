@@ -1,0 +1,3 @@
+"""Test harnesses (parity: dlrover/trainer/mock process schedulers)."""
+
+from dlrover_tpu.testing.mock_cluster import LocalCluster  # noqa: F401

@@ -1,0 +1,116 @@
+"""LocalCluster: a whole multi-node elastic job as local subprocesses.
+
+Parity: the reference's mock process schedulers
+(dlrover/trainer/mock/tf_process_scheduler.py:60,
+base_process_scheduler.py:112) that its CI system tests run full PS
+"clusters" with. One in-process master + N ``dlrover-tpu-run`` launcher
+subprocesses (each = agent + training procs), with kill/relaunch hooks
+for chaos testing.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.local_master import LocalJobMaster, start_local_master
+from dlrover_tpu.utils.env import child_env
+
+
+class LocalCluster:
+    """``with LocalCluster(2, script) as c: rc = c.wait()``"""
+
+    def __init__(
+        self,
+        num_nodes: int,
+        training_script: str,
+        script_args: Optional[List[str]] = None,
+        nproc_per_node: int = 1,
+        device_spec: str = "cpu:1",
+        extra_args: Optional[List[str]] = None,
+        env: Optional[Dict[str, str]] = None,
+    ):
+        self.num_nodes = num_nodes
+        self._script = training_script
+        self._script_args = script_args or []
+        self._nproc = nproc_per_node
+        self._device_spec = device_spec
+        self._extra = extra_args or []
+        self._env = env or {}
+        self.master: Optional[LocalJobMaster] = None
+        self.procs: Dict[int, subprocess.Popen] = {}
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "LocalCluster":
+        self.master = start_local_master(node_num=self.num_nodes)
+        for rank in range(self.num_nodes):
+            self.start_node(rank)
+        return self
+
+    def node_cmd(self, rank: int) -> List[str]:
+        return [
+            sys.executable,
+            "-m",
+            "dlrover_tpu.trainer.run",
+            f"--nnodes={self.num_nodes}",
+            f"--node-rank={rank}",
+            f"--nproc-per-node={self._nproc}",
+            f"--master-addr={self.master.addr}",
+            f"--device-spec={self._device_spec}",
+            "--monitor-interval=0.3",
+            *self._extra,
+            self._script,
+            *self._script_args,
+        ]
+
+    def start_node(self, rank: int):
+        env = child_env()
+        env.update(self._env)
+        proc = subprocess.Popen(self.node_cmd(rank), env=env)
+        self.procs[rank] = proc
+        logger.info(f"cluster node {rank} pid={proc.pid}")
+        return proc
+
+    # -- chaos ----------------------------------------------------------
+    def kill_node(self, rank: int, sig: int = 9):
+        proc = self.procs.get(rank)
+        if proc is not None and proc.poll() is None:
+            logger.info(f"killing cluster node {rank} (pid {proc.pid})")
+            proc.send_signal(sig)
+
+    # -- join -----------------------------------------------------------
+    def wait(self, timeout: float = 120.0) -> Dict[int, int]:
+        """Join every node; returns {rank: returncode}."""
+        deadline = time.time() + timeout
+        rcs: Dict[int, int] = {}
+        for rank, proc in self.procs.items():
+            remain = max(0.5, deadline - time.time())
+            try:
+                rcs[rank] = proc.wait(timeout=remain)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                rcs[rank] = proc.wait()
+        return rcs
+
+    def stop(self):
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if self.master is not None:
+            self.master.stop()
+            self.master = None
+
+    def __enter__(self) -> "LocalCluster":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -81,6 +81,43 @@ class TestKvStore:
             s.gather(keys), w0 - 0.25, rtol=1e-6, atol=1e-7
         )
 
+    def test_sparse_adam_matches_numpy(self, dim):
+        s = KvEmbeddingStore(dim, num_slots=2, seed=0)
+        keys = np.array([3, 4], np.int64)
+        w = s.gather(keys).copy()
+        m = np.zeros((2, dim), np.float32)
+        v = np.zeros((2, dim), np.float32)
+        lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+        rng = np.random.default_rng(1)
+        for t in range(1, 6):
+            g = rng.normal(size=(2, dim)).astype(np.float32)
+            s.sparse_adam(keys, g, lr=lr, step=t, beta1=b1, beta2=b2, eps=eps)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            mhat = m / (1 - b1**t)
+            vhat = v / (1 - b2**t)
+            w -= lr * mhat / (np.sqrt(vhat) + eps)
+        np.testing.assert_allclose(s.gather(keys), w, rtol=1e-4, atol=1e-6)
+
+    def test_group_ftrl_zeroes_weak_rows(self, dim):
+        """The L2,1 penalty must null entire rows with weak signal while
+        strong rows survive — the reference's group-sparse behavior."""
+        s = KvEmbeddingStore(dim, num_slots=2, seed=0)
+        strong, weak = np.array([1], np.int64), np.array([2], np.int64)
+        for _ in range(10):
+            s.sparse_group_ftrl(
+                strong, np.full((1, dim), 1.0, np.float32),
+                alpha=0.5, l21=0.1,
+            )
+            s.sparse_group_ftrl(
+                weak, np.full((1, dim), 1e-3, np.float32),
+                alpha=0.5, l21=0.1,
+            )
+        w_strong = s.gather(strong, insert_missing=False)
+        w_weak = s.gather(weak, insert_missing=False)
+        assert np.abs(w_strong).sum() > 0
+        np.testing.assert_array_equal(w_weak, np.zeros((1, dim)))
+
     def test_freq_and_ts_metadata(self, dim):
         s = KvEmbeddingStore(dim)
         s.gather([7])

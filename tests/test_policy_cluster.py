@@ -1,0 +1,74 @@
+"""Mixed-precision policies + the LocalCluster system-test harness."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.models import tiny
+from dlrover_tpu.models.policy import PRESETS, MixedPrecisionPolicy
+from dlrover_tpu.testing import LocalCluster
+
+ASSETS = os.path.join(os.path.dirname(__file__), "assets")
+
+
+class TestPolicy:
+    def test_parse_and_apply(self):
+        p = MixedPrecisionPolicy.parse("params=f32,compute=bf16")
+        assert p.param_dtype == "float32"
+        assert p.compute_dtype == "bfloat16"
+        cfg = p.apply(tiny())
+        assert cfg.dtype == "bfloat16" and cfg.param_dtype == "float32"
+
+    def test_presets_and_errors(self):
+        assert MixedPrecisionPolicy.parse("mixed_bf16") == PRESETS["mixed_bf16"]
+        full = MixedPrecisionPolicy.parse("full_bf16").apply(tiny())
+        assert full.param_dtype == "bfloat16"
+        with pytest.raises(ValueError):
+            MixedPrecisionPolicy.parse("compute=int7")
+        with pytest.raises(ValueError):
+            MixedPrecisionPolicy.parse("banana=f32")
+
+    def test_policy_trains(self):
+        """A policy-stamped config runs a real step (bf16 compute, fp32
+        params) with finite loss."""
+        import jax
+        import optax
+
+        from dlrover_tpu.models import (
+            build_train_step,
+            init_sharded_state,
+            shard_batch,
+        )
+        from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+        cfg = PRESETS["mixed_bf16"].apply(tiny())
+        mesh = build_mesh(MeshConfig(dp=8))
+        tx = optax.adamw(1e-3)
+        state, _ = init_sharded_state(jax.random.PRNGKey(0), cfg, mesh, tx)
+        assert state.params["embed"]["tokens"].dtype == np.float32
+        step = build_train_step(cfg, mesh, tx, donate=False)
+        x = np.zeros((8, 16), np.int32)
+        b = shard_batch({"x": x, "y": x}, mesh)
+        _, metrics = step(state, b["x"], b["y"])
+        assert np.isfinite(float(metrics["loss"]))
+
+
+@pytest.mark.slow
+class TestLocalCluster:
+    def test_two_node_job_completes(self):
+        with LocalCluster(
+            2, os.path.join(ASSETS, "exit0.py"), device_spec=""
+        ) as cluster:
+            rcs = cluster.wait(timeout=90)
+        assert rcs == {0: 0, 1: 0}
+
+    def test_killed_node_fails_cleanly(self):
+        """Chaos hook: a SIGKILLed node reports failure; the survivor
+        still finishes its own work."""
+        with LocalCluster(
+            2, os.path.join(ASSETS, "exit0.py"), device_spec=""
+        ) as cluster:
+            cluster.kill_node(1)
+            rcs = cluster.wait(timeout=90)
+        assert rcs[1] != 0

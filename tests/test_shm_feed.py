@@ -1,0 +1,66 @@
+"""Coworker shm batch feed: real producer processes, real shm."""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.data import ShmBatchReader, ShmBatchWriter, ShmDataFeeder
+
+
+def _produce(worker_id):
+    rng = np.random.default_rng(worker_id)
+    for i in range(5):
+        yield {
+            "x": rng.integers(0, 100, (4, 8)).astype(np.int32),
+            "y": np.full((4,), worker_id, np.int32),
+            "step": i,
+        }
+
+
+class TestShmFeed:
+    def test_single_process_roundtrip(self):
+        reader = ShmBatchReader("t_rt", slot_bytes=1 << 16, num_slots=2)
+        writer = ShmBatchWriter("t_rt", slot_bytes=1 << 16)
+        try:
+            batch = {"a": np.arange(10), "b": (np.ones(3), 2)}
+            writer.put(batch)
+            got = reader.get()
+            np.testing.assert_array_equal(got["a"], np.arange(10))
+            np.testing.assert_array_equal(got["b"][0], np.ones(3))
+            # slots recycle: more puts than slots
+            for i in range(5):
+                writer.put({"i": np.full(4, i)})
+                assert reader.get()["i"][0] == i
+        finally:
+            writer.close()
+            reader.close()
+
+    def test_oversized_batch_rejected(self):
+        reader = ShmBatchReader("t_big", slot_bytes=1024, num_slots=2)
+        writer = ShmBatchWriter("t_big", slot_bytes=1024)
+        try:
+            with pytest.raises(ValueError):
+                writer.put({"x": np.zeros(10_000)})
+        finally:
+            writer.close()
+            reader.close()
+
+    def test_multiworker_feeder_end_to_end(self):
+        """2 real coworker processes × 5 batches each, all delivered."""
+        feeder = ShmDataFeeder(
+            _produce, num_workers=2, slot_bytes=1 << 16
+        )
+        try:
+            batches = list(feeder)
+            assert len(batches) == 10
+            workers = {int(b["y"][0]) for b in batches}
+            assert workers == {0, 1}
+            steps_by_worker = {
+                w: sorted(
+                    b["step"] for b in batches if int(b["y"][0]) == w
+                )
+                for w in workers
+            }
+            # per-worker order preserved, nothing lost or duplicated
+            assert steps_by_worker == {0: list(range(5)), 1: list(range(5))}
+        finally:
+            feeder.close()

@@ -35,6 +35,14 @@ from dlrover_tpu.common.multi_process import (
 _HEADER = struct.Struct("<Q")  # payload byte length
 
 
+class StopSentinel:
+    """Returned by ``ShmBatchReader.get`` when a worker's stream ended
+    (a plain tuple could collide with a user batch)."""
+
+    def __init__(self, worker_id: int):
+        self.worker_id = worker_id
+
+
 def _flatten(batch: Any) -> bytes:
     """Batch pytree (dicts/tuples of numpy arrays) → bytes. Arrays are
     serialized with np.save semantics via pickle protocol 5 out-of-band
@@ -87,7 +95,12 @@ class ShmBatchReader:
     """Consumer side: creates the ring (K slots + queues), yields
     batches, recycles slots."""
 
-    STOP = -1
+    # stop sentinels are negative and identify the worker: -(wid+1).
+    # Anonymous STOPs would double-count a worker that both posted its
+    # STOP (finally:) and exited nonzero (seen by the liveness poll).
+    @staticmethod
+    def stop_token(worker_id: int) -> int:
+        return -(worker_id + 1)
 
     def __init__(self, name: str, slot_bytes: int, num_slots: int = 4):
         self._name = name
@@ -107,19 +120,16 @@ class ShmBatchReader:
             self._segments.append(seg)
             self._free.put(slot)
 
-    def get(self, timeout: float = 60.0) -> Optional[Any]:
-        """Next batch, or None when a producer posted STOP."""
+    def get(self, timeout: float = 60.0):
+        """Next batch, or a ``StopSentinel`` when a worker finished."""
         slot = self._ready.get(timeout=timeout)
-        if slot == self.STOP:
-            return None
+        if slot < 0:
+            return StopSentinel(-slot - 1)
         seg = self._segments[slot]
         (n,) = _HEADER.unpack(bytes(seg.buf[: _HEADER.size]))
         batch = _unflatten(bytes(seg.buf[_HEADER.size : _HEADER.size + n]))
         self._free.put(slot)  # recycle AFTER the copy out of shm
         return batch
-
-    def post_stop(self):
-        self._ready.put(self.STOP)
 
     def close(self):
         for seg in self._segments:
@@ -135,12 +145,25 @@ def _worker_main(
     produce_fn: Callable[[int], Iterator[Any]],
     worker_id: int,
 ):
+    import queue as _queue
+
     writer = ShmBatchWriter(name, slot_bytes)
     try:
         for batch in produce_fn(worker_id):
-            writer.put(batch)
+            while True:
+                try:
+                    writer.put(batch)
+                    break
+                except _queue.Empty:
+                    # all slots leased while the trainer stalls (XLA
+                    # compile routinely exceeds the lease timeout on the
+                    # first step) — keep waiting, don't die
+                    logger.info(
+                        f"shm feed worker {worker_id}: ring full, "
+                        f"trainer busy; retrying"
+                    )
     finally:
-        writer._ready.put(ShmBatchReader.STOP)
+        writer._ready.put(ShmBatchReader.stop_token(worker_id))
         writer.close()
 
 
@@ -184,28 +207,27 @@ class ShmDataFeeder:
         # detected by polling exit codes instead of hanging forever
         import queue as _queue
 
-        stops = 0
-        dead_seen: set = set()
-        while stops + len(dead_seen) < len(self._procs):
+        finished: set = set()  # stop-posted OR observed dead, deduped
+        while len(finished) < len(self._procs):
             try:
                 batch = self._reader.get(timeout=5.0)
             except _queue.Empty:
                 for i, p in enumerate(self._procs):
-                    if i not in dead_seen and p.exitcode not in (None, 0):
+                    if i not in finished and p.exitcode not in (None, 0):
                         logger.warning(
                             f"shm feed worker {i} died "
                             f"(exitcode {p.exitcode}); its remaining "
                             f"batches are lost"
                         )
-                        dead_seen.add(i)
+                        finished.add(i)
                 if all(p.exitcode is not None for p in self._procs):
                     # every worker exited and the queue has been dry for
                     # a full timeout: nothing more is coming (covers
                     # re-iterating an already-drained single-pass feeder)
                     return
                 continue
-            if batch is None:
-                stops += 1
+            if isinstance(batch, StopSentinel):
+                finished.add(batch.worker_id)
                 continue
             yield batch
 

@@ -286,6 +286,19 @@ void kv_sparse_group_ftrl(void* h, const int64_t* keys, int64_t nkeys,
     float* acc = w + s->dim;  // n accumulator
     float* z = w + 2 * s->dim;
     const float* gr = grads + i * s->dim;
+    // First FTRL touch of a row created by gather (random init, zero
+    // accumulators): seed z so the proximal solve reproduces the
+    // initial weights (z = -w*(beta+sqrt(n))/alpha, TF Ftrl's init
+    // convention; exact when l1=l21=0). Without this the random init
+    // would leak into z as a permanent bias AND be discarded from w.
+    {
+      bool untouched = true;
+      for (int64_t d = 0; d < s->dim && untouched; ++d)
+        untouched = acc[d] == 0.0f && z[d] == 0.0f;
+      if (untouched) {
+        for (int64_t d = 0; d < s->dim; ++d) z[d] = -w[d] * beta / alpha;
+      }
+    }
     // accumulate, then solve the proximal step for the whole row
     for (int64_t d = 0; d < s->dim; ++d) {
       const float n_new = acc[d] + gr[d] * gr[d];

@@ -1,0 +1,135 @@
+"""SparseTrainer: elastic sparse (embedding/recommender) training.
+
+Parity: the reference's TF-PS path — EstimatorExecutor + PS failover
+(dlrover/trainer/tensorflow/executor/estimator_executor.py:52,
+failover/tensorflow_failover.py:33) over TFPlus KvVariable embeddings.
+The TPU shape replaces the parameter-server fleet with the host-side
+``ShardedKvEmbedding`` store (C++; ops/embedding): the DENSE model
+trains on the chip under jit, the SPARSE embedding rows live in host
+memory with fused native optimizers, and elasticity means
+
+- checkpoint = dense pytree (flash ckpt) + embedding export (npz);
+- failover = watch the master's PS cluster version; on a bump (a
+  reshard happened elsewhere, or we are a restarted worker) re-import
+  the embedding state before continuing — the analog of the reference's
+  relaunch-aware session refresh (tensorflow_failover.py:91).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.ops.embedding import ShardedKvEmbedding
+
+
+class SparseTrainer:
+    """Embedding-store-backed training loop with elastic checkpointing.
+
+    ``dense_step(dense_params, rows, batch) ->
+    (dense_params, row_grads, metrics)`` is the user's jitted dense
+    computation; the trainer owns the gather → step → fused-sparse-update
+    cycle, checkpoints, and cluster-version failover.
+    """
+
+    def __init__(
+        self,
+        embedding: ShardedKvEmbedding,
+        dense_params: Any,
+        dense_step: Callable,
+        ckpt_dir: str = "",
+        sparse_optimizer: str = "adagrad",
+        sparse_lr: float = 0.05,
+        master_client=None,
+    ):
+        self.embedding = embedding
+        self.dense_params = dense_params
+        self._dense_step = dense_step
+        self._ckpt_dir = ckpt_dir
+        self._opt = sparse_optimizer
+        self._lr = sparse_lr
+        self._client = master_client
+        self._cluster_version = (
+            master_client.get_cluster_version() if master_client else 0
+        )
+        self.step = 0
+
+    # -- sparse update dispatch ----------------------------------------
+    def _apply_sparse(self, keys, grads):
+        if self._opt == "adagrad":
+            self.embedding.sparse_adagrad(keys, grads, lr=self._lr)
+        elif self._opt == "adam":
+            self.embedding.sparse_adam(
+                keys, grads, lr=self._lr, step=self.step + 1
+            )
+        elif self._opt == "momentum":
+            self.embedding.sparse_momentum(keys, grads, lr=self._lr)
+        elif self._opt == "group_ftrl":
+            self.embedding.sparse_group_ftrl(keys, grads, alpha=self._lr)
+        else:
+            raise ValueError(f"unknown sparse optimizer {self._opt!r}")
+
+    # -- failover -------------------------------------------------------
+    def check_failover(self) -> bool:
+        """True if the PS cluster version moved and state was reloaded
+        (parity: ps_addresses_changed → session refresh)."""
+        if self._client is None:
+            return False
+        version = self._client.get_cluster_version()
+        if version == self._cluster_version:
+            return False
+        logger.warning(
+            f"embedding cluster version {self._cluster_version} -> "
+            f"{version}: reloading sparse state"
+        )
+        self._cluster_version = version
+        self.restore_embedding()
+        return True
+
+    # -- train loop -----------------------------------------------------
+    def train_step(self, ids: np.ndarray, batch: Any) -> Dict:
+        """One cycle: gather rows → dense step on device → fused sparse
+        update on host."""
+        rows = self.embedding.gather(ids)
+        self.dense_params, row_grads, metrics = self._dense_step(
+            self.dense_params, rows, batch
+        )
+        self._apply_sparse(ids, np.asarray(row_grads))
+        self.step += 1
+        return metrics
+
+    # -- checkpoint -----------------------------------------------------
+    def _emb_path(self) -> str:
+        return os.path.join(self._ckpt_dir, "embedding_state.npz")
+
+    def save_embedding(self):
+        if not self._ckpt_dir:
+            return
+        os.makedirs(self._ckpt_dir, exist_ok=True)
+        state = self.embedding.export_state()
+        # np.savez appends .npz to names without it — keep the suffix on
+        # the temp file so the atomic rename targets what was written
+        tmp = self._emb_path().replace(".npz", f".tmp{os.getpid()}.npz")
+        np.savez(tmp, step=self.step, **state)
+        os.replace(tmp, self._emb_path())
+        logger.info(
+            f"saved embedding state ({len(state['keys'])} rows) at "
+            f"step {self.step}"
+        )
+
+    def restore_embedding(self) -> bool:
+        path = self._emb_path()
+        if not os.path.exists(path):
+            return False
+        data = dict(np.load(path))
+        self.step = int(data.pop("step", 0))
+        self.embedding.import_state(data)
+        logger.info(
+            f"restored embedding state ({len(data['keys'])} rows) at "
+            f"step {self.step}"
+        )
+        return True

@@ -152,6 +152,26 @@ def profile_model(
     return prof
 
 
+def trace_steps(
+    step_fn, state, args: tuple, trace_dir: str, steps: int = 3
+):
+    """Capture an XLA execution trace of ``steps`` train steps into
+    ``trace_dir`` (TensorBoard/Perfetto-viewable). Parity: atorch's
+    execution tracer (utils/tracer.py) — on TPU the runtime's own
+    profiler already records per-op device timelines, so "tracing" is
+    one context manager, not an interposer."""
+    import jax
+
+    state, metrics = step_fn(state, *args)  # compile outside the trace
+    jax.block_until_ready(jax.tree_util.tree_leaves(metrics))
+    with jax.profiler.trace(trace_dir):
+        for _ in range(steps):
+            state, metrics = step_fn(state, *args)
+        leaf = jax.tree_util.tree_leaves(metrics)[0]
+        float(np.asarray(leaf).ravel()[0])  # force inside the trace
+    return trace_dir
+
+
 @dataclass
 class StepMeasurement:
     step_seconds: float

@@ -8,3 +8,6 @@ from dlrover_tpu.ops.embedding.store import (  # noqa: F401
     KvEmbeddingStore,
     ShardedKvEmbedding,
 )
+from dlrover_tpu.ops.embedding.ckpt import (  # noqa: F401
+    IncrementalCheckpointManager,
+)

@@ -1,0 +1,153 @@
+"""Incremental embedding checkpoints: full snapshots + version deltas.
+
+Parity: TFPlus's incremental checkpoint manager
+(tfplus/kv_variable/python/training/checkpoint_manager.py:333) built on
+KvVariable FullOrDeltaExport — recommender embedding tables are huge but
+churn slowly, so persisting only rows touched since the last save cuts
+checkpoint cost by orders of magnitude. Here the native store's
+per-row mutation versions drive it: a full snapshot every
+``full_every`` saves, deltas (rows with version > last saved version,
+per shard) in between; restore = latest full + deltas in order (delta
+rows carry full values+slots, so import order is the only invariant).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.ops.embedding.store import ShardedKvEmbedding
+
+
+class IncrementalCheckpointManager:
+    def __init__(
+        self,
+        store: ShardedKvEmbedding,
+        directory: str,
+        full_every: int = 10,
+        keep_history: int = 2,
+    ):
+        self._store = store
+        self._dir = directory
+        self._full_every = max(1, full_every)
+        self._keep_history = max(1, keep_history)
+        # per-shard version at the last save; len mismatch (resharded
+        # store) forces the next save to be full
+        self._last_versions: List[int] = []
+        # deltas written since this manager's last full (None = none yet)
+        self._saves_since_full: Optional[int] = None
+        os.makedirs(directory, exist_ok=True)
+        # file indices must be unique against whatever already lives in
+        # the directory (restore trims the manifest; len(entries) would
+        # collide with surviving higher-numbered files and a later GC
+        # would delete a live checkpoint)
+        self._save_count = self._next_index()
+
+    def _next_index(self) -> int:
+        indices = [
+            int(e["file"].rsplit("_", 1)[1].split(".")[0])
+            for e in self._read_manifest()
+        ]
+        return max(indices) + 1 if indices else 0
+
+    # -- manifest -------------------------------------------------------
+    def _manifest_path(self) -> str:
+        return os.path.join(self._dir, "manifest.json")
+
+    def _read_manifest(self) -> List[dict]:
+        try:
+            with open(self._manifest_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return []
+
+    def _write_manifest(self, entries: List[dict]):
+        tmp = f"{self._manifest_path()}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(entries, f)
+        os.replace(tmp, self._manifest_path())
+
+    # -- save -----------------------------------------------------------
+    def save(self, step: int = 0) -> str:
+        """Write one checkpoint; returns the file path. Full when due
+        (cadence, first save, or the store was resharded), else delta."""
+        shards = self._store.shards
+        force_full = (
+            self._saves_since_full is None
+            or self._saves_since_full >= self._full_every
+            or len(self._last_versions) != len(shards)
+        )
+        state = self._store.export_state(
+            since_versions=None if force_full else self._last_versions
+        )
+        keys = state["keys"]
+        kind = "full" if force_full else "delta"
+        name = f"{kind}_{self._save_count:06d}.npz"
+        path = os.path.join(self._dir, name)
+        tmp = path.replace(".npz", f".tmp{os.getpid()}.npz")
+        np.savez(tmp, step=step, **state)
+        os.replace(tmp, path)
+
+        entries = self._read_manifest()
+        entries.append(
+            {"file": name, "kind": kind, "step": step, "rows": len(keys)}
+        )
+        self._write_manifest(entries)
+        self._last_versions = self._store.shard_versions()
+        self._save_count += 1
+        self._saves_since_full = (
+            0 if force_full else self._saves_since_full + 1
+        )
+        logger.info(
+            f"embedding ckpt {name}: {len(keys)} rows ({kind})"
+        )
+        self._gc(entries)
+        return path
+
+    def _gc(self, entries: List[dict]):
+        """Keep the last ``keep_history`` full chains; drop older files."""
+        full_idx = [
+            i for i, e in enumerate(entries) if e["kind"] == "full"
+        ]
+        if len(full_idx) <= self._keep_history:
+            return
+        cut = full_idx[-self._keep_history]
+        dead, live = entries[:cut], entries[cut:]
+        for e in dead:
+            try:
+                os.remove(os.path.join(self._dir, e["file"]))
+            except OSError:
+                pass
+        self._write_manifest(live)
+
+    # -- restore --------------------------------------------------------
+    def restore(self) -> Optional[int]:
+        """Latest full + subsequent deltas, in order. Returns the last
+        saved training step, or None when nothing is restorable."""
+        entries = self._read_manifest()
+        full_idx = [
+            i for i, e in enumerate(entries) if e["kind"] == "full"
+        ]
+        if not full_idx:
+            return None
+        chain = entries[full_idx[-1] :]
+        step = 0
+        for e in chain:
+            path = os.path.join(self._dir, e["file"])
+            data = dict(np.load(path))
+            step = int(data.pop("step", 0))
+            self._store.import_state(data)
+        logger.info(
+            f"restored embedding from {len(chain)} files "
+            f"(1 full + {len(chain) - 1} deltas), step {step}"
+        )
+        # future deltas must be relative to what is now in the store;
+        # the restored chain counts as a fresh full for cadence purposes
+        self._last_versions = self._store.shard_versions()
+        self._save_count = self._next_index()
+        self._saves_since_full = len(chain) - 1
+        return step

@@ -397,14 +397,25 @@ class ShardedKvEmbedding:
         )
 
     # -- checkpoint ----------------------------------------------------
-    def export_state(self) -> Dict[str, np.ndarray]:
-        parts = [s.export() for s in self.shards]
+    def export_state(
+        self, since_versions: Optional[List[int]] = None
+    ) -> Dict[str, np.ndarray]:
+        """Full export, or a delta (rows newer than the per-shard
+        versions) when ``since_versions`` is given."""
+        since = since_versions or [0] * len(self.shards)
+        parts = [
+            s.export(since_version=v)
+            for s, v in zip(self.shards, since)
+        ]
         return {
             "keys": np.concatenate([p[0] for p in parts]),
             "rows": np.concatenate([p[1] for p in parts]),
             "freq": np.concatenate([p[2] for p in parts]),
             "ts": np.concatenate([p[3] for p in parts]),
         }
+
+    def shard_versions(self) -> List[int]:
+        return [s.version for s in self.shards]
 
     def import_state(self, state: Dict[str, np.ndarray]) -> None:
         keys = state["keys"]

@@ -1,0 +1,16 @@
+"""Ray platform layer (parity: dlrover/python/master/scaler/ray_scaler.py:134,
+watcher/ray_watcher.py, client/platform/ray/ray_job_submitter.py).
+
+Same shape as the k8s layer: a narrow ``RayApi`` seam (real SDK gated on
+``import ray``; in-memory fake for tests/simulation), a Scaler, a
+watcher, and a job submitter. On TPU, Ray actors map to per-host agent
+processes exactly like pods do.
+"""
+
+from dlrover_tpu.ray.platform import (  # noqa: F401
+    FakeRayApi,
+    RayActorScaler,
+    RayApi,
+    RayJobSubmitter,
+    RayWatcher,
+)

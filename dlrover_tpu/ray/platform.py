@@ -1,0 +1,254 @@
+"""Ray scaler/watcher/submitter over a narrow API seam.
+
+Parity: the reference's ray path — ``ActorScaler``
+(master/scaler/ray_scaler.py:134) converges scale plans into named
+actors, ``ActorWatcher`` polls actor states into node events, and
+``RayJobSubmitter`` (client/platform/ray/ray_job_submitter.py) submits
+the whole job. The SDK never appears outside ``RealRayApi`` so the
+control logic tests against ``FakeRayApi`` (the reference mocks ray the
+same way) and the master can be built rayless.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeEventType, NodeStatus
+from dlrover_tpu.common.daemon import PollingDaemon
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.job_manager import JobManager, NodeEvent
+from dlrover_tpu.master.scaler import ScalePlan, Scaler
+
+
+def actor_name(job: str, node: Node) -> str:
+    return f"{job}-{node.type}-{node.id}"
+
+
+class RayApi:
+    """What the control plane needs from a Ray cluster."""
+
+    def create_actor(self, name: str, spec: dict) -> None:
+        raise NotImplementedError
+
+    def remove_actor(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def list_actors(self, job: str) -> Dict[str, str]:
+        """{actor_name: state} — state in ALIVE/PENDING/DEAD."""
+        raise NotImplementedError
+
+    def submit_job(self, entrypoint: str, runtime_env: dict) -> str:
+        raise NotImplementedError
+
+
+class RealRayApi(RayApi):  # pragma: no cover - needs a ray cluster
+    def __init__(self, address: str = "auto"):
+        try:
+            import ray
+        except ImportError as e:
+            raise ImportError(
+                "the 'ray' package is required for the ray platform"
+            ) from e
+        self._ray = ray
+        ray.init(address=address, ignore_reinit_error=True)
+
+    def create_actor(self, name: str, spec: dict) -> None:
+        import subprocess
+
+        @self._ray.remote(num_cpus=spec.get("num_cpus", 1))
+        class _Agent:
+            def run(self, cmd):
+                return subprocess.run(cmd).returncode
+
+        actor = _Agent.options(name=name, lifetime="detached").remote()
+        actor.run.remote(spec["cmd"])
+
+    def remove_actor(self, name: str) -> bool:
+        try:
+            self._ray.kill(self._ray.get_actor(name))
+            return True
+        except ValueError:
+            return False
+
+    def list_actors(self, job: str) -> Dict[str, str]:
+        from ray.util.state import list_actors
+
+        return {
+            a.name: a.state
+            for a in list_actors()
+            if a.name and a.name.startswith(f"{job}-")
+        }
+
+    def submit_job(self, entrypoint: str, runtime_env: dict) -> str:
+        from ray.job_submission import JobSubmissionClient
+
+        client = JobSubmissionClient()
+        return client.submit_job(
+            entrypoint=entrypoint, runtime_env=runtime_env
+        )
+
+
+class FakeRayApi(RayApi):
+    """In-memory cluster double (reference pattern: mocked ray)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.actors: Dict[str, dict] = {}
+        self.states: Dict[str, str] = {}
+        self.submitted: List[dict] = []
+
+    def create_actor(self, name, spec):
+        with self._lock:
+            self.actors[name] = spec
+            self.states[name] = "PENDING"
+
+    def remove_actor(self, name):
+        with self._lock:
+            self.states.pop(name, None)
+            return self.actors.pop(name, None) is not None
+
+    def list_actors(self, job):
+        with self._lock:
+            return {
+                n: s
+                for n, s in self.states.items()
+                if n.startswith(f"{job}-")
+            }
+
+    def set_state(self, name, state):
+        with self._lock:
+            if name in self.states:
+                self.states[name] = state
+
+    def submit_job(self, entrypoint, runtime_env):
+        with self._lock:
+            self.submitted.append(
+                {"entrypoint": entrypoint, "runtime_env": runtime_env}
+            )
+            return f"raysubmit_{len(self.submitted)}"
+
+
+class RayActorScaler(Scaler):
+    """ScalePlan → named detached actors running the launcher
+    (parity: ray_scaler.py:134)."""
+
+    def __init__(
+        self,
+        api: RayApi,
+        job_name: str,
+        training_cmd: Optional[List[str]] = None,
+        master_addr: str = "",
+        nproc_per_node: int = 1,
+        num_cpus: int = 1,
+    ):
+        self._api = api
+        self._job = job_name
+        # training script + args — the launcher's required positional;
+        # without it every actor would die on argparse at startup
+        self._training_cmd = training_cmd or []
+        self._master_addr = master_addr
+        self._nproc = nproc_per_node
+        self._num_cpus = num_cpus
+
+    def set_master_addr(self, addr: str):
+        self._master_addr = addr
+
+    def scale(self, plan: ScalePlan) -> None:
+        for node in plan.remove_nodes:
+            self._api.remove_actor(actor_name(self._job, node))
+        for node in plan.launch_nodes:
+            name = actor_name(self._job, node)
+            cmd = [
+                "python",
+                "-m",
+                "dlrover_tpu.trainer.run",
+                f"--master-addr={self._master_addr}",
+                f"--node-rank={node.rank_index}",
+                f"--nproc-per-node={self._nproc}",
+                *self._training_cmd,
+            ]
+            logger.info(f"ray scaler creating actor {name}")
+            self._api.create_actor(
+                name, {"cmd": cmd, "num_cpus": self._num_cpus}
+            )
+
+
+_STATE_MAP = {
+    "PENDING": NodeStatus.PENDING,
+    "ALIVE": NodeStatus.RUNNING,
+    "RESTARTING": NodeStatus.PENDING,
+    "DEAD": NodeStatus.FAILED,
+}
+
+
+class RayWatcher(PollingDaemon):
+    """Actor states → NodeEvents (parity: ray_watcher.py)."""
+
+    def __init__(
+        self,
+        api: RayApi,
+        job_manager: JobManager,
+        job_name: str,
+        interval: float = 5.0,
+    ):
+        super().__init__("ray-watcher", interval)
+        self._api = api
+        self._job_manager = job_manager
+        self._job = job_name
+        self._last: Dict[str, str] = {}
+
+    def _tick(self):
+        states = self._api.list_actors(self._job)
+        for name, state in states.items():
+            status = _STATE_MAP.get(state, NodeStatus.PENDING)
+            if self._last.get(name) == status:
+                continue
+            event = (
+                NodeEventType.ADDED
+                if name not in self._last
+                else NodeEventType.MODIFIED
+            )
+            self._last[name] = status
+            try:
+                node_type, node_id = name[len(self._job) + 1 :].rsplit(
+                    "-", 1
+                )
+                node = Node(node_type=node_type, node_id=int(node_id))
+            except ValueError:
+                continue
+            node.status = status
+            self._job_manager.process_event(NodeEvent(event, node))
+
+
+class RayJobSubmitter:
+    """Submit a whole dlrover-tpu job to a Ray cluster (parity:
+    ray_job_submitter.py)."""
+
+    def __init__(self, api: RayApi):
+        self._api = api
+
+    def submit(
+        self,
+        training_script: str,
+        num_nodes: int,
+        nproc_per_node: int = 1,
+        script_args: Optional[List[str]] = None,
+        working_dir: str = ".",
+    ) -> str:
+        import shlex
+
+        # the entrypoint is executed by a shell: quote everything so
+        # spaces/metacharacters in script paths or args survive intact
+        parts = [
+            "python", "-m", "dlrover_tpu.trainer.run",
+            f"--nnodes={num_nodes}",
+            f"--nproc-per-node={nproc_per_node}",
+            training_script,
+            *(script_args or []),
+        ]
+        entrypoint = shlex.join(parts)
+        return self._api.submit_job(
+            entrypoint, {"working_dir": working_dir}
+        )

@@ -1,5 +1,7 @@
 """Resource optimizer, strategy generator and profiler."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -138,6 +140,33 @@ class TestProfiler:
         prof = profile_model(cfg, batch=1, seq=1024)
         six_nd = 6.0 * prof.total_params * 1024
         assert prof.step_flops == pytest.approx(six_nd, rel=0.5)
+
+    def test_trace_steps_writes_profile(self, tmp_path):
+        import glob
+
+        import jax
+        import optax
+
+        from dlrover_tpu.accel.profiler import trace_steps
+        from dlrover_tpu.models import (
+            build_train_step,
+            init_sharded_state,
+            shard_batch,
+        )
+        from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+        cfg = tiny()
+        mesh = build_mesh(MeshConfig(dp=len(jax.devices())))
+        tx = optax.adamw(1e-3)
+        state, _ = init_sharded_state(jax.random.PRNGKey(0), cfg, mesh, tx)
+        step = build_train_step(cfg, mesh, tx, donate=False)
+        x = np.zeros((8, 16), np.int32)
+        b = shard_batch({"x": x, "y": x}, mesh)
+        out = trace_steps(
+            step, state, (b["x"], b["y"]), str(tmp_path / "trace"), steps=2
+        )
+        traces = glob.glob(os.path.join(out, "**", "*.trace*"), recursive=True)
+        assert traces, os.listdir(out)
 
     def test_measure_step(self):
         import jax

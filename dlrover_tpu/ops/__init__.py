@@ -10,7 +10,10 @@ transforms.
 from dlrover_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from dlrover_tpu.ops.optimizers import agd, make_wsam_grad_fn  # noqa: F401
 from dlrover_tpu.ops.quantized_optim import (  # noqa: F401
+    adamw_4bit,
     adamw_8bit,
+    dequantize_4bit,
     dequantize_8bit,
+    quantize_4bit,
     quantize_8bit,
 )

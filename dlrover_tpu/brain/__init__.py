@@ -1,0 +1,19 @@
+"""Brain: cluster-level resource optimization service.
+
+Parity: dlrover/go/brain — a standalone gRPC service
+(pkg/server/server.go:176) that persists job metrics into a datastore
+and serves optimization plans computed by pluggable algorithms
+(optimize_job_worker_resource.go:400, OOM-adjust, hot-node). The TPU
+build keeps the exact seams (persist_metrics / optimize /
+get_job_metrics over the same 2-RPC wire the master uses; datastore =
+stdlib sqlite instead of MySQL; algorithms = the same
+JobResourceOptimizer heuristics the master runs locally) so one Brain
+serves many jobs and masters opt in by pointing their collector's
+reporter and their optimizer's brain-callable at it.
+"""
+
+from dlrover_tpu.brain.service import (  # noqa: F401
+    BrainClient,
+    BrainServicer,
+    start_brain_service,
+)

@@ -1,0 +1,210 @@
+"""Brain service + client (see package docstring for the parity map)."""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import List
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.resource.optimizer import (
+    JobResourceOptimizer,
+    ResourcePlan,
+)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS job_metrics (
+    job TEXT NOT NULL,
+    ts REAL NOT NULL,
+    global_step INTEGER,
+    steps_per_sec REAL,
+    alive_nodes INTEGER,
+    total_cpu_percent REAL,
+    total_memory_mb INTEGER
+);
+CREATE INDEX IF NOT EXISTS job_metrics_job ON job_metrics (job, ts);
+"""
+
+
+class BrainServicer:
+    """2-RPC dispatch (same wire as the master servicer) backed by a
+    sqlite datastore (parity: server.go + datastore/mysql.go)."""
+
+    def __init__(self, db_path: str = ":memory:", max_rows_per_job: int = 10000):
+        # one connection guarded by a lock: the RPC pool is many threads
+        self._conn = sqlite3.connect(db_path, check_same_thread=False)
+        self._conn.executescript(_SCHEMA)
+        self._lock = threading.Lock()
+        self._max_rows = max_rows_per_job
+
+    # -- RPC entrypoints (bytes in/out) --------------------------------
+    def report(self, request_bytes: bytes, context=None) -> bytes:
+        req: comm.BaseRequest = comm.deserialize_message(request_bytes)
+        message = comm.deserialize_message(req.data)
+        response = comm.BaseResponse()
+        try:
+            if isinstance(message, comm.BrainMetricsReport):
+                self.persist_metrics(message.job_name, message.sample)
+            else:
+                response.success = False
+                response.message = f"unknown {type(message).__name__}"
+        except Exception as e:
+            logger.error(f"brain report failed: {e!r}")
+            response.success = False
+            response.message = repr(e)
+        return comm.serialize_message(response)
+
+    def get(self, request_bytes: bytes, context=None) -> bytes:
+        req: comm.BaseRequest = comm.deserialize_message(request_bytes)
+        message = comm.deserialize_message(req.data)
+        response = comm.BaseResponse()
+        try:
+            if isinstance(message, comm.BrainOptimizeRequest):
+                plan = self.optimize(message.job_name, message.node_unit)
+                result = comm.BrainOptimizePlan(
+                    worker_count=plan.worker_count or 0,
+                    worker_memory_mb=plan.worker_memory_mb or 0,
+                    reason=plan.reason,
+                )
+                response.data = comm.serialize_message(result)
+            elif isinstance(message, comm.BrainJobMetricsRequest):
+                samples = self.job_metrics(
+                    message.job_name, message.last_n
+                )
+                response.data = comm.serialize_message(
+                    comm.JobMetrics(samples=samples)
+                )
+            else:
+                response.success = False
+                response.message = f"unknown {type(message).__name__}"
+        except Exception as e:
+            logger.error(f"brain get failed: {e!r}")
+            response.success = False
+            response.message = repr(e)
+        return comm.serialize_message(response)
+
+    # -- datastore ------------------------------------------------------
+    def persist_metrics(self, job: str, s: comm.JobMetricsSample):
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO job_metrics VALUES (?,?,?,?,?,?,?)",
+                (
+                    job, s.timestamp, s.global_step, s.steps_per_sec,
+                    s.alive_nodes, s.total_cpu_percent, s.total_memory_mb,
+                ),
+            )
+            # bound the series per job (parity: the reference prunes by
+            # retention policy in its DB)
+            self._conn.execute(
+                "DELETE FROM job_metrics WHERE job = ? AND ts NOT IN "
+                "(SELECT ts FROM job_metrics WHERE job = ? "
+                " ORDER BY ts DESC LIMIT ?)",
+                (job, job, self._max_rows),
+            )
+            self._conn.commit()
+
+    def job_metrics(
+        self, job: str, last_n: int = 0
+    ) -> List[comm.JobMetricsSample]:
+        # last_n is applied in SQL: fetching a capped 10k-row series to
+        # keep 10 would hold the lock for nothing
+        query = (
+            "SELECT ts, global_step, steps_per_sec, alive_nodes, "
+            "total_cpu_percent, total_memory_mb FROM job_metrics "
+            "WHERE job = ? ORDER BY ts"
+        )
+        with self._lock:
+            if last_n:
+                rows = self._conn.execute(
+                    query.replace("ORDER BY ts", "ORDER BY ts DESC LIMIT ?"),
+                    (job, last_n),
+                ).fetchall()[::-1]
+            else:
+                rows = self._conn.execute(query, (job,)).fetchall()
+        return [
+            comm.JobMetricsSample(
+                timestamp=r[0],
+                global_step=r[1],
+                steps_per_sec=r[2],
+                alive_nodes=r[3],
+                total_cpu_percent=r[4],
+                total_memory_mb=r[5],
+            )
+            for r in rows
+        ]
+
+    # -- optimization algorithms ---------------------------------------
+    def optimize(self, job: str, node_unit: int = 1) -> ResourcePlan:
+        """Run the algorithm suite over the job's stored series
+        (parity: optalgorithm/*.go — worker-resource + OOM-adjust)."""
+        samples = self.job_metrics(job)
+        opt = JobResourceOptimizer(node_unit=node_unit)
+        return opt.plan_from_samples(samples)
+
+    def close(self):
+        with self._lock:
+            self._conn.close()
+
+
+def start_brain_service(
+    port: int = 0, db_path: str = ":memory:"
+):
+    """Returns (grpc_server, servicer, addr)."""
+    from dlrover_tpu.master.servicer import create_master_service
+
+    servicer = BrainServicer(db_path=db_path)
+    port = port or comm.find_free_port()
+    server = create_master_service(port, servicer)
+    logger.info(f"brain serving on 127.0.0.1:{port} (db={db_path})")
+    return server, servicer, f"127.0.0.1:{port}"
+
+
+class BrainClient:
+    """Client + the two adaptor callables masters plug in (parity:
+    dlrover/python/brain/client.py BrainClient)."""
+
+    def __init__(self, addr: str, job_name: str, timeout: float = 10.0):
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        self._client = MasterClient(addr, timeout=timeout)
+        self._job = job_name
+
+    def persist_metrics(self, sample: comm.JobMetricsSample):
+        return self._client.report(
+            comm.BrainMetricsReport(job_name=self._job, sample=sample)
+        )
+
+    def optimize(self, node_unit: int = 1) -> ResourcePlan:
+        resp = self._client.get(
+            comm.BrainOptimizeRequest(
+                job_name=self._job, node_unit=node_unit
+            )
+        )
+        if not resp:
+            return ResourcePlan()
+        return ResourcePlan(
+            worker_count=resp.worker_count or None,
+            worker_memory_mb=resp.worker_memory_mb or None,
+            reason=resp.reason,
+        )
+
+    def get_job_metrics(self, last_n: int = 0) -> List[comm.JobMetricsSample]:
+        resp = self._client.get(
+            comm.BrainJobMetricsRequest(job_name=self._job, last_n=last_n)
+        )
+        return resp.samples if resp else []
+
+    # -- master integration seams --------------------------------------
+    def reporter(self):
+        """For JobMetricCollector(reporter=...): every sample lands in
+        the Brain datastore."""
+        return lambda sample: self.persist_metrics(sample)
+
+    def optimizer(self, node_unit: int = 1):
+        """For JobResourceOptimizer(brain=...): plans come from the
+        cluster service instead of local heuristics."""
+        return lambda samples: self.optimize(node_unit)
+
+    def close(self):
+        self._client.close()

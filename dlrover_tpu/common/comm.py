@@ -326,6 +326,34 @@ class JobMetricsRequest(Message):
     last_n: int = 0  # 0 = whole retained series
 
 
+# -- Brain service (cluster-level optimizer) --------------------------------
+@dataclass
+class BrainMetricsReport(Message):
+    """persist_metrics (parity: brain.proto:196)."""
+
+    job_name: str = ""
+    sample: JobMetricsSample = field(default_factory=JobMetricsSample)
+
+
+@dataclass
+class BrainOptimizeRequest(Message):
+    job_name: str = ""
+    node_unit: int = 1
+
+
+@dataclass
+class BrainOptimizePlan(Message):
+    worker_count: int = 0  # 0 = no recommendation
+    worker_memory_mb: int = 0
+    reason: str = ""
+
+
+@dataclass
+class BrainJobMetricsRequest(Message):
+    job_name: str = ""
+    last_n: int = 0
+
+
 @dataclass
 class JobMetrics(Message):
     samples: List[JobMetricsSample] = field(default_factory=list)

@@ -70,6 +70,18 @@ class JobResourceOptimizer:
         )
 
     # -- plans ----------------------------------------------------------
+    def plan_from_samples(
+        self, samples: List[comm.JobMetricsSample]
+    ) -> ResourcePlan:
+        """Run the local algorithm suite over a metric series (also the
+        entry the Brain service calls for its stored series)."""
+        for s in samples:
+            self.observe(s)
+        plan = ResourcePlan()
+        self._check_scaling_efficiency(plan)
+        self._check_memory(plan, samples)
+        return plan
+
     def generate_plan(self) -> ResourcePlan:
         """Current recommendation from everything observed so far."""
         samples = (
@@ -80,12 +92,7 @@ class JobResourceOptimizer:
                 return self._brain(samples)
             except Exception as e:
                 logger.warning(f"brain optimizer failed, local: {e!r}")
-        for s in samples:
-            self.observe(s)
-        plan = ResourcePlan()
-        self._check_scaling_efficiency(plan)
-        self._check_memory(plan, samples)
-        return plan
+        return self.plan_from_samples(samples)
 
     def _check_scaling_efficiency(self, plan: ResourcePlan):
         """Diminishing-returns: if the largest size's throughput gain
@@ -103,10 +110,15 @@ class JobResourceOptimizer:
         actual = speed_big / speed_small
         linear = big / small
         if actual < 1 + self._min_speedup * (linear - 1):
-            plan.worker_count = small
+            # slice-align the recommendation (a partial TPU slice cannot
+            # join the world)
+            want = small
+            if want % self._node_unit:
+                want += self._node_unit - want % self._node_unit
+            plan.worker_count = want
             plan.reason = (
                 f"scaling {small}->{big} nodes bought only "
-                f"{actual:.2f}x (linear {linear:.2f}x); recommend {small}"
+                f"{actual:.2f}x (linear {linear:.2f}x); recommend {want}"
             )
 
     def _check_memory(

@@ -22,6 +22,7 @@ from typing import Callable, Deque, Optional
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.daemon import PollingDaemon
+from dlrover_tpu.common.log import default_logger as logger
 
 
 class JobMetricCollector(PollingDaemon):
@@ -61,7 +62,12 @@ class JobMetricCollector(PollingDaemon):
         )
         self._samples.append(sample)
         if self._reporter is not None:
-            self._reporter(sample)
+            try:
+                self._reporter(sample)
+            except Exception as e:
+                # a reporter (e.g. a networked Brain) outage must not
+                # disrupt local collection
+                logger.warning(f"metrics reporter failed: {e!r}")
         return sample
 
     def _tick(self):

@@ -1,0 +1,103 @@
+"""Brain service: persist/optimize/query over real gRPC + sqlite."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.brain import BrainClient, start_brain_service
+from dlrover_tpu.common import comm
+from dlrover_tpu.master.resource.optimizer import JobResourceOptimizer
+
+
+def _sample(nodes, sps, mem=1000, ts=None):
+    return comm.JobMetricsSample(
+        timestamp=ts or time.time(),
+        alive_nodes=nodes,
+        steps_per_sec=sps,
+        total_memory_mb=mem,
+    )
+
+
+@pytest.fixture()
+def brain():
+    server, servicer, addr = start_brain_service()
+    yield addr
+    server.stop(grace=1)
+    servicer.close()
+
+
+class TestBrain:
+    def test_persist_and_query_isolated_per_job(self, brain):
+        a = BrainClient(brain, "job-a")
+        b = BrainClient(brain, "job-b")
+        try:
+            a.persist_metrics(_sample(4, 10.0, ts=1.0))
+            a.persist_metrics(_sample(4, 12.0, ts=2.0))
+            b.persist_metrics(_sample(2, 5.0, ts=1.5))
+            assert len(a.get_job_metrics()) == 2
+            got_b = b.get_job_metrics()
+            assert len(got_b) == 1 and got_b[0].alive_nodes == 2
+        finally:
+            a.close()
+            b.close()
+
+    def test_optimize_recommends_scale_down(self, brain):
+        c = BrainClient(brain, "job-c")
+        try:
+            c.persist_metrics(_sample(4, 10.0, ts=1.0))
+            c.persist_metrics(_sample(8, 11.0, ts=2.0))  # bad scaling
+            plan = c.optimize()
+            assert plan.worker_count == 4
+            assert "recommend 4" in plan.reason
+        finally:
+            c.close()
+
+    def test_master_optimizer_uses_brain(self, brain):
+        """The JobResourceOptimizer brain seam end to end over RPC."""
+        c = BrainClient(brain, "job-d")
+        try:
+            c.persist_metrics(_sample(4, 10.0, ts=1.0))
+            c.persist_metrics(_sample(8, 11.0, ts=2.0))
+            opt = JobResourceOptimizer(brain=c.optimizer())
+            plan = opt.generate_plan()
+            assert plan.worker_count == 4
+        finally:
+            c.close()
+
+    def test_reporter_seam_feeds_brain(self, brain):
+        from dlrover_tpu.master.stats.collector import JobMetricCollector
+
+        c = BrainClient(brain, "job-e")
+
+        class _SM:
+            completed_global_step = 9
+
+            def running_speed(self):
+                return 2.0
+
+        try:
+            coll = JobMetricCollector(None, _SM(), reporter=c.reporter())
+            coll.collect()
+            samples = c.get_job_metrics()
+            assert len(samples) == 1 and samples[0].global_step == 9
+        finally:
+            c.close()
+
+    def test_persistence_across_restart(self, tmp_path):
+        db = str(tmp_path / "brain.db")
+        server, servicer, addr = start_brain_service(db_path=db)
+        c = BrainClient(addr, "job-f")
+        c.persist_metrics(_sample(3, 7.0, ts=1.0))
+        c.close()
+        server.stop(grace=1)
+        servicer.close()
+
+        server2, servicer2, addr2 = start_brain_service(db_path=db)
+        c2 = BrainClient(addr2, "job-f")
+        try:
+            samples = c2.get_job_metrics()
+            assert len(samples) == 1 and samples[0].steps_per_sec == 7.0
+        finally:
+            c2.close()
+            server2.stop(grace=1)
+            servicer2.close()

@@ -110,11 +110,14 @@ class JobResourceOptimizer:
         actual = speed_big / speed_small
         linear = big / small
         if actual < 1 + self._min_speedup * (linear - 1):
-            # slice-align the recommendation (a partial TPU slice cannot
-            # join the world)
-            want = small
-            if want % self._node_unit:
-                want += self._node_unit - want % self._node_unit
+            # slice-align DOWNWARD: rounding up could re-recommend (or
+            # exceed) the size already judged inefficient, turning a
+            # scale-down into a no-op or a scale-UP
+            want = max(
+                self._node_unit, small - small % self._node_unit
+            )
+            if want >= big:
+                return  # alignment ate the whole recommendation
             plan.worker_count = want
             plan.reason = (
                 f"scaling {small}->{big} nodes bought only "

@@ -41,6 +41,7 @@ class JobMetricCollector(PollingDaemon):
             maxlen=max_samples
         )
         self._reporter = reporter
+        self._report_thread = None
 
     def collect(self) -> comm.JobMetricsSample:
         running = (
@@ -61,17 +62,39 @@ class JobMetricCollector(PollingDaemon):
             ),
         )
         self._samples.append(sample)
-        if self._reporter is not None:
+        self._dispatch_to_reporter(sample)
+        return sample
+
+    def _dispatch_to_reporter(self, sample):
+        """Fire-and-forget: a networked reporter (Brain) doing its RPC
+        retries must not stall the collection cadence. One in-flight
+        report at a time; samples arriving while it blocks are skipped
+        for reporting (they stay in the local series)."""
+        if self._reporter is None:
+            return
+        if self._report_thread is not None and self._report_thread.is_alive():
+            return
+
+        def _run():
             try:
                 self._reporter(sample)
             except Exception as e:
-                # a reporter (e.g. a networked Brain) outage must not
-                # disrupt local collection
                 logger.warning(f"metrics reporter failed: {e!r}")
-        return sample
+
+        import threading
+
+        self._report_thread = threading.Thread(
+            target=_run, name="metrics-reporter", daemon=True
+        )
+        self._report_thread.start()
 
     def _tick(self):
         self.collect()
+
+    def flush_reports(self, timeout: float = 10.0):
+        """Join the in-flight reporter dispatch (tests / shutdown)."""
+        if self._report_thread is not None:
+            self._report_thread.join(timeout=timeout)
 
     def snapshot(self, last_n: int = 0) -> comm.JobMetrics:
         samples = list(self._samples)

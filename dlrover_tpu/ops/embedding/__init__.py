@@ -11,3 +11,6 @@ from dlrover_tpu.ops.embedding.store import (  # noqa: F401
 from dlrover_tpu.ops.embedding.ckpt import (  # noqa: F401
     IncrementalCheckpointManager,
 )
+from dlrover_tpu.ops.embedding.tiered import (  # noqa: F401
+    TieredKvEmbedding,
+)

@@ -367,6 +367,21 @@ class ShardedKvEmbedding:
             "sparse_group_ftrl", keys, grads, alpha, beta, l1, l21
         )
 
+    def meta(self, keys) -> Tuple[np.ndarray, np.ndarray]:
+        """(frequency, last_access_ts) per key; -1 for absent keys.
+        Reads only — never bumps freq/ts."""
+        k = KvEmbeddingStore._keys(keys)
+        freqs = np.empty(len(k), np.int64)
+        tss = np.empty(len(k), np.int64)
+        route = self._route(k)
+        for sid in range(self.num_shards):
+            mask = route == sid
+            if mask.any():
+                f, t = self.shards[sid].meta(k[mask])
+                freqs[mask] = f
+                tss[mask] = t
+        return freqs, tss
+
     # -- elastic resharding --------------------------------------------
     def reshard(self, new_num_shards: int) -> None:
         """N → M shards: export every row once, re-route, import. Bumps

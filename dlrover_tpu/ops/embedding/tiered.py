@@ -1,0 +1,199 @@
+"""Tiered (hybrid) embedding storage: hot rows in memory, cold on disk.
+
+Parity: TFPlus hybrid embedding storage
+(tfplus/kv_variable/kernels/hybrid_embedding/{table_manager.h:547,
+storage_table.h:199, embedding_context.h:177}) — recommender vocabularies
+outgrow host RAM, but access frequency is zipfian, so rarely-touched
+rows live in a disk tier and fault back into the native hash table on
+access. The TPU build keeps the C++ store as the hot tier and uses a
+stdlib sqlite file as the cold tier (random-access by key, atomic,
+survives restarts); policy lives in Python because eviction runs at
+checkpoint cadence, not per step.
+
+Semantics:
+- ``gather``: keys absent from memory but present on disk are faulted
+  in first (values AND optimizer slots travel); untouched keys follow
+  the base store's init/zero rules. A row lives in exactly one tier,
+  and the move happens atomically under the cold-tier lock.
+- ``evict_cold(ts_limit)``: rows last touched before ``ts_limit`` move
+  to disk and leave memory.
+- ``export_state``: merges BOTH tiers — checkpoints must not silently
+  drop evicted rows. Delta exports include cold rows evicted since the
+  previous export (tracked by an eviction sequence number).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.ops.embedding.store import ShardedKvEmbedding
+
+_IN_CHUNK = 500  # sqlite host-parameter limit safety (999 on old builds)
+
+
+class TieredKvEmbedding:
+    def __init__(self, hot: ShardedKvEmbedding, cold_path: str):
+        self.hot = hot
+        self._conn = sqlite3.connect(cold_path, check_same_thread=False)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS rows ("
+            "key INTEGER PRIMARY KEY, row BLOB, freq INTEGER, "
+            "ts INTEGER, evict_seq INTEGER)"
+        )
+        self._lock = threading.Lock()
+        self.dim = hot.dim
+        self.row_floats = hot.dim * (1 + hot.num_slots)
+        with self._lock:
+            (mx,) = self._conn.execute(
+                "SELECT COALESCE(MAX(evict_seq), 0) FROM rows"
+            ).fetchone()
+        self._evict_seq = mx
+        self._exported_seq = 0  # cold rows > this are new to a delta
+
+    # -- introspection --------------------------------------------------
+    def hot_rows(self) -> int:
+        return len(self.hot)
+
+    def cold_rows(self) -> int:
+        with self._lock:
+            (n,) = self._conn.execute(
+                "SELECT COUNT(*) FROM rows"
+            ).fetchone()
+        return n
+
+    # -- fault-in -------------------------------------------------------
+    def _fault_in(self, keys: np.ndarray) -> int:
+        """Move any cold ``keys`` into the hot tier. Import-then-delete
+        under the lock: a concurrent gather of the same key either waits
+        here or finds the row already hot — never in neither tier."""
+        f, _ = self.hot.meta(keys)  # reads only, no freq/ts bump
+        missing = np.unique(keys[f < 0])
+        if len(missing) == 0:
+            return 0
+        moved = 0
+        with self._lock:
+            for start in range(0, len(missing), _IN_CHUNK):
+                chunk = [
+                    int(k) for k in missing[start : start + _IN_CHUNK]
+                ]
+                qmarks = ",".join("?" * len(chunk))
+                rows = self._conn.execute(
+                    f"SELECT key, row, freq, ts FROM rows "
+                    f"WHERE key IN ({qmarks})",
+                    chunk,
+                ).fetchall()
+                if not rows:
+                    continue
+                k = np.array([r[0] for r in rows], np.int64)
+                data = np.stack(
+                    [np.frombuffer(r[1], np.float32) for r in rows]
+                ).reshape(len(rows), self.row_floats)
+                self.hot.import_state(
+                    {
+                        "keys": k,
+                        "rows": data,
+                        "freq": np.array([r[2] for r in rows], np.int64),
+                        "ts": np.array([r[3] for r in rows], np.int64),
+                    }
+                )
+                self._conn.execute(
+                    f"DELETE FROM rows WHERE key IN "
+                    f"({','.join('?' * len(rows))})",
+                    [r[0] for r in rows],
+                )
+                moved += len(rows)
+            self._conn.commit()
+        return moved
+
+    # -- public surface (hot-store API + fault-in) ---------------------
+    def gather(self, keys, insert_missing: bool = True) -> np.ndarray:
+        k = np.ascontiguousarray(keys, dtype=np.int64).ravel()
+        self._fault_in(k)
+        return self.hot.gather(k, insert_missing)
+
+    def __getattr__(self, name):
+        # sparse_* updates / scatter pass through to the hot tier —
+        # callers gather() first (which faults in), the same contract
+        # the training loop already follows
+        return getattr(self.hot, name)
+
+    # -- checkpoint (both tiers!) ---------------------------------------
+    def _cold_rows(self, min_seq: int = 0):
+        with self._lock:
+            return self._conn.execute(
+                "SELECT key, row, freq, ts FROM rows WHERE evict_seq > ?",
+                (min_seq,),
+            ).fetchall()
+
+    def export_state(
+        self, since_versions: Optional[List[int]] = None
+    ) -> Dict[str, np.ndarray]:
+        """Hot export (full or delta) merged with the cold tier: full
+        export carries every cold row; delta export carries cold rows
+        evicted since the previous export — a checkpoint of a tiered
+        store must never silently drop evicted rows."""
+        state = self.hot.export_state(since_versions)
+        min_seq = self._exported_seq if since_versions else 0
+        cold = self._cold_rows(min_seq)
+        self._exported_seq = self._evict_seq
+        if cold:
+            state = {
+                "keys": np.concatenate(
+                    [state["keys"], [r[0] for r in cold]]
+                ).astype(np.int64),
+                "rows": np.concatenate(
+                    [
+                        state["rows"].reshape(-1, self.row_floats),
+                        np.stack(
+                            [
+                                np.frombuffer(r[1], np.float32)
+                                for r in cold
+                            ]
+                        ),
+                    ]
+                ),
+                "freq": np.concatenate(
+                    [state["freq"], [r[2] for r in cold]]
+                ).astype(np.int64),
+                "ts": np.concatenate(
+                    [state["ts"], [r[3] for r in cold]]
+                ).astype(np.int64),
+            }
+        return state
+
+    # -- eviction -------------------------------------------------------
+    def evict_cold(self, ts_limit: int) -> int:
+        """Move rows last touched before ``ts_limit`` to disk."""
+        state = self.hot.export_state()
+        cold = state["ts"] < ts_limit
+        n = int(cold.sum())
+        if n:
+            self._evict_seq += 1
+            with self._lock:
+                self._conn.executemany(
+                    "INSERT OR REPLACE INTO rows VALUES (?,?,?,?,?)",
+                    [
+                        (
+                            int(state["keys"][i]),
+                            state["rows"][i].tobytes(),
+                            int(state["freq"][i]),
+                            int(state["ts"][i]),
+                            self._evict_seq,
+                        )
+                        for i in np.nonzero(cold)[0]
+                    ],
+                )
+                self._conn.commit()
+            for shard in self.hot.shards:
+                shard.evict_older_than(ts_limit)
+            logger.info(f"evicted {n} cold embedding rows to disk")
+        return n
+
+    def close(self):
+        with self._lock:
+            self._conn.close()

@@ -78,6 +78,7 @@ class TestBrain:
         try:
             coll = JobMetricCollector(None, _SM(), reporter=c.reporter())
             coll.collect()
+            coll.flush_reports()  # reporting is fire-and-forget
             samples = c.get_job_metrics()
             assert len(samples) == 1 and samples[0].global_step == 9
         finally:

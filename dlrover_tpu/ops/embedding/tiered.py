@@ -52,8 +52,14 @@ class TieredKvEmbedding:
             (mx,) = self._conn.execute(
                 "SELECT COALESCE(MAX(evict_seq), 0) FROM rows"
             ).fetchone()
+            (cnt,) = self._conn.execute(
+                "SELECT COUNT(*) FROM rows"
+            ).fetchone()
         self._evict_seq = mx
         self._exported_seq = 0  # cold rows > this are new to a delta
+        # maintained counter: gather's fault-in probe short-circuits
+        # while the cold tier is empty (the common pre-eviction state)
+        self._cold_count = cnt
 
     # -- introspection --------------------------------------------------
     def hot_rows(self) -> int:
@@ -66,11 +72,19 @@ class TieredKvEmbedding:
             ).fetchone()
         return n
 
+    def __len__(self) -> int:
+        # a row lives in exactly one tier, so the total is the sum
+        # (dunders bypass __getattr__, so the passthrough can't serve
+        # len())
+        return self.hot_rows() + self.cold_rows()
+
     # -- fault-in -------------------------------------------------------
     def _fault_in(self, keys: np.ndarray) -> int:
         """Move any cold ``keys`` into the hot tier. Import-then-delete
         under the lock: a concurrent gather of the same key either waits
         here or finds the row already hot — never in neither tier."""
+        if self._cold_count == 0:
+            return 0  # nothing evicted: skip the extra meta probe
         f, _ = self.hot.meta(keys)  # reads only, no freq/ts bump
         missing = np.unique(keys[f < 0])
         if len(missing) == 0:
@@ -108,6 +122,7 @@ class TieredKvEmbedding:
                 )
                 moved += len(rows)
             self._conn.commit()
+            self._cold_count -= moved
         return moved
 
     # -- public surface (hot-store API + fault-in) ---------------------
@@ -135,64 +150,108 @@ class TieredKvEmbedding:
     ) -> Dict[str, np.ndarray]:
         """Hot export (full or delta) merged with the cold tier: full
         export carries every cold row; delta export carries cold rows
-        evicted since the previous export — a checkpoint of a tiered
-        store must never silently drop evicted rows."""
+        evicted since the previous DELTA export — a checkpoint of a
+        tiered store must never silently drop evicted rows.
+
+        The delta cursor advances only on delta exports, so unrelated
+        full exports (e.g. SparseTrainer's own save over the same
+        store) cannot consume rows out of a checkpoint manager's delta
+        stream. One delta consumer per store is the supported shape.
+        Cold rows come FIRST so that when a key transiently has copies
+        in both tiers the fresher hot row wins the last-wins import.
+        """
         state = self.hot.export_state(since_versions)
-        min_seq = self._exported_seq if since_versions else 0
-        cold = self._cold_rows(min_seq)
-        self._exported_seq = self._evict_seq
+        if since_versions:
+            cold = self._cold_rows(self._exported_seq)
+            self._exported_seq = self._evict_seq
+        else:
+            cold = self._cold_rows(0)
         if cold:
             state = {
                 "keys": np.concatenate(
-                    [state["keys"], [r[0] for r in cold]]
+                    [[r[0] for r in cold], state["keys"]]
                 ).astype(np.int64),
                 "rows": np.concatenate(
                     [
-                        state["rows"].reshape(-1, self.row_floats),
                         np.stack(
                             [
                                 np.frombuffer(r[1], np.float32)
                                 for r in cold
                             ]
                         ),
+                        state["rows"].reshape(-1, self.row_floats),
                     ]
                 ),
                 "freq": np.concatenate(
-                    [state["freq"], [r[2] for r in cold]]
+                    [[r[2] for r in cold], state["freq"]]
                 ).astype(np.int64),
                 "ts": np.concatenate(
-                    [state["ts"], [r[3] for r in cold]]
+                    [[r[3] for r in cold], state["ts"]]
                 ).astype(np.int64),
             }
         return state
 
     # -- eviction -------------------------------------------------------
     def evict_cold(self, ts_limit: int) -> int:
-        """Move rows last touched before ``ts_limit`` to disk."""
-        state = self.hot.export_state()
-        cold = state["ts"] < ts_limit
-        n = int(cold.sum())
-        if n:
-            self._evict_seq += 1
+        """Move rows last touched before ``ts_limit`` to disk.
+
+        Processed one hot shard at a time (peak host memory = largest
+        shard, not the whole table — the tier exists because RAM is
+        short). A row touched between the snapshot and the in-memory
+        eviction survives hot; its just-written stale disk copy is
+        removed afterwards so no key ever has copies in both tiers.
+        """
+        total = 0
+        self._evict_seq += 1
+        for shard in self.hot.shards:
+            keys, rows, freq, ts = shard.export()
+            cold = ts < ts_limit
+            n = int(cold.sum())
+            if not n:
+                continue
+            idx = np.nonzero(cold)[0]
             with self._lock:
                 self._conn.executemany(
                     "INSERT OR REPLACE INTO rows VALUES (?,?,?,?,?)",
                     [
                         (
-                            int(state["keys"][i]),
-                            state["rows"][i].tobytes(),
-                            int(state["freq"][i]),
-                            int(state["ts"][i]),
+                            int(keys[i]),
+                            rows[i].tobytes(),
+                            int(freq[i]),
+                            int(ts[i]),
                             self._evict_seq,
                         )
-                        for i in np.nonzero(cold)[0]
+                        for i in idx
                     ],
                 )
                 self._conn.commit()
-            for shard in self.hot.shards:
-                shard.evict_older_than(ts_limit)
-            logger.info(f"evicted {n} cold embedding rows to disk")
-        return n
+            shard.evict_older_than(ts_limit)
+            # rows touched in the snapshot→evict window stayed hot: drop
+            # their (stale) disk copies before anything can re-export them
+            survivors_f, _ = shard.meta(keys[idx])
+            still_hot = keys[idx][survivors_f >= 0]
+            if len(still_hot):
+                with self._lock:
+                    for start in range(0, len(still_hot), _IN_CHUNK):
+                        chunk = [
+                            int(k)
+                            for k in still_hot[start : start + _IN_CHUNK]
+                        ]
+                        self._conn.execute(
+                            f"DELETE FROM rows WHERE key IN "
+                            f"({','.join('?' * len(chunk))})",
+                            chunk,
+                        )
+                    self._conn.commit()
+                n -= len(still_hot)
+            total += n
+        if total:
+            with self._lock:
+                (self._cold_count,) = self._conn.execute(
+                    "SELECT COUNT(*) FROM rows"
+                ).fetchone()
+            logger.info(f"evicted {total} cold embedding rows to disk")
+        return total
 
     def close(self):
         with self._lock:

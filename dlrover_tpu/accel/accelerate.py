@@ -43,13 +43,17 @@ def auto_accelerate(
     max_timed: int = 3,
     strategy: Optional[Strategy] = None,
     donate: bool = True,
+    search: str = "combination",
 ) -> AccelerateResult:
     """Pick (or apply) a strategy and return the compiled artifacts.
 
     ``strategy`` short-circuits the search (the reference's
-    ``load_strategy=`` path); otherwise candidates are generated, scored
-    by compile-time cost/memory analysis, the finalists timed, and the
-    winner rebuilt.
+    ``load_strategy=`` path); otherwise candidates are generated and
+    searched. ``search``: "combination" statically scores every candidate
+    via compile-time cost/memory analysis and times the finalists
+    (atorch combination_sg analog); "bayes" spends ``max_timed`` + 2
+    measured runs steered by a TPE (atorch bayes_opt_sg/HEBO analog) —
+    better when the candidate list is large and compiles are slow.
     """
     import jax
 
@@ -66,10 +70,20 @@ def auto_accelerate(
                 f"no valid mesh factorization for {len(devices)} devices, "
                 f"batch={batch}, seq={seq}"
             )
-        reports = dry_run(
-            cands, cfg, tx, batch, seq, devices,
-            hbm_budget=hbm_budget, max_timed=max_timed,
-        )
+        if search == "bayes":
+            from dlrover_tpu.accel.bayes import tpe_search
+
+            reports = tpe_search(
+                cands, cfg, tx, batch, seq, devices,
+                budget=max_timed + 2, hbm_budget=hbm_budget,
+            )
+        elif search == "combination":
+            reports = dry_run(
+                cands, cfg, tx, batch, seq, devices,
+                hbm_budget=hbm_budget, max_timed=max_timed,
+            )
+        else:
+            raise ValueError(f"unknown search algorithm {search!r}")
         best = reports[0]
         if not (best.ok and best.fits):
             over = [r for r in reports if r.ok and not r.fits]

@@ -172,8 +172,12 @@ def timed_run(
     seq: int,
     devices,
     steps: int = 3,
-) -> Optional[float]:
-    """Measured seconds/step (median of ``steps`` after one warmup)."""
+) -> Tuple[Optional[float], float]:
+    """(measured seconds/step — median of ``steps`` after one warmup,
+    per-device memory bytes). Compiles AOT so the memory analysis comes
+    from the SAME executable being timed — callers gating on HBM must
+    not pay a second compile (the TPE path exists because compiles are
+    slow). Memory is 0.0 when the backend offers no analysis."""
     import jax
 
     try:
@@ -182,20 +186,30 @@ def timed_run(
         )
         state = init_fn(jax.random.PRNGKey(0))
         x, y = make_batch(batch, seq)
-        state, _ = step_fn(state, x, y)  # compile + warmup
+        compiled = step_fn.lower(state, x, y).compile()
+        ma = compiled.memory_analysis()
+        mem = (
+            float(
+                getattr(ma, "argument_size_in_bytes", 0)
+                + getattr(ma, "temp_size_in_bytes", 0)
+            )
+            if ma is not None
+            else 0.0
+        )
+        state, _ = compiled(state, x, y)  # warmup
         jax.block_until_ready(state.params)
         times = []
         for _ in range(steps):
             t0 = time.perf_counter()
-            state, _ = step_fn(state, x, y)
+            state, _ = compiled(state, x, y)
             jax.block_until_ready(state.params)
             times.append(time.perf_counter() - t0)
-        return float(np.median(times))
+        return float(np.median(times)), mem
     except Exception as e:
         logger.warning(
             f"timed dry run failed for {strategy.describe()}: {e!r}"
         )
-        return None
+        return None, 0.0
 
 
 def dry_run(
@@ -219,7 +233,7 @@ def dry_run(
     viable = [r for r in reports if r.ok and r.fits]
     viable.sort(key=lambda r: r.est_step_s)
     for r in viable[:max_timed]:
-        r.step_s = timed_run(
+        r.step_s, _ = timed_run(
             r.strategy, cfg, tx, batch, seq, devices, steps=timed_steps
         )
 

@@ -13,6 +13,11 @@ from dlrover_tpu.models.transformer import (  # noqa: F401
     logical_axes,
     loss_fn,
 )
+from dlrover_tpu.models.mup import (  # noqa: F401
+    mup_adamw,
+    mup_config,
+    mup_lr_scales,
+)
 from dlrover_tpu.models.train import (  # noqa: F401
     TrainState,
     build_train_step,

@@ -36,6 +36,9 @@ class TransformerConfig:
     dtype: str = "bfloat16"  # activation/compute dtype
     param_dtype: str = "float32"
     remat: bool = False  # checkpoint each block (HBM <-> FLOPs trade)
+    # muP forward multipliers (models/mup.py sets these; defaults = SP)
+    mup_attn_scale: Optional[float] = None  # None => 1/sqrt(head_dim)
+    mup_output_mult: float = 1.0
 
     @property
     def kv_heads(self) -> int:

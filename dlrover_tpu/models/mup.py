@@ -1,0 +1,125 @@
+"""muP (Maximal Update Parametrization) — width-transferable hyperparams.
+
+Parity: atorch/atorch/mup/ (infshape.py, init.py, optim.py — a vendored
+Microsoft mup port: `MuAdam` rescales per-group LR by 1/width-mult,
+`mup init` rescales matrix-like init variance, attention uses 1/d).
+
+TPU-native design: no module surgery and no "infinite-shape" metadata
+attached to tensors. The parametrization is three pure pieces keyed off
+the *config* (base width vs target width), applied to the existing
+functional model:
+
+1. **init**: matrix-like params whose fan-in grows with width keep their
+   1/fan_in variance (already the case in ``init_params``); the readout
+   is handled by the output multiplier instead of init rescaling
+   (the two are equivalent under muP — see Yang et al. Appendix).
+2. **forward multipliers** (carried on ``TransformerConfig``):
+   ``mup_attn_scale`` switches attention logits from 1/sqrt(d) to
+   1/d * base_head_dim**0.5 and ``mup_output_mult`` scales the logits by
+   base_width/width.
+3. **optimizer**: ``scale_adam_lr_by_mup`` wraps any optax chain with
+   per-leaf LR multipliers — 1/width_mult for matrix-like (2+ dim)
+   hidden params, 1 for vectors (norms, biases) and the embedding table.
+
+``mup_config(cfg, base)`` returns the config with forward multipliers
+set; ``mup_lr_scales(cfg, base)`` / ``mup_adamw(lr, cfg, base)`` supply
+the optimizer side. Widths can then be swept at a fixed base LR (the
+muTransfer workflow the reference uses for hyperparameter search on
+small proxies).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any, Tuple
+
+import jax
+import optax
+
+from dlrover_tpu.models.config import TransformerConfig
+from dlrover_tpu.models.transformer import logical_axes
+
+
+def width_mult(cfg: TransformerConfig, base: TransformerConfig) -> float:
+    return cfg.model_dim / base.model_dim
+
+
+def mup_config(
+    cfg: TransformerConfig, base: TransformerConfig
+) -> TransformerConfig:
+    """Return ``cfg`` with muP forward multipliers set relative to
+    ``base`` (the small proxy whose hyperparameters transfer)."""
+    m = width_mult(cfg, base)
+    # attention: logits scaled 1/d instead of 1/sqrt(d), normalized so the
+    # base model is unchanged (scale = sqrt(base_head_dim)/head_dim)
+    attn_scale = (base.head_dim**0.5) / cfg.head_dim
+    return replace(cfg, mup_attn_scale=attn_scale, mup_output_mult=1.0 / m)
+
+
+# axes whose size grows with model width; vocab / max_seq_len / experts /
+# stage axes are width-finite (the mup package's "infinite dims")
+WIDTH_AXES = {"embed", "heads", "head_dim", "mlp", "norm", "expert_mlp"}
+
+
+def _is_matrix_like(axes: Tuple) -> bool:
+    """Hidden matrix-like = 2+ width-scaling dims (mup's ninf>=2 rule):
+    1/m LR. Embedding tables, the readout (handled by the output
+    multiplier instead) and vectors have <=1 and keep O(1) LR."""
+    if not isinstance(axes, tuple):
+        return False
+    if "expert_mlp" in axes:
+        return True  # expert FFN matrices: their d-axis is unnamed (None)
+    return sum(1 for a in axes if a in WIDTH_AXES) >= 2
+
+
+def mup_lr_scales(cfg: TransformerConfig, base: TransformerConfig) -> Any:
+    """Pytree (congruent with params) of per-leaf LR multipliers:
+    1/width_mult for hidden matrices, 1 elsewhere."""
+    m = width_mult(cfg, base)
+    axes = logical_axes(cfg)
+    return jax.tree_util.tree_map(
+        lambda a: 1.0 / m if _is_matrix_like(a) else 1.0,
+        axes,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(a is None or isinstance(a, str) for a in x),
+    )
+
+
+def scale_adam_lr_by_mup(scales: Any) -> optax.GradientTransformation:
+    """Optax transform multiplying each leaf's update by its muP LR scale.
+    Chain it AFTER the Adam transform (updates, not grads, are scaled):
+    ``optax.chain(optax.adamw(lr), scale_adam_lr_by_mup(scales))``."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        scaled = jax.tree_util.tree_map(
+            lambda u, s: u * s, updates, scales
+        )
+        return scaled, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def mup_adamw(
+    lr: float,
+    cfg: TransformerConfig,
+    base: TransformerConfig,
+    weight_decay: float = 0.0,
+    **adam_kwargs,
+) -> optax.GradientTransformation:
+    """AdamW under muP: base LR transfers across width.
+
+    Weight decay under muP-AdamW should stay *coupled* to the scaled LR
+    (decay strength independent of width), which optax's multiplicative
+    ``weight_decay`` inside adamw already gives when we scale the whole
+    update afterwards.
+    """
+    scales = mup_lr_scales(cfg, base)
+    return optax.chain(
+        optax.adamw(lr, weight_decay=weight_decay, **adam_kwargs),
+        scale_adam_lr_by_mup(scales),
+    )

@@ -209,6 +209,10 @@ def _attention_block(x, layer, cfg: TransformerConfig, mesh, positions):
     if cfg.rope:
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
+    if cfg.mup_attn_scale is not None:
+        # muP 1/d attention: fold the deviation from the kernels' builtin
+        # 1/sqrt(d) into q, so flash and ring paths need no new plumbing
+        q = q * (cfg.mup_attn_scale * cfg.head_dim**0.5)
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
         o = ring_self_attention(q, k, v, mesh, causal=True)
     else:
@@ -270,7 +274,10 @@ def lm_head(params: Params, x: jnp.ndarray, cfg: TransformerConfig):
         logits = jnp.einsum("btd,vd->btv", x, w)
     else:
         logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dt))
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if cfg.mup_output_mult != 1.0:
+        logits = logits * cfg.mup_output_mult
+    return logits
 
 
 def token_nll(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
@@ -380,6 +387,10 @@ def forward_step(
         if cfg.rope:
             q = _rope(q, positions, cfg.rope_theta)
             k = _rope(k, positions, cfg.rope_theta)
+        if cfg.mup_attn_scale is not None:
+            # same muP 1/d fold as _attention_block — decode must score
+            # with the training attention math
+            q = q * (cfg.mup_attn_scale * cfg.head_dim**0.5)
         k_all = lax.dynamic_update_slice(
             cache["k"][i], k.astype(cache["k"].dtype), (0, cur_len, 0, 0)
         )

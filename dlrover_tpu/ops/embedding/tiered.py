@@ -36,6 +36,53 @@ from dlrover_tpu.ops.embedding.store import ShardedKvEmbedding
 _IN_CHUNK = 500  # sqlite host-parameter limit safety (999 on old builds)
 
 
+class _RWLock:
+    """Readers-writer lock: gathers run concurrently (the hot path, the
+    C++ store handles its own per-shard locking); a tier move (eviction)
+    excludes them so no gather can probe the hot tier before a row is
+    evicted and re-insert it after (a TOCTOU that would shadow the cold
+    copy with a freshly initialized row, losing trained values).
+
+    Writer-preferring: new readers also wait while a writer is *queued*,
+    otherwise continuously-overlapping gather traffic would starve
+    eviction forever (and unbounded hot-tier growth is the exact failure
+    the tier exists to prevent).
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    def acquire_read(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self):
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writer = True
+
+    def release_write(self):
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+
 class TieredKvEmbedding:
     def __init__(self, hot: ShardedKvEmbedding, cold_path: str):
         self.hot = hot
@@ -46,6 +93,7 @@ class TieredKvEmbedding:
             "ts INTEGER, evict_seq INTEGER)"
         )
         self._lock = threading.Lock()
+        self._tier_lock = _RWLock()  # gathers read / eviction writes
         self.dim = hot.dim
         self.row_floats = hot.dim * (1 + hot.num_slots)
         with self._lock:
@@ -128,8 +176,16 @@ class TieredKvEmbedding:
     # -- public surface (hot-store API + fault-in) ---------------------
     def gather(self, keys, insert_missing: bool = True) -> np.ndarray:
         k = np.ascontiguousarray(keys, dtype=np.int64).ravel()
-        self._fault_in(k)
-        return self.hot.gather(k, insert_missing)
+        # read-side of the tier lock: without it a gather could probe the
+        # hot tier just before eviction moves a row out and then
+        # re-initialize it (insert_missing) just after — shadowing the
+        # cold copy with a fresh row and losing the trained values
+        self._tier_lock.acquire_read()
+        try:
+            self._fault_in(k)
+            return self.hot.gather(k, insert_missing)
+        finally:
+            self._tier_lock.release_read()
 
     def __getattr__(self, name):
         # sparse_* updates / scatter pass through to the hot tier —
@@ -204,11 +260,30 @@ class TieredKvEmbedding:
         total = 0
         self._evict_seq += 1
         for shard in self.hot.shards:
-            keys, rows, freq, ts = shard.export()
-            cold = ts < ts_limit
-            n = int(cold.sum())
-            if not n:
-                continue
+            # writer side of the tier lock, per shard (gathers of other
+            # shards' keys proceed between shards): the snapshot →
+            # insert → evict → stale-delete sequence must not interleave
+            # with a gather's probe-then-insert of the same keys
+            self._tier_lock.acquire_write()
+            try:
+                total += self._evict_shard(shard, ts_limit)
+            finally:
+                self._tier_lock.release_write()
+        # settle the maintained counter to the exact value (it may have
+        # overshot when INSERT OR REPLACE overwrote existing rows)
+        with self._lock:
+            (self._cold_count,) = self._conn.execute(
+                "SELECT COUNT(*) FROM rows"
+            ).fetchone()
+        if total:
+            logger.info(f"evicted {total} cold embedding rows to disk")
+        return total
+
+    def _evict_shard(self, shard, ts_limit: int) -> int:
+        keys, rows, freq, ts = shard.export()
+        cold = ts < ts_limit
+        n = int(cold.sum())
+        if n:
             idx = np.nonzero(cold)[0]
             with self._lock:
                 self._conn.executemany(
@@ -225,6 +300,12 @@ class TieredKvEmbedding:
                     ],
                 )
                 self._conn.commit()
+                # keep the maintained counter >= the true cold count at
+                # every point a gather can run (between per-shard write
+                # sections): a false zero would short-circuit fault-in
+                # for rows this shard just evicted. Transient overshoot
+                # is safe; evict_cold settles the exact value at the end
+                self._cold_count += n
             shard.evict_older_than(ts_limit)
             # rows touched in the snapshot→evict window stayed hot: drop
             # their (stale) disk copies before anything can re-export them
@@ -243,15 +324,9 @@ class TieredKvEmbedding:
                             chunk,
                         )
                     self._conn.commit()
+                    self._cold_count -= len(still_hot)
                 n -= len(still_hot)
-            total += n
-        if total:
-            with self._lock:
-                (self._cold_count,) = self._conn.execute(
-                    "SELECT COUNT(*) FROM rows"
-                ).fetchone()
-            logger.info(f"evicted {total} cold embedding rows to disk")
-        return total
+        return n
 
     def close(self):
         with self._lock:

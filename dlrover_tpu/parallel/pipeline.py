@@ -18,10 +18,22 @@ machinery:
 - autodiff through the scan-of-ppermute yields the backward pipeline
   schedule for free (ppermute transposes to the reverse rotation).
 
-Schedule: GPipe with M microbatches over P stages — bubble fraction
-(P-1)/(M+P-1). Activation memory is bounded by ``cfg.remat`` (each stage
-checkpoint-recomputes its layer stack in backward, the standard GPipe
-memory trade).
+Schedules:
+
+- **GPipe** (``schedule="gpipe"``): M microbatch forwards scanned over the
+  stage ring, reverse-mode AD gives the backward rotation; bubble fraction
+  (P-1)/(M+P-1), activation footprint O(M) stage inputs per device (the
+  scan carry is saved per tick).
+- **1F1B** (``schedule="1f1b"``): the steady-state one-forward-one-backward
+  schedule (PipeDream-flush, what Megatron/DeepSpeed run). Reverse-mode AD
+  cannot produce it (it is not "forward then transpose"), so the backward
+  is built manually: each tick every stage runs one microbatch forward
+  AND one microbatch backward (``jax.vjp`` per stage, recomputing the
+  stage forward from its saved *input* — remat at stage granularity), the
+  last stage turns a microbatch's loss into d(loss)/dy the same tick its
+  forward completes. Activation footprint is a ring buffer of 2P-1 stage
+  inputs per device — **independent of M**, the property that lets real
+  pipelines run M >> P microbatches to shrink the bubble.
 
 Layout contract: the embedding runs before the pipeline region and the
 final-norm/LM-head after it, in plain GSPMD-auto land; only the L
@@ -247,6 +259,234 @@ def pipeline_loss_fn(
 
 
 # ---------------------------------------------------------------------------
+# 1F1B schedule (manual backward)
+# ---------------------------------------------------------------------------
+def pipeline_value_and_grad_1f1b(
+    pparams: Any,
+    tokens: jnp.ndarray,
+    targets: jnp.ndarray,
+    cfg: TransformerConfig,
+    mesh,
+    num_microbatches: int,
+) -> Tuple[jnp.ndarray, Any]:
+    """(loss, grads) under the 1F1B schedule; grads congruent to pparams.
+
+    Tick clock: stage i runs forward of microbatch j at tick ``i + j`` and
+    backward of microbatch j at tick ``2(P-1) - i + j`` (so the last stage
+    does fwd+bwd of the same microbatch in one tick, stage 0's backward
+    lags its forward by 2(P-1) ticks — the classic 1F1B picture). Both
+    hops (activations forward, cotangents backward) are next-tick
+    ``ppermute`` neighbours, so one scan over ``M + 2(P-1)`` ticks runs
+    the whole schedule. Stage inputs wait in a ring buffer of ``2P-1``
+    slots (max residency 2(P-1) ticks < 2P-1); the stage forward is
+    recomputed inside ``jax.vjp`` at the backward tick, so nothing else
+    is stored.
+
+    Only *token ids* ([M, mb, T] int32 — no model-dim factor) cross the
+    shard_map boundary per microbatch: the embedding lookup runs inside
+    the tick on stage 0 and its backward is a hand-written scatter-add
+    into the embedding-grad accumulator (the gather's exact vjp, but
+    touching only the mb*T gathered rows per tick instead of
+    materializing a dense [vocab, D] cotangent to sum). So per-device
+    activation state really is the O(P) ring buffer; nothing activation-
+    sized scales with M.
+
+    The loss head (final norm + vocab projection) and the embedding are
+    evaluated inside the tick on every stage (SPMD lockstep — only the
+    last/first stage's result is kept); the head costs one microbatch
+    head per tick, the same order as the stage compute it overlaps with.
+    """
+    pp = mesh.shape["pp"]
+    M = num_microbatches
+    _check_pipeline_cfg(cfg, pp)
+    if mesh.shape.get("sp", 1) > 1:
+        raise ValueError("sp (ring attention) inside pp stages not supported")
+    B, T = tokens.shape
+    if B % M != 0:
+        raise ValueError(f"batch {B} must divide into {M} microbatches")
+    mb = B // M
+    D = cfg.model_dim
+
+    head_params = {"final_norm": pparams["final_norm"]}
+    if cfg.tie_embeddings:
+        head_params["embed"] = pparams["embed"]
+    else:
+        head_params["lm_head"] = pparams["lm_head"]
+
+    tok = lax.with_sharding_constraint(
+        tokens.reshape(M, mb, T),
+        NamedSharding(mesh, P(None, ("dp", "fsdp"))),
+    )
+    tgt = targets.reshape(M, mb, T)
+
+    def block(xx, layer):
+        positions = jnp.broadcast_to(jnp.arange(T), xx.shape[:2])
+        xx = _attention_block(xx, layer, cfg, None, positions)
+        xx, _ = _mlp_block(xx, layer, cfg, None)
+        return xx
+
+    def stage_fn(stage_layers, xx):
+        def body(xx, layer):
+            return block(xx, layer), None
+
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        xx, _ = lax.scan(body, xx, stage_layers)
+        return xx
+
+    def head_loss(hp, y, t_mb):
+        # /M so per-microbatch cotangents and head grads sum to the grads
+        # of the mean-over-microbatches loss
+        return token_nll(lm_head(hp, y, cfg), t_mb) / M
+
+    n_ticks = M + 2 * (pp - 1)
+    buf_n = 2 * pp - 1
+
+    def pipelined(stages, head_p, emb_p, tok_all, tgt_all):
+        stages_loc = jax.tree_util.tree_map(lambda a: a[0], stages)
+        idx = lax.axis_index("pp")
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+        bwd_perm = [((i + 1) % pp, i) for i in range(pp)]
+
+        def v(a):
+            return lax.pcast(a, ("pp",), to="varying")
+
+        tok_loc = v(tok_all)
+        tgt_loc = v(tgt_all)
+        head_loc = jax.tree_util.tree_map(v, head_p)
+        emb_loc = jax.tree_util.tree_map(v, emb_p)
+
+        act_dt = jnp.dtype(cfg.dtype)
+        zeros_mb = v(jnp.zeros((mb, T, D), act_dt))
+        carry0 = (
+            zeros_mb,  # act: activation arriving from the previous stage
+            zeros_mb,  # gin: cotangent arriving from the next stage
+            v(jnp.zeros((buf_n, mb, T, D), act_dt)),
+            jax.tree_util.tree_map(jnp.zeros_like, stages_loc),
+            jax.tree_util.tree_map(jnp.zeros_like, head_loc),
+            jax.tree_util.tree_map(jnp.zeros_like, emb_loc),
+            v(jnp.float32(0.0)),  # loss accumulator (last stage)
+        )
+
+        def tick(carry, t):
+            act, gin, buf, gstage, ghead, gemb, loss_acc = carry
+            last = idx == pp - 1
+
+            # -- forward slot: microbatch jf enters this stage
+            jf = t - idx
+            fwd_on = (jf >= 0) & (jf < M)
+            jf_c = jnp.clip(jf, 0, M - 1)
+            tok_mb = lax.dynamic_index_in_dim(
+                tok_loc, jf_c, 0, keepdims=False
+            )
+            inject = embed_tokens({"embed": emb_loc}, tok_mb, cfg)
+            x_in = jnp.where(idx == 0, inject.astype(act_dt), act)
+            y = stage_fn(stages_loc, x_in)
+            buf = jnp.where(
+                fwd_on,
+                lax.dynamic_update_index_in_dim(buf, x_in, t % buf_n, 0),
+                buf,
+            )
+
+            # -- last stage: loss -> d(loss)/dy the same tick (the "1B"
+            # of this tick consumes it below, jb == jf there)
+            t_mb = lax.dynamic_index_in_dim(
+                tgt_loc, jf_c, 0, keepdims=False
+            )
+            loss_mb, (dhead, dy_head) = jax.value_and_grad(
+                head_loss, argnums=(0, 1)
+            )(head_loc, y, t_mb)
+            loss_on = last & fwd_on
+            loss_w = loss_on.astype(jnp.float32)
+            loss_acc = loss_acc + loss_mb * loss_w
+            # mask by scalar multiply, not where-select: a 0/1 scale
+            # fuses into the add (matters for the tied-embedding head
+            # whose grads are [vocab, D]-dense)
+            ghead = jax.tree_util.tree_map(
+                lambda g, d: g + d * loss_w.astype(d.dtype), ghead, dhead
+            )
+
+            # -- backward slot: microbatch jb leaves this stage
+            jb = t - 2 * (pp - 1) + idx
+            bwd_on = (jb >= 0) & (jb < M)
+            jb_c = jnp.clip(jb, 0, M - 1)
+            x_saved = lax.dynamic_index_in_dim(
+                buf, (idx + jb_c) % buf_n, 0, keepdims=False
+            )
+            dy = jnp.where(last, dy_head.astype(x_saved.dtype), gin)
+            _, svjp = jax.vjp(stage_fn, stages_loc, x_saved)
+            dstage, dxi = svjp(dy)
+            bwd_w = bwd_on.astype(jnp.float32)
+            gstage = jax.tree_util.tree_map(
+                lambda g, d: g + d * bwd_w.astype(d.dtype), gstage, dstage
+            )
+
+            # -- embedding backward (stage 0): the gather's vjp is a
+            # scatter-add touching only the mb*T gathered rows — never a
+            # dense [vocab, D] cotangent
+            emb_w = ((idx == 0) & bwd_on).astype(jnp.float32)
+            tok_jb = lax.dynamic_index_in_dim(
+                tok_loc, jb_c, 0, keepdims=False
+            )
+            contrib = dxi.astype(jnp.float32) * emb_w
+            gtok = gemb["tokens"].at[tok_jb.reshape(-1)].add(
+                contrib.reshape(-1, D).astype(gemb["tokens"].dtype)
+            )
+            new_gemb = dict(gemb)
+            new_gemb["tokens"] = gtok
+            if "positions" in gemb:
+                new_gemb["positions"] = (
+                    gemb["positions"]
+                    .at[:T]
+                    .add(contrib.sum(0).astype(gemb["positions"].dtype))
+                )
+            gemb = new_gemb
+
+            # -- next-tick hops: activations one stage forward, cotangents
+            # one stage back
+            if pp > 1:
+                act = lax.ppermute(y, "pp", fwd_perm)
+                gin = lax.ppermute(dxi, "pp", bwd_perm)
+            return (act, gin, buf, gstage, ghead, gemb, loss_acc), None
+
+        (_, _, _, gstage, ghead, gemb, loss_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(n_ticks)
+        )
+        # only one stage holds each of these (masked zeros elsewhere), so
+        # psum over pp is selection, not averaging
+        loss_out = lax.psum(loss_acc, "pp")
+        ghead_out = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, "pp"), ghead
+        )
+        gemb_out = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, "pp"), gemb
+        )
+        gstage_out = jax.tree_util.tree_map(lambda g: g[None], gstage)
+        return gstage_out, ghead_out, gemb_out, loss_out
+
+    gstage, ghead, gemb, loss = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(P("pp"), P(), P(), P(), P()),
+        out_specs=(P("pp"), P(), P(), P()),
+        axis_names={"pp"},
+    )(pparams["stages"], head_params, pparams["embed"], tok, tgt)
+
+    grads = {
+        "stages": gstage,
+        "final_norm": ghead["final_norm"],
+        "embed": gemb,
+    }
+    if cfg.tie_embeddings:
+        grads["embed"] = jax.tree_util.tree_map(
+            jnp.add, grads["embed"], ghead["embed"]
+        )
+    else:
+        grads["lm_head"] = ghead["lm_head"]
+    return loss, grads
+
+
+# ---------------------------------------------------------------------------
 # training
 # ---------------------------------------------------------------------------
 def pipeline_state_shardings(
@@ -289,17 +529,31 @@ def build_pipeline_train_step(
     num_microbatches: int,
     rules: Optional[ShardingRules] = None,
     donate: bool = True,
+    schedule: str = "gpipe",
 ):
-    """jitted (state, tokens, targets) → (state, metrics), GPipe over pp."""
+    """jitted (state, tokens, targets) → (state, metrics) over pp.
+
+    ``schedule``: "gpipe" (AD backward, O(M) activation footprint) or
+    "1f1b" (manual backward, O(P) footprint — see module docstring).
+    """
     import optax
 
-    def train_step(state: TrainState, tokens, targets):
-        def lf(p):
-            return pipeline_loss_fn(
-                p, tokens, targets, cfg, mesh, num_microbatches
-            )
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
 
-        loss, grads = jax.value_and_grad(lf)(state.params)
+    def train_step(state: TrainState, tokens, targets):
+        if schedule == "1f1b":
+            loss, grads = pipeline_value_and_grad_1f1b(
+                state.params, tokens, targets, cfg, mesh, num_microbatches
+            )
+        else:
+
+            def lf(p):
+                return pipeline_loss_fn(
+                    p, tokens, targets, cfg, mesh, num_microbatches
+                )
+
+            loss, grads = jax.value_and_grad(lf)(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         return (

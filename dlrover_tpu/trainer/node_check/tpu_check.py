@@ -31,24 +31,58 @@ def write_result(elapsed: float, path: str = ""):
         json.dump({"elapsed": elapsed}, f)
 
 
-def matmul_rounds(rounds: int = 3, size: int = 1024):
+def _workload_scale():
+    """(matmul_size, matmul_rounds, collective_elems, collective_rounds).
+
+    On an accelerator the load must *sustain* the MXU and the interconnect
+    long enough that a degraded chip/link separates from healthy noise —
+    the reference's check is 10 rounds of a 16M-element allgather plus a
+    matmul (node_check/utils.py:59-90), not a one-shot kernel. 8192^2 bf16
+    matmuls (~1.1 TFLOP each) x 30 chained rounds ≈ tens of TFLOPs of MXU
+    time; 16M fp32 elements x 10 chained collectives ≈ 640 MB moved.
+    On CPU (tests, smoke runs) the same shapes would dominate the suite,
+    so they drop to token sizes. Env overrides for either case:
+    DLROVER_TPU_CHECK_{MM_SIZE,MM_ROUNDS,COLL_ELEMS,COLL_ROUNDS}.
+    """
+    import jax
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    mm_size = 8192 if on_accel else 256
+    mm_rounds = 30 if on_accel else 3
+    elems = (1 << 24) if on_accel else (1 << 16)
+    coll_rounds = 10 if on_accel else 3
+    mm_size = int(os.getenv("DLROVER_TPU_CHECK_MM_SIZE", mm_size))
+    mm_rounds = int(os.getenv("DLROVER_TPU_CHECK_MM_ROUNDS", mm_rounds))
+    elems = int(os.getenv("DLROVER_TPU_CHECK_COLL_ELEMS", elems))
+    coll_rounds = int(os.getenv("DLROVER_TPU_CHECK_COLL_ROUNDS", coll_rounds))
+    return mm_size, mm_rounds, elems, coll_rounds
+
+
+def matmul_rounds(rounds: int, size: int):
     import jax
     import jax.numpy as jnp
 
     @jax.jit
     def mm(a):
-        return a @ a
+        # normalize so chained rounds stay ~1.0 (bf16 ones would hit inf
+        # after two rounds; keep the MXU on real numbers)
+        return (a @ a) * jnp.bfloat16(1.0 / size)
 
     a = jnp.ones((size, size), dtype=jnp.bfloat16)
-    mm(a).block_until_ready()  # compile outside the timed region
+    b = mm(a)  # compile outside the timed region
+    float(jnp.sum(b))
     t0 = time.monotonic()
     for _ in range(rounds):
         a = mm(a)
-    a.block_until_ready()
+    # fetch a scalar that depends on the whole chain: on tunneled
+    # runtimes block_until_ready can return before execution finishes,
+    # which would time dispatch instead of the MXU (bench.py hit the
+    # same artifact)
+    float(jnp.sum(a))
     return time.monotonic() - t0
 
 
-def collective_rounds(ctx, rounds: int = 10, elems: int = 1 << 20):
+def collective_rounds(ctx, rounds: int, elems: int):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -64,13 +98,16 @@ def collective_rounds(ctx, rounds: int = 10, elems: int = 1 << 20):
 
     @jax.jit
     def allreduce(v):
-        return jnp.sum(v) * jnp.ones_like(v)
+        return jnp.sum(v) / v.size * jnp.ones_like(v)
 
     allreduce(x).block_until_ready()
     t0 = time.monotonic()
     for _ in range(rounds):
         x = allreduce(x)
     x.block_until_ready()
+    # force local completion of the chained collectives (see
+    # matmul_rounds: block_until_ready alone can return early)
+    np.asarray(x.addressable_shards[0].data[:1])
     return time.monotonic() - t0
 
 
@@ -81,9 +118,10 @@ def main() -> int:
     mock_err = os.getenv("DLROVER_TPU_MOCK_ERR_RANK", "")
     if mock_err and int(mock_err) == ctx.process_id:
         raise RuntimeError(f"mock error on rank {ctx.process_id}")
-    t = matmul_rounds()
+    mm_size, mm_rounds, elems, coll_rounds = _workload_scale()
+    t = matmul_rounds(mm_rounds, mm_size)
     if ctx.is_distributed:
-        t += collective_rounds(ctx)
+        t += collective_rounds(ctx, coll_rounds, elems)
     mock_slow = os.getenv("DLROVER_TPU_MOCK_SLOW_RANK", "")
     if mock_slow and int(mock_slow) == ctx.process_id:
         time.sleep(2.0)

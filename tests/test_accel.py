@@ -128,3 +128,55 @@ def test_auto_accelerate_with_pinned_strategy():
     x = rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)
     state, metrics = result.step_fn(state, x, x)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_tpe_propose_prefers_good_region():
+    """TPE must propose the pool candidate nearest the good observations
+    in feature space."""
+    from dlrover_tpu.accel.bayes import tpe_propose
+
+    def s(dp, fsdp):
+        return Strategy(mesh=MeshConfig(dp=dp, fsdp=fsdp))
+
+    # observed: big-fsdp fast (good), big-dp slow (bad)
+    tried = [s(8, 1), s(4, 2), s(1, 8), s(2, 4)]
+    scores = [0.9, 0.5, 0.1, 0.12]
+    pool = [s(1, 4), s(4, 1)]
+    pick = tpe_propose(tried, scores, pool)
+    assert pick.mesh.fsdp == 4, pick.describe()
+
+
+def test_tpe_propose_handles_failures():
+    from dlrover_tpu.accel.bayes import tpe_propose
+
+    def s(dp):
+        return Strategy(mesh=MeshConfig(dp=dp))
+
+    tried = [s(8), s(4)]
+    scores = [None, 0.2]  # first crashed
+    pick = tpe_propose(tried, scores, [s(2), s(1)])
+    assert pick.mesh.dp in (1, 2)
+
+
+def test_auto_accelerate_bayes_search():
+    """The TPE path returns a measured, trainable winner."""
+    cfg = tiny(num_layers=2)
+    tx = optax.adamw(1e-3)
+    result = auto_accelerate(
+        cfg, tx, batch=16, seq=32, devices=jax.devices(),
+        max_candidates=6, max_timed=1, search="bayes",
+    )
+    assert result.reports[0].step_s is not None
+    state = result.init_fn(jax.random.PRNGKey(0))
+    from dlrover_tpu.models import shard_batch
+
+    x = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (16, 32)
+    ).astype(np.int32)
+    if result.strategy.mesh.pp > 1:
+        bx = by = x
+    else:
+        b = shard_batch({"x": x, "y": x}, result.mesh)
+        bx, by = b["x"], b["y"]
+    state, metrics = result.step_fn(state, bx, by)
+    assert np.isfinite(float(metrics["loss"]))

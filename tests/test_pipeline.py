@@ -121,6 +121,101 @@ def test_pipeline_training_matches_plain():
     assert losses_pp[-1] < losses_pp[0]
 
 
+@pytest.mark.parametrize("pp,mb", [(2, 4), (4, 4)])
+def test_1f1b_grads_match_plain(pp, mb):
+    """The manual 1F1B backward must produce the same gradients as AD on
+    the unpiped model (fp32 tiny config => tight tolerance)."""
+    from dlrover_tpu.parallel.pipeline import pipeline_value_and_grad_1f1b
+
+    cfg = tiny(num_layers=4)
+    mesh = build_mesh(MeshConfig(pp=pp, dp=8 // pp))
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    x, y = _batch(cfg)
+
+    ref_loss, ref_grads = jax.jit(
+        jax.value_and_grad(lambda p: loss_fn(p, x, y, cfg))
+    )(params)
+    stacked = stack_pipeline_params(params, pp)
+    loss, grads = jax.jit(
+        lambda p: pipeline_value_and_grad_1f1b(p, x, y, cfg, mesh, mb)
+    )(stacked)
+    np.testing.assert_allclose(
+        float(loss), float(ref_loss), rtol=1e-5, atol=1e-6
+    )
+    ref_grads_stacked = stack_pipeline_params(ref_grads, pp)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        grads,
+        ref_grads_stacked,
+    )
+
+
+def test_1f1b_grads_tied_embeddings():
+    """Tied-embedding configs route head grads back into the embedding
+    table (two contributions summed)."""
+    from dlrover_tpu.parallel.pipeline import pipeline_value_and_grad_1f1b
+
+    cfg = tiny(num_layers=2, tie_embeddings=True, rope=False)
+    pp, mb = 2, 2
+    mesh = build_mesh(MeshConfig(pp=pp, dp=4))
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    x, y = _batch(cfg)
+
+    ref_loss, ref_grads = jax.jit(
+        jax.value_and_grad(lambda p: loss_fn(p, x, y, cfg))
+    )(params)
+    stacked = stack_pipeline_params(params, pp)
+    loss, grads = jax.jit(
+        lambda p: pipeline_value_and_grad_1f1b(p, x, y, cfg, mesh, mb)
+    )(stacked)
+    np.testing.assert_allclose(
+        float(loss), float(ref_loss), rtol=1e-5, atol=1e-6
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        grads,
+        stack_pipeline_params(ref_grads, pp),
+    )
+
+
+def test_1f1b_training_matches_gpipe():
+    """Both schedules drive identical optimizer trajectories."""
+    cfg = tiny(num_layers=2)
+    pp, mb = 2, 4
+    mesh = build_mesh(MeshConfig(pp=pp, dp=2, fsdp=2))
+    tx = optax.adamw(1e-2)
+
+    s_g, _ = init_pipeline_state(jax.random.PRNGKey(0), cfg, mesh, tx)
+    s_1, _ = init_pipeline_state(jax.random.PRNGKey(0), cfg, mesh, tx)
+    step_g = build_pipeline_train_step(
+        cfg, mesh, tx, mb, donate=False, schedule="gpipe"
+    )
+    step_1 = build_pipeline_train_step(
+        cfg, mesh, tx, mb, donate=False, schedule="1f1b"
+    )
+    x, y = _batch(cfg)
+    for _ in range(3):
+        s_g, m_g = step_g(s_g, x, y)
+        s_1, m_1 = step_1(s_1, x, y)
+        np.testing.assert_allclose(
+            float(m_1["loss"]), float(m_g["loss"]), rtol=1e-5, atol=1e-6
+        )
+    # 3 AdamW steps amplify last-ulp grad differences through m/rsqrt(v)
+    # for elements whose momentum crosses zero; the strict checks are the
+    # per-step loss equality above and the one-step grad tests
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-2, atol=1e-3
+        ),
+        s_1.params,
+        s_g.params,
+    )
+
+
 def test_pipeline_rejects_bad_configs():
     cfg = tiny(num_layers=3)
     mesh = build_mesh(MeshConfig(pp=2, dp=4))

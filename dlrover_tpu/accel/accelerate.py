@@ -86,12 +86,17 @@ def auto_accelerate(
             raise ValueError(f"unknown search algorithm {search!r}")
         best = reports[0]
         if not (best.ok and best.fits):
-            over = [r for r in reports if r.ok and not r.fits]
+            # mem_bytes == 0 means "no memory analysis", not "needs 0
+            # bytes" — surface the per-report error instead
+            over = [
+                r for r in reports
+                if r.ok and not r.fits and r.mem_bytes > 0
+            ]
             detail = (
                 f"smallest candidate needs {min(r.mem_bytes for r in over):.3e} "
                 f"bytes vs budget {hbm_budget:.3e}"
                 if over
-                else f"best compile error: {best.error}"
+                else f"best candidate error: {best.error}"
             )
             raise RuntimeError(
                 f"no candidate strategy compiled within budget; {detail}"

@@ -39,6 +39,9 @@ class TransformerConfig:
     # muP forward multipliers (models/mup.py sets these; defaults = SP)
     mup_attn_scale: Optional[float] = None  # None => 1/sqrt(head_dim)
     mup_output_mult: float = 1.0
+    # int8 MXU path for the MLP projections (ops/int8_matmul.py — the
+    # TPU-native analog of the reference's FP8 optimization)
+    int8_mlp: bool = False
 
     @property
     def kv_heads(self) -> int:

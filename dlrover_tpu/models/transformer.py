@@ -240,16 +240,20 @@ def _mlp_block(x, layer, cfg: TransformerConfig, mesh):
             out = out.reshape(B, T, d)
         return x + out, aux
     mlp = layer["mlp"]
+    if cfg.int8_mlp:
+        from dlrover_tpu.ops.int8_matmul import int8_einsum_btd_df as mm
+    else:
+
+        def mm(x, w):
+            return jnp.einsum("btd,df->btf", x, w.astype(x.dtype))
+
     if cfg.swiglu:
-        g = jnp.einsum("btd,df->btf", h, mlp["w_gate"].astype(h.dtype))
-        u = jnp.einsum("btd,df->btf", h, mlp["w_up"].astype(h.dtype))
+        g = mm(h, mlp["w_gate"])
+        u = mm(h, mlp["w_up"])
         z = jax.nn.silu(g) * u
     else:
-        z = jax.nn.gelu(
-            jnp.einsum("btd,df->btf", h, mlp["w_up"].astype(h.dtype))
-            + mlp["b_up"].astype(h.dtype)
-        )
-    out = jnp.einsum("btf,fd->btd", z, mlp["w_down"].astype(h.dtype))
+        z = jax.nn.gelu(mm(h, mlp["w_up"]) + mlp["b_up"].astype(h.dtype))
+    out = mm(z, mlp["w_down"])
     if not cfg.swiglu:
         out = out + mlp["b_down"].astype(h.dtype)
     return x + out, jnp.float32(0.0)

@@ -8,6 +8,11 @@ transforms.
 """
 
 from dlrover_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from dlrover_tpu.ops.int8_matmul import (  # noqa: F401
+    int8_einsum_btd_df,
+    int8_matmul,
+    quantize_int8,
+)
 from dlrover_tpu.ops.optimizers import agd, make_wsam_grad_fn  # noqa: F401
 from dlrover_tpu.ops.quantized_optim import (  # noqa: F401
     adamw_4bit,

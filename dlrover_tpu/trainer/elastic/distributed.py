@@ -59,16 +59,46 @@ def elastic_context() -> ElasticContext:
 _initialized = False
 
 
+def enable_compile_cache(cache_dir: str = "") -> str:
+    """Point JAX's persistent compilation cache at a job-stable dir.
+
+    The elasticity hard part SURVEY.md §7 calls out: a restarted worker's
+    first step recompiles the whole train program (tens of seconds to
+    minutes at scale) — pure goodput loss. With the persistent cache, a
+    restart into the SAME world size replays the compiled executable from
+    disk, and each previously-seen world size after a scale event is a
+    cache hit too (entries are keyed on the program, which includes mesh
+    shape). Returns the cache dir in use, "" when disabled via
+    ``DLROVER_TPU_COMPILE_CACHE=off``.
+    """
+    env = os.getenv("DLROVER_TPU_COMPILE_CACHE", "")
+    if env == "off":
+        return ""
+    cache_dir = env or cache_dir or "/tmp/dlrover_tpu/compile_cache"
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache everything that took meaningful compile time, not only the
+    # multi-minute programs (defaults skip sub-second compiles)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir
+
+
 def init_elastic(timeout_secs: int = 300) -> ElasticContext:
     """Configure devices and join the JAX distributed system.
 
     Safe to call for single-process jobs (no-op init). Fast re-init after a
     restart is just process re-exec + this call — the agent already
-    re-assigned ``process_id``/``coordinator_addr`` for the new world.
+    re-assigned ``process_id``/``coordinator_addr`` for the new world;
+    the persistent compilation cache turns the post-restart recompile
+    into a disk read.
     """
     global _initialized
     ctx = elastic_context()
     configure_devices()  # honors DLROVER_TPU_DEVICE_SPEC before backend init
+    enable_compile_cache()
     if ctx.is_distributed and not _initialized:
         import jax
 

@@ -132,3 +132,18 @@ class TestLauncher:
             os.environ.pop("TEST_CKPT_DIR", None)
             AsyncCheckpointSaver.reset()
         assert rc == 0
+
+
+def test_enable_compile_cache(tmp_path, monkeypatch):
+    import jax
+
+    from dlrover_tpu.trainer.elastic.distributed import enable_compile_cache
+
+    monkeypatch.setenv("DLROVER_TPU_COMPILE_CACHE", str(tmp_path / "cc"))
+    got = enable_compile_cache()
+    assert got == str(tmp_path / "cc")
+    assert (tmp_path / "cc").is_dir()
+    assert jax.config.jax_compilation_cache_dir == got
+
+    monkeypatch.setenv("DLROVER_TPU_COMPILE_CACHE", "off")
+    assert enable_compile_cache() == ""

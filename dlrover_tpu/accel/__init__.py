@@ -26,6 +26,11 @@ the winner is also published via the master KV store, see
 from dlrover_tpu.accel.strategy import Strategy  # noqa: F401
 from dlrover_tpu.accel.candidates import candidate_strategies  # noqa: F401
 from dlrover_tpu.accel.dry_runner import DryRunReport, dry_run  # noqa: F401
+from dlrover_tpu.accel.opt_lib import (  # noqa: F401
+    apply_optimizations,
+    register_optimization,
+    registered_optimizations,
+)
 from dlrover_tpu.accel.accelerate import (  # noqa: F401
     AccelerateResult,
     agree_strategy,

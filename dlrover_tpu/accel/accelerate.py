@@ -12,11 +12,12 @@ can reuse (or deliberately re-run) the search.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
-from typing import Any, Callable, List, Optional
+from dataclasses import dataclass, replace as dc_replace
+from typing import Any, Callable, List, Optional, Sequence
 
 from dlrover_tpu.accel.candidates import candidate_strategies
 from dlrover_tpu.accel.dry_runner import DryRunReport, _build, dry_run
+from dlrover_tpu.accel.opt_lib import get_optimization
 from dlrover_tpu.accel.strategy import Strategy
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.models.config import TransformerConfig
@@ -44,6 +45,7 @@ def auto_accelerate(
     strategy: Optional[Strategy] = None,
     donate: bool = True,
     search: str = "combination",
+    optimizations: Sequence[str] = (),
 ) -> AccelerateResult:
     """Pick (or apply) a strategy and return the compiled artifacts.
 
@@ -59,7 +61,18 @@ def auto_accelerate(
 
     if devices is None:
         devices = jax.devices()
+    # fail fast on unknown names; actual application happens ONCE, in
+    # _build (strategies only *record* opt names, so non-idempotent
+    # registered opts can't compound across candidate/search/build)
+    opt_names = tuple(dict.fromkeys(optimizations))
+    for n in opt_names:
+        get_optimization(n)
     reports: List[DryRunReport] = []
+    if strategy is not None and opt_names:
+        strategy = dc_replace(
+            strategy,
+            opts=tuple(dict.fromkeys(tuple(strategy.opts) + opt_names)),
+        )
     if strategy is None:
         t0 = time.time()
         cands = candidate_strategies(
@@ -70,21 +83,46 @@ def auto_accelerate(
                 f"no valid mesh factorization for {len(devices)} devices, "
                 f"batch={batch}, seq={seq}"
             )
-        if search == "bayes":
-            from dlrover_tpu.accel.bayes import tpe_search
+        if opt_names:
+            cands = [dc_replace(s, opts=opt_names) for s in cands]
 
-            reports = tpe_search(
-                cands, cfg, tx, batch, seq, devices,
-                budget=max_timed + 2, hbm_budget=hbm_budget,
-            )
-        elif search == "combination":
-            reports = dry_run(
-                cands, cfg, tx, batch, seq, devices,
-                hbm_budget=hbm_budget, max_timed=max_timed,
-            )
-        else:
+        def run_search(cands):
+            if search == "bayes":
+                from dlrover_tpu.accel.bayes import tpe_search
+
+                return tpe_search(
+                    cands, cfg, tx, batch, seq, devices,
+                    budget=max_timed + 2, hbm_budget=hbm_budget,
+                )
+            if search == "combination":
+                return dry_run(
+                    cands, cfg, tx, batch, seq, devices,
+                    hbm_budget=hbm_budget, max_timed=max_timed,
+                )
             raise ValueError(f"unknown search algorithm {search!r}")
+
+        reports = run_search(cands)
         best = reports[0]
+        if (
+            not (best.ok and best.fits)
+            and hbm_budget
+            and "remat" not in opt_names
+        ):
+            # nothing plain fits: retry with activation checkpointing
+            # (FLOPs for HBM — the reference's checkpoint optimization).
+            # Lazy on purpose: when the plain candidates fit, the extra
+            # compiles never happen
+            logger.info(
+                "auto_accelerate: no plain candidate fits the HBM "
+                "budget; retrying the search with remat"
+            )
+            reports = run_search(
+                [
+                    dc_replace(s, opts=tuple(s.opts) + ("remat",))
+                    for s in cands
+                ]
+            )
+            best = reports[0]
         if not (best.ok and best.fits):
             # mem_bytes == 0 means "no memory analysis", not "needs 0
             # bytes" — surface the per-report error instead

@@ -57,8 +57,13 @@ def _build(
     for a strategy. ``donate=False`` for dry runs (state is reused across
     timing iterations); production callers rebuild with ``donate=True``
     so the old train state's buffers are reused in-place."""
+    from dlrover_tpu.accel.opt_lib import apply_optimizations
     from dlrover_tpu.parallel.mesh import build_mesh
 
+    # re-derive the config from the strategy's named optimizations (a
+    # Strategy is a serializable value — another host applying the same
+    # one must build the identical program), then pin dtype/remat
+    cfg, strategy = apply_optimizations(cfg, strategy, strategy.opts)
     cfg = dc_replace(cfg, dtype=strategy.dtype, remat=strategy.remat)
     mesh = build_mesh(strategy.mesh, devices=devices)
     if strategy.mesh.pp > 1:
@@ -69,7 +74,12 @@ def _build(
         )
 
         step_fn = build_pipeline_train_step(
-            cfg, mesh, tx, strategy.num_microbatches, donate=donate
+            cfg,
+            mesh,
+            tx,
+            strategy.num_microbatches,
+            donate=donate,
+            schedule=strategy.pp_schedule,
         )
         shardings = pipeline_state_shardings(cfg, mesh, tx)
 

@@ -1,0 +1,105 @@
+"""Pluggable optimization library.
+
+Parity: atorch's optimization registry (auto/opt_lib/
+optimization_library.py:39-58 — 14 named, composable optimizations:
+zero/FSDP, AMP, fp8, TP, module replace, activation checkpointing,
+compile, PP, mixed parallel, half...). The TPU translation is radically
+smaller because GSPMD subsumes the parallelism entries (they are mesh
+axes on the Strategy, searched by ``candidate_strategies``); what
+remains pluggable are the *program-level* knobs — each a named, pure
+transform of ``(TransformerConfig, Strategy)``:
+
+- ``remat``      — activation checkpointing (HBM <-> FLOPs trade)
+- ``bf16``/``fp32`` — compute dtype policy (AMP analog)
+- ``int8_mlp``   — int8 MXU matmuls in the MLP (FP8 analog)
+- ``1f1b``       — 1F1B pipeline schedule instead of GPipe
+
+A Strategy records applied optimization *names* (``strategy.opts``), so
+the strategy stays a serializable value: ``agree_strategy`` publishes it
+through the master KV store and every host re-derives the identical
+config via this registry. Third-party optimizations register with
+``register_optimization`` (they must be registered on every host before
+the strategy is applied — same contract as the reference's custom
+opt_lib entries).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace as dc_replace
+from typing import Callable, Dict, Sequence, Tuple
+
+from dlrover_tpu.accel.strategy import Strategy
+from dlrover_tpu.models.config import TransformerConfig
+
+ApplyFn = Callable[
+    [TransformerConfig, Strategy], Tuple[TransformerConfig, Strategy]
+]
+
+
+@dataclass(frozen=True)
+class Optimization:
+    name: str
+    apply: ApplyFn
+    # tunable entries may be auto-added by the search (e.g. remat when
+    # the memory gate rejects every plain candidate); non-tunable ones
+    # only apply when the user asks by name
+    tunable: bool = False
+
+
+_REGISTRY: Dict[str, Optimization] = {}
+
+
+def register_optimization(
+    name: str, apply: ApplyFn, tunable: bool = False
+) -> None:
+    _REGISTRY[name] = Optimization(name=name, apply=apply, tunable=tunable)
+
+
+def get_optimization(name: str) -> Optimization:
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown optimization {name!r} (registered: "
+            f"{sorted(_REGISTRY)}); register it on every host with "
+            f"register_optimization before applying strategies"
+        )
+    return _REGISTRY[name]
+
+
+def registered_optimizations() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+def apply_optimizations(
+    cfg: TransformerConfig,
+    strategy: Strategy,
+    names: Sequence[str],
+) -> Tuple[TransformerConfig, Strategy]:
+    """Apply named optimizations in order; the result strategy records
+    them in ``opts`` (deduplicated, order-preserving)."""
+    seen = []
+    for n in names:
+        if n in seen:
+            continue
+        cfg, strategy = get_optimization(n).apply(cfg, strategy)
+        seen.append(n)
+    return cfg, dc_replace(strategy, opts=tuple(seen))
+
+
+# -- builtins ---------------------------------------------------------------
+register_optimization(
+    "remat",
+    lambda cfg, s: (cfg, dc_replace(s, remat=True)),
+    tunable=True,
+)
+register_optimization(
+    "bf16", lambda cfg, s: (cfg, dc_replace(s, dtype="bfloat16"))
+)
+register_optimization(
+    "fp32", lambda cfg, s: (cfg, dc_replace(s, dtype="float32"))
+)
+register_optimization(
+    "int8_mlp", lambda cfg, s: (dc_replace(cfg, int8_mlp=True), s)
+)
+register_optimization(
+    "1f1b", lambda cfg, s: (cfg, dc_replace(s, pp_schedule="1f1b"))
+)

@@ -23,8 +23,12 @@ class Strategy:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     remat: bool = False
     dtype: str = "bfloat16"
-    # >1 runs the GPipe schedule over the mesh's pp axis
+    # >1 runs a pipeline schedule over the mesh's pp axis
     num_microbatches: int = 1
+    pp_schedule: str = "gpipe"  # or "1f1b" (parallel/pipeline.py)
+    # named optimization-library entries applied to this strategy
+    # (accel/opt_lib.py re-derives the config from these on every host)
+    opts: Tuple[str, ...] = ()
 
     def describe(self) -> str:
         axes = {
@@ -33,14 +37,23 @@ class Strategy:
         bits = ["x".join(f"{a}{s}" for a, s in axes.items())]
         if self.num_microbatches > 1:
             bits.append(f"mb{self.num_microbatches}")
-        if self.remat:
+        sched = "1f1b" if "1f1b" in self.opts else self.pp_schedule
+        if self.mesh.pp > 1 and sched != "gpipe":
+            bits.append(sched)
+        if self.remat or "remat" in self.opts:
             bits.append("remat")
         bits.append(self.dtype)
+        bits.extend(
+            o
+            for o in self.opts
+            if o not in ("remat", "bf16", "fp32", "1f1b")
+        )
         return "/".join(bits)
 
     def to_json(self) -> str:
         d = asdict(self)
         d["mesh"]["dcn_axes"] = list(self.mesh.dcn_axes)
+        d["opts"] = list(self.opts)
         return json.dumps(d, sort_keys=True)
 
     @staticmethod
@@ -48,6 +61,7 @@ class Strategy:
         d = json.loads(s)
         mesh_d = d.pop("mesh")
         mesh_d["dcn_axes"] = tuple(mesh_d.get("dcn_axes", ()))
+        d["opts"] = tuple(d.get("opts", ()))
         return Strategy(mesh=MeshConfig(**mesh_d), **d)
 
     def with_remat(self, remat: bool = True) -> "Strategy":

@@ -180,3 +180,113 @@ def test_auto_accelerate_bayes_search():
         bx, by = b["x"], b["y"]
     state, metrics = result.step_fn(state, bx, by)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_opt_lib_registry_and_apply():
+    from dlrover_tpu.accel import apply_optimizations, registered_optimizations
+    from dlrover_tpu.accel.opt_lib import register_optimization
+
+    assert {"remat", "bf16", "fp32", "int8_mlp", "1f1b"} <= set(
+        registered_optimizations()
+    )
+    cfg = tiny()
+    s = Strategy(mesh=MeshConfig(dp=8))
+    cfg2, s2 = apply_optimizations(cfg, s, ["remat", "int8_mlp", "remat"])
+    assert s2.remat and cfg2.int8_mlp
+    assert s2.opts == ("remat", "int8_mlp")  # deduplicated, ordered
+
+    register_optimization(
+        "test_double_mb",
+        lambda c, st: (c, st.__class__(**{
+            **st.__dict__, "num_microbatches": st.num_microbatches * 2,
+        })),
+    )
+    _, s3 = apply_optimizations(cfg, s, ["test_double_mb"])
+    assert s3.num_microbatches == 2
+
+    with pytest.raises(KeyError):
+        apply_optimizations(cfg, s, ["not_registered"])
+
+
+def test_strategy_json_carries_opts():
+    """agree_strategy ships strategies as JSON — named opts must round-
+    trip so the receiving host rebuilds the identical program."""
+    s = Strategy(
+        mesh=MeshConfig(pp=2, dp=4),
+        num_microbatches=4,
+        pp_schedule="1f1b",
+        opts=("remat", "int8_mlp"),
+    )
+    rt = Strategy.from_json(s.to_json())
+    assert rt == s
+    assert "1f1b" in rt.describe() and "int8_mlp" in rt.describe()
+
+
+def test_build_rederives_cfg_from_opts():
+    """_build must re-apply cfg-level opts recorded on the strategy (the
+    other-host path: the strategy arrives as JSON, not the config)."""
+    from dlrover_tpu.accel.dry_runner import _build
+
+    cfg = tiny(num_layers=2)
+    assert not cfg.int8_mlp
+    s = Strategy(mesh=MeshConfig(dp=8), dtype="float32", opts=("int8_mlp",))
+    cfg2, mesh, step_fn, init_fn, make_batch, _ = _build(
+        s, cfg, optax.adamw(1e-3), jax.devices()
+    )
+    assert cfg2.int8_mlp
+    state = init_fn(jax.random.PRNGKey(0))
+    x, y = make_batch(8, 16)
+    state, metrics = step_fn(state, x, y)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_pinned_1f1b_strategy_through_driver():
+    cfg = tiny(num_layers=2)
+    tx = optax.adamw(1e-3)
+    s = Strategy(
+        mesh=MeshConfig(pp=2, dp=4),
+        dtype="float32",
+        num_microbatches=4,
+        pp_schedule="1f1b",
+    )
+    result = auto_accelerate(
+        cfg, tx, batch=8, seq=16, devices=jax.devices(), strategy=s
+    )
+    state = result.init_fn(jax.random.PRNGKey(0))
+    x = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (8, 16)
+    ).astype(np.int32)
+    state, metrics = result.step_fn(state, x, x)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_optimizations_applied_exactly_once():
+    """Non-idempotent registered opts must not compound across the
+    candidate/search/build stages (names are recorded; _build applies)."""
+    from dataclasses import replace as dc_replace
+
+    from dlrover_tpu.accel.opt_lib import register_optimization
+
+    register_optimization(
+        "test_add_layers",
+        lambda c, s: (dc_replace(c, num_layers=c.num_layers + 2), s),
+    )
+    cfg = tiny(num_layers=2)
+    result = auto_accelerate(
+        cfg, optax.adamw(1e-3), batch=8, seq=16, devices=jax.devices(),
+        max_candidates=2, max_timed=1,
+        optimizations=("test_add_layers",),
+    )
+    assert result.cfg.num_layers == 4  # once, not 6 or 8
+    assert result.strategy.opts == ("test_add_layers",)
+
+
+def test_pinned_strategy_honors_optimizations():
+    cfg = tiny(num_layers=2)
+    result = auto_accelerate(
+        cfg, optax.adamw(1e-3), batch=8, seq=16, devices=jax.devices(),
+        strategy=Strategy(mesh=MeshConfig(dp=8), dtype="float32"),
+        optimizations=("int8_mlp",),
+    )
+    assert result.cfg.int8_mlp
+    assert "int8_mlp" in result.strategy.opts

@@ -41,6 +41,34 @@ from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.utils.env import ensure_framework_on_pythonpath
 
 
+# bound at import time: a preexec hook runs between fork and exec in a
+# multithreaded parent, where an import/dlopen can deadlock on a lock
+# whose owner doesn't exist in the child (subprocess docs warn exactly
+# this for preexec_fn)
+try:
+    import ctypes
+
+    _libc_prctl = ctypes.CDLL("libc.so.6", use_errno=True).prctl
+except Exception:  # non-Linux
+    _libc_prctl = None
+_PR_SET_PDEATHSIG = 1
+
+
+def _die_with_parent():
+    """preexec hook: SIGKILL this worker if its agent dies.
+
+    A SIGKILL'd agent (chaos, OOM-killer) cannot reap its training
+    procs; orphaned workers then fight the relaunched node's workers
+    for the job's shm segments and checkpoint locks and hang the job
+    (found by the chaos soak). On k8s the pod cgroup provides this
+    guarantee; the local/process platform needs PR_SET_PDEATHSIG.
+    Linux-only; a no-op elsewhere. Only calls the pre-bound symbol —
+    nothing here may allocate, import, or lock.
+    """
+    if _libc_prctl is not None:
+        _libc_prctl(_PR_SET_PDEATHSIG, signal.SIGKILL)
+
+
 class WorkerState(str, Enum):
     INIT = "INIT"
     HEALTHY = "HEALTHY"
@@ -199,6 +227,7 @@ class ElasticTrainingAgent:
                 env=self._worker_env(local_rank, world),
                 stdout=stdout,
                 stderr=stderr,
+                preexec_fn=_die_with_parent,
             )
             self._workers.append(proc)
         logger.info(
@@ -326,10 +355,13 @@ class ElasticTrainingAgent:
         (training.py:614-623): persist any in-memory checkpoint first."""
         if self._ckpt_hook is not None:
             try:
+                logger.info(f"node {self._node_rank}: save-at-breakpoint")
                 self._ckpt_hook()
             except Exception as e:
                 logger.warning(f"save-at-breakpoint failed: {e!r}")
+        logger.info(f"node {self._node_rank}: stopping workers for restart")
         self._stop_workers()
+        logger.info(f"node {self._node_rank}: workers stopped")
         # a worker killed mid-staging leaves its shm shard lock held;
         # release orphaned locks before the new generation starts saving
         # (parity: reset_shared_memory ckpt_saver.py:527)

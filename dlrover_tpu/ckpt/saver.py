@@ -453,9 +453,20 @@ class AsyncCheckpointSaver:
     # ------------------------------------------------------------------
     # breakpoint / SIGTERM persistence
     # ------------------------------------------------------------------
-    def save_shm_to_storage(self, commit_timeout: float = 600.0):
+    def save_shm_to_storage(
+        self, commit_timeout: float = 600.0, sync_commit: bool = True
+    ):
         """Persist in-memory checkpoints newer than the last persisted step
-        (the workers may be dead already — shm outlives them)."""
+        (the workers may be dead already — shm outlives them).
+
+        ``sync_commit``: wait for the global commit before returning. Only
+        correct when THIS PROCESS is about to die (SIGTERM, close) — the
+        commit needs done files from every node, and after a hard node
+        death those never come, so a synchronous wait burns the whole
+        timeout. Membership-change restarts keep the agent alive: pass
+        False there and the commit completes (or times out) on its own
+        thread while the node re-rendezvouses (found by the chaos soak:
+        survivors stalled 600s on every peer death)."""
         steps: Dict[int, _StepState] = {}
         for r, handler in enumerate(self._shm_handlers):
             if handler.no_checkpoint():
@@ -474,7 +485,9 @@ class AsyncCheckpointSaver:
         for step, st in sorted(steps.items()):
             logger.info(f"save-at-breakpoint: persisting shm step {step}")
             self._persist_step(
-                step, st, sync_commit=True, commit_timeout=commit_timeout
+                step, st,
+                sync_commit=sync_commit,
+                commit_timeout=commit_timeout,
             )
 
     @classmethod

@@ -32,11 +32,13 @@ SOCKET_DIR_ENV = "DLROVER_TPU_SOCKET_DIR"
 
 def _socket_dir() -> str:
     # namespaced per job so two launchers on one host cannot clobber each
-    # other's endpoints (the shm segments are namespaced the same way)
+    # other's endpoints (the shm segments are namespaced the same way).
+    # The env var overrides the BASE dir only — the job namespace always
+    # applies (an as-is override once let a multi-node local cluster's
+    # agents share un-namespaced endpoints and deadlock; chaos soak)
     job = os.getenv("DLROVER_TPU_JOB_NAME", "job")
-    d = os.getenv(
-        SOCKET_DIR_ENV, os.path.join("/tmp/dlrover_tpu", job, "sockets")
-    )
+    base = os.getenv(SOCKET_DIR_ENV, "/tmp/dlrover_tpu")
+    d = os.path.join(base, job, "sockets")
     os.makedirs(d, exist_ok=True)
     return d
 

@@ -33,6 +33,7 @@ class LocalCluster:
         device_spec: str = "cpu:1",
         extra_args: Optional[List[str]] = None,
         env: Optional[Dict[str, str]] = None,
+        job_name: str = "",
     ):
         self.num_nodes = num_nodes
         self._script = training_script
@@ -41,6 +42,11 @@ class LocalCluster:
         self._device_spec = device_spec
         self._extra = extra_args or []
         self._env = env or {}
+        # every simulated "node" is an agent on THIS host — without a
+        # per-node namespace they share shm segment names and saver
+        # socket paths (one-agent-per-host is the production invariant)
+        # and workers attach to the wrong node's saver and hang
+        self._job_name = job_name or f"cluster{os.getpid()}"
         self.master: Optional[LocalJobMaster] = None
         self.procs: Dict[int, subprocess.Popen] = {}
 
@@ -61,6 +67,7 @@ class LocalCluster:
             f"--nproc-per-node={self._nproc}",
             f"--master-addr={self.master.addr}",
             f"--device-spec={self._device_spec}",
+            f"--job-name={self._job_name}-n{rank}",
             "--monitor-interval=0.3",
             *self._extra,
             self._script,

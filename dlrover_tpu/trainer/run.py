@@ -27,6 +27,7 @@ from dlrover_tpu.agent.training_agent import (
     ElasticTrainingAgent,
     WorkerSpec,
     WorkerState,
+    _die_with_parent,
 )
 from dlrover_tpu.ckpt.saver import AsyncCheckpointSaver
 from dlrover_tpu.common import comm
@@ -110,6 +111,9 @@ def launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
         stderr=None,
         text=True,
         env=child_env(),
+        # a SIGKILL'd launcher must not orphan the job master it spawned
+        # (see agent/training_agent._die_with_parent)
+        preexec_fn=_die_with_parent,
     )
     # Read the address line on a thread so a wedged master (alive but never
     # printing its address) cannot block the launcher past the deadline; the
@@ -224,7 +228,12 @@ def run(args) -> int:
         agent = ElasticTrainingAgent(
             node_rank=args.node_rank, spec=spec, client=client
         )
-        agent.set_checkpoint_hook(saver.save_shm_to_storage)
+        # restart-path persist: the agent survives, so the global commit
+        # runs on its own thread — a dead peer's missing done files must
+        # not stall re-rendezvous (sync commit is for SIGTERM/close only)
+        agent.set_checkpoint_hook(
+            lambda: saver.save_shm_to_storage(sync_commit=False)
+        )
         result = agent.run()
         logger.info(
             f"agent finished: {result.state} after "

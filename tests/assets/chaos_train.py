@@ -1,0 +1,60 @@
+"""Chaos-soak worker: flash-checkpointed training that survives random
+SIGKILLs of whole nodes (used by the chaos soak / LocalCluster).
+
+Every step flash-saves to memory; every 5th step persists. A relaunched
+or membership-restarted worker resumes from the newest checkpoint it can
+see and keeps going until CHAOS_STEPS. Exits 0 once the target step is
+reached.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+from dlrover_tpu.ckpt import FlashCheckpointer
+from dlrover_tpu.ckpt.checkpointer import StorageType
+from dlrover_tpu.trainer.elastic.distributed import init_elastic
+
+
+def main() -> int:
+    ctx = init_elastic()
+    import jax.numpy as jnp
+
+    total = int(os.getenv("CHAOS_STEPS", "60"))
+    step_secs = float(os.getenv("CHAOS_STEP_SECS", "0.2"))
+    # ONE shared dir for the whole job: the commit protocol counts done
+    # files from every node's saver in the same tree
+    ckpt_dir = os.getenv("CHAOS_CKPT_DIR", "/tmp/dlrover_tpu/chaos_ckpt")
+
+    ckptr = FlashCheckpointer(ckpt_dir)
+    state = {"w": jnp.zeros((8,)), "step": 0}
+    start, restored = ckptr.load_checkpoint(state)
+    if restored is not None:
+        state = restored
+        print(f"node {ctx.node_rank}: resumed from step {start}", flush=True)
+
+    for step in range(int(state["step"]) + 1, total + 1):
+        state = {"w": state["w"] + 1.0, "step": step}
+        time.sleep(step_secs)
+        st = (
+            StorageType.DISK if step % 5 == 0 else StorageType.MEMORY
+        )
+        saved = ckptr.save_checkpoint(step, state, storage_type=st)
+        if step % 10 == 0:
+            print(
+                f"node {ctx.node_rank}: step {step} saved={saved}",
+                flush=True,
+            )
+
+    w = float(np.asarray(state["w"])[0])
+    if w != float(total):
+        print(f"FAIL: w={w} want {total}", flush=True)
+        return 1
+    print(f"node {ctx.node_rank}: chaos_train done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

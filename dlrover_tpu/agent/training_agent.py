@@ -54,7 +54,7 @@ except Exception:  # non-Linux
 _PR_SET_PDEATHSIG = 1
 
 
-def _die_with_parent():
+def _die_with_parent(expected_ppid: int = 0):
     """preexec hook: SIGKILL this worker if its agent dies.
 
     A SIGKILL'd agent (chaos, OOM-killer) cannot reap its training
@@ -62,11 +62,25 @@ def _die_with_parent():
     for the job's shm segments and checkpoint locks and hang the job
     (found by the chaos soak). On k8s the pod cgroup provides this
     guarantee; the local/process platform needs PR_SET_PDEATHSIG.
-    Linux-only; a no-op elsewhere. Only calls the pre-bound symbol —
-    nothing here may allocate, import, or lock.
+    Linux-only; a no-op elsewhere. Only calls pre-bound symbols and
+    syscalls — nothing here may allocate, import, or lock.
+
+    Classic pdeathsig race: the parent can die between fork and prctl,
+    in which case the signal never fires — so after arming it, verify
+    the parent is still the process that forked us (callers bind their
+    own pid into the hook before spawning) and exit if it changed.
     """
     if _libc_prctl is not None:
         _libc_prctl(_PR_SET_PDEATHSIG, signal.SIGKILL)
+        if expected_ppid and os.getppid() != expected_ppid:
+            os._exit(1)
+
+
+def die_with_parent_hook():
+    """Build a preexec_fn with the spawning process's pid bound in."""
+    import functools
+
+    return functools.partial(_die_with_parent, os.getpid())
 
 
 class WorkerState(str, Enum):
@@ -227,7 +241,7 @@ class ElasticTrainingAgent:
                 env=self._worker_env(local_rank, world),
                 stdout=stdout,
                 stderr=stderr,
-                preexec_fn=_die_with_parent,
+                preexec_fn=die_with_parent_hook(),
             )
             self._workers.append(proc)
         logger.info(

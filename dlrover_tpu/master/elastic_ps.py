@@ -42,3 +42,22 @@ class ElasticPsService:
         with self._lock:
             self._global_version += 1
             return self._global_version
+
+    # -- failover snapshot (master/state.py) ---------------------------
+    def export_state(self) -> dict:
+        with self._lock:
+            return {
+                "global": self._global_version,
+                "nodes": [
+                    [t, i, vt, v]
+                    for (t, i, vt), v in self._node_versions.items()
+                ],
+            }
+
+    def import_state(self, state: dict):
+        if not state:
+            return
+        with self._lock:
+            self._global_version = int(state.get("global", 0))
+            for t, i, vt, v in state.get("nodes", []):
+                self._node_versions[(t, int(i), vt)] = int(v)

@@ -51,6 +51,16 @@ class KVStoreService:
         with self._lock:
             self._store.pop(key, None)
 
+    # -- failover snapshot (master/state.py) ---------------------------
+    def export_store(self) -> Dict[str, bytes]:
+        with self._lock:
+            return dict(self._store)
+
+    def import_store(self, data: Dict[str, bytes]):
+        with self._cond:
+            self._store.update(data)
+            self._cond.notify_all()
+
     def clear(self):
         with self._lock:
             self._store.clear()

@@ -85,12 +85,28 @@ class LocalJobMaster:
         )
         self._server = None
         self._stopped = threading.Event()
+        # master failover: snapshot/restore through a state file when
+        # DLROVER_TPU_MASTER_STATE names one (the k8s operator relaunches
+        # the master pod; agents ride out the outage — master/state.py)
+        from dlrover_tpu.master.state import (
+            MasterStateSaver,
+            state_path_from_env,
+        )
+
+        self._state_saver = None
+        state_path = state_path_from_env()
+        if state_path:
+            self._state_saver = MasterStateSaver(self, state_path)
 
     @property
     def addr(self) -> str:
         return f"127.0.0.1:{self.port}"
 
     def prepare(self):
+        if self._state_saver is not None:
+            if self._state_saver.restore_if_any():
+                logger.info("master restarted from persisted state")
+            self._state_saver.start()
         self._server = create_master_service(self.port, self.servicer)
         # Without a platform scaler the periodic pass would fabricate
         # replacement Node entries nothing ever launches — ghosts that
@@ -115,6 +131,12 @@ class LocalJobMaster:
         while not self._stopped.is_set():
             if self.task_manager.finished():
                 logger.info("all dataset tasks completed")
+                if self._state_saver is not None:
+                    # terminal success: drop the failover state so a
+                    # fresh run on this path doesn't resume a done job
+                    # (an externally-stopped master keeps its state —
+                    # that IS the failover case)
+                    self._state_saver.clear()
                 return JobExitReason.SUCCEEDED
             if self.job_manager.all_running_node_hanged() and not (
                 # data starvation is not a hang: consumers parked on a
@@ -156,6 +178,8 @@ class LocalJobMaster:
         self._stopped.set()
         self.auto_scaler.stop()
         self.metric_collector.stop()
+        if self._state_saver is not None:
+            self._state_saver.stop()  # final snapshot
         if self._server is not None:
             self._server.stop(grace=1)
             self._server = None

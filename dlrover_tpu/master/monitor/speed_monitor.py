@@ -41,6 +41,12 @@ class SpeedMonitor:
             self._global_step = step
             self._samples.append((timestamp, step))
 
+    def set_completed_step_baseline(self, step: int):
+        """Failover restore: a relaunched master must not read the next
+        step report as 'progress since 0' (hang/scaling baselines)."""
+        if step > self._global_step:
+            self._global_step = step
+
     def add_running_worker(self, node_id: int):
         self._running_workers.add(node_id)
 

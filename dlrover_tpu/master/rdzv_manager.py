@@ -90,6 +90,13 @@ class RendezvousManager:
     def rdzv_round(self) -> int:
         return self._rdzv_round
 
+    def restore_round(self, rdzv_round: int):
+        """Failover restore: a relaunched master must not replay round
+        numbers agents have already trained under."""
+        with self._lock:
+            if rdzv_round > self._rdzv_round:
+                self._rdzv_round = rdzv_round
+
     # -- joining -------------------------------------------------------
     def join_rendezvous(
         self,

@@ -209,6 +209,11 @@ class TaskManager:
         # producer reports that arrived before the consumer registered the
         # streaming dataset: (records, ended) buffered per name
         self._pending_stream: Dict[str, Tuple[int, bool]] = {}
+        # failover restore that arrived before workers re-reported the
+        # dataset definition: checkpoint buffered per name, applied by
+        # new_dataset (workers always re-report on restart, so progress
+        # maps onto the recreated dataset instead of being dropped)
+        self._pending_restore: Dict[str, Dict] = {}
         # per-dataset (first, last) WAIT timestamps of the CURRENT
         # continuous starvation period; cleared when a real shard ships
         self._wait_spans: Dict[str, Tuple[float, float]] = {}
@@ -235,6 +240,11 @@ class TaskManager:
             )
             ds = manager_cls(splitter, params.task_type or TaskType.TRAIN)
             self._datasets[params.dataset_name] = ds
+            pending_ckpt = self._pending_restore.pop(
+                params.dataset_name, None
+            )
+            if pending_ckpt is not None:
+                ds.restore_checkpoint(pending_ckpt)
             pending = self._pending_stream.pop(params.dataset_name, None)
             if isinstance(ds, StreamingDatasetManager):
                 records, ended = pending or (0, False)
@@ -373,3 +383,8 @@ class TaskManager:
                 ds = self._datasets.get(name)
                 if ds is not None:
                     ds.restore_checkpoint(ckpt)
+                else:
+                    # dataset not re-reported yet (master relaunch runs
+                    # restore before any worker reconnects) — apply when
+                    # new_dataset recreates it
+                    self._pending_restore[name] = ckpt

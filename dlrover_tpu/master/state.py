@@ -1,0 +1,164 @@
+"""Master state snapshot/restore — master failover.
+
+Parity: the reference's master is relaunched by the ElasticJob operator
+when its pod dies (go/operator pkg/controllers/master/master.go); the
+relaunched master must not lose data-shard progress (its TaskManager
+supports checkpoint/restore for exactly this) or hand out already-used
+rendezvous rounds. Agents ride out the outage: every master RPC path in
+the agent already tolerates ConnectionError with retry/backoff, so a
+master coming back on the same address (k8s service DNS, or a pinned
+port locally) resumes the job without restarting workers.
+
+What is snapshotted (JSON, atomic rename):
+- task manager: every dataset's shard progress (pending/dispatched/done)
+- kv store: the cross-host agreement surface (auto_accelerate strategy,
+  user barriers) — lost keys would re-run searches or wedge waiters
+- elastic PS cluster versions (sparse failover correctness)
+- rendezvous round counters (a reset would replay round numbers agents
+  have already seen)
+- speed monitor's completed step (hang detection baseline)
+
+What is deliberately NOT snapshotted: the node table and waiting lists —
+live agents re-populate them through heartbeats and (re)joins within one
+monitor interval, and stale entries would be worse than none.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+from typing import Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+STATE_ENV = "DLROVER_TPU_MASTER_STATE"
+
+
+def state_path_from_env() -> str:
+    return os.getenv(STATE_ENV, "")
+
+
+def snapshot_master(master) -> dict:
+    kv = master.kv_store.export_store()
+    ps = master.elastic_ps_service
+    return {
+        "task_manager": master.task_manager.checkpoint(),
+        "kv_store": {
+            k: base64.b64encode(v).decode() for k, v in kv.items()
+        },
+        "elastic_ps": ps.export_state(),
+        "rdzv_rounds": {
+            name: m.rdzv_round for name, m in master.rdzv_managers.items()
+        },
+        "completed_global_step": (
+            master.speed_monitor.completed_global_step
+        ),
+    }
+
+
+def restore_master(master, state: dict) -> None:
+    master.task_manager.restore_checkpoint(state.get("task_manager", ""))
+    master.kv_store.import_store(
+        {
+            k: base64.b64decode(v)
+            for k, v in state.get("kv_store", {}).items()
+        }
+    )
+    master.elastic_ps_service.import_state(state.get("elastic_ps", {}))
+    for name, rnd in state.get("rdzv_rounds", {}).items():
+        m = master.rdzv_managers.get(name)
+        if m is not None:
+            m.restore_round(int(rnd))
+    step = int(state.get("completed_global_step", 0))
+    if step:
+        master.speed_monitor.set_completed_step_baseline(step)
+    logger.info(
+        f"master state restored: step={step}, "
+        f"rdzv_rounds={state.get('rdzv_rounds')}"
+    )
+
+
+class MasterStateBackend:
+    """File-backed snapshot store with atomic replace + autosave loop."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def save(self, state: dict) -> None:
+        tmp = f"{self.path}.tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.path)
+
+    def load(self) -> Optional[dict]:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as e:
+            logger.warning(f"master state at {self.path} unreadable: {e!r}")
+            return None
+
+
+class MasterStateSaver:
+    """Autosave daemon: snapshot every ``interval`` seconds + on stop."""
+
+    def __init__(self, master, path: str, interval: float = 5.0):
+        self._master = master
+        self._backend = MasterStateBackend(path)
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def restore_if_any(self) -> bool:
+        state = self._backend.load()
+        if state is None:
+            return False
+        restore_master(self._master, state)
+        return True
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="master-state-saver", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            self._save()
+
+    def _save(self):
+        try:
+            self._backend.save(snapshot_master(self._master))
+        except Exception as e:
+            logger.warning(f"master state save failed: {e!r}")
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        # final snapshot on a helper thread with a bounded join: stop()
+        # can run inside a SIGTERM handler that interrupted the main
+        # thread MID-snapshot-lock (task_manager._lock is not reentrant)
+        # — a direct call would self-deadlock; a missed final save loses
+        # at most one autosave interval
+        t = threading.Thread(
+            target=self._save, name="master-state-final", daemon=True
+        )
+        t.start()
+        t.join(timeout=5)
+
+    def clear(self):
+        """Terminal success: a finished job's state must not leak into a
+        fresh run using the same state path (it would restore
+        'all shards done' and train on zero data)."""
+        self._stop.set()
+        try:
+            os.remove(self._backend.path)
+        except OSError:
+            pass

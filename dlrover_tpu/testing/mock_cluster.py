@@ -75,6 +75,16 @@ class LocalCluster:
         ]
 
     def start_node(self, rank: int):
+        old = self.procs.get(rank)
+        if old is not None and old.poll() is None:
+            # reap a killed predecessor before replacing its handle —
+            # overwriting an un-waited Popen leaks a zombie and loses
+            # its exit status
+            try:
+                old.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                old.kill()
+                old.wait()
         env = child_env()
         env.update(self._env)
         proc = subprocess.Popen(self.node_cmd(rank), env=env)

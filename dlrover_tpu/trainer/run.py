@@ -27,7 +27,7 @@ from dlrover_tpu.agent.training_agent import (
     ElasticTrainingAgent,
     WorkerSpec,
     WorkerState,
-    _die_with_parent,
+    die_with_parent_hook,
 )
 from dlrover_tpu.ckpt.saver import AsyncCheckpointSaver
 from dlrover_tpu.common import comm
@@ -113,7 +113,7 @@ def launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
         env=child_env(),
         # a SIGKILL'd launcher must not orphan the job master it spawned
         # (see agent/training_agent._die_with_parent)
-        preexec_fn=_die_with_parent,
+        preexec_fn=die_with_parent_hook(),
     )
     # Read the address line on a thread so a wedged master (alive but never
     # printing its address) cannot block the launcher past the deadline; the

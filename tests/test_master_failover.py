@@ -1,0 +1,106 @@
+"""Master failover: a relaunched master restores shard progress, KV
+state, PS versions and rendezvous rounds from its state file, and a live
+client rides out the outage.
+
+Parity: the reference's master pod is relaunched by the ElasticJob
+operator (go/operator pkg/controllers/master/master.go); its TaskManager
+ships checkpoint/restore for shard progress. Here the whole failover
+surface is tested end-to-end over real gRPC.
+"""
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import comm
+from dlrover_tpu.master.local_master import LocalJobMaster
+
+
+def _start(port=0, node_num=2):
+    m = LocalJobMaster(port=port, node_num=node_num)
+    m.prepare()
+    return m
+
+
+@pytest.fixture()
+def state_env(tmp_path, monkeypatch):
+    path = str(tmp_path / "master_state.json")
+    monkeypatch.setenv("DLROVER_TPU_MASTER_STATE", path)
+    return path
+
+
+def test_state_roundtrip_across_masters(state_env):
+    m1 = _start()
+    c = MasterClient(m1.addr, node_id=0)
+    try:
+        # shard progress: dispatch 2 of 4 shards, finish 1
+        c.report_dataset_shard_params(
+            comm.DatasetShardParams(
+                batch_size=4,
+                num_minibatches_per_shard=2,
+                dataset_size=32,
+                num_epochs=1,
+                dataset_name="ds",
+            )
+        )
+        t0 = c.get_task("ds")
+        t1 = c.get_task("ds")
+        c.report_task_result("ds", t0.task_id)
+        # agreement surface + PS version + rdzv round
+        c.kv_store_set("strategy", b"dp8")
+        m1.elastic_ps_service.update_version("global", "ps", 0, 7)
+        m1.rdzv_managers["elastic-training"]._rdzv_round = 5
+    finally:
+        c.close()
+        m1.stop()  # final snapshot
+
+    m2 = _start(port=0)
+    try:
+        c2 = MasterClient(m2.addr, node_id=0)
+        # kv + versions + round survived
+        assert c2.kv_store_get("strategy") == b"dp8"
+        assert m2.elastic_ps_service.get_version("global", "ps", 0) == 7
+        assert m2.rdzv_managers["elastic-training"].rdzv_round >= 5
+        # the dataset definition itself is re-reported by workers on
+        # restart (same as first startup); restore then maps progress
+        # onto it: the finished shard must NOT come back, the dispatched-
+        # but-unfinished one must
+        c2.report_dataset_shard_params(
+            comm.DatasetShardParams(
+                batch_size=4,
+                num_minibatches_per_shard=2,
+                dataset_size=32,
+                num_epochs=1,
+                dataset_name="ds",
+            )
+        )
+        remaining = []
+        while True:
+            t = c2.get_task("ds")
+            if t.is_empty:
+                break
+            remaining.append((t.shard.start, t.shard.end))
+            c2.report_task_result("ds", t.task_id)
+        # 4 shards total, 1 completed before failover -> 3 remain
+        assert len(remaining) == 3, remaining
+        del t1
+        c2.close()
+    finally:
+        m2.stop()
+
+
+def test_client_rides_out_master_restart(state_env):
+    m1 = _start()
+    port = m1.port
+    c = MasterClient(m1.addr, node_id=0)
+    c.kv_store_set("k", b"v")
+    m1.stop()
+
+    # outage: the client's next call retries with backoff; bring a new
+    # master up on the SAME address (k8s: stable service DNS) with the
+    # persisted state
+    m2 = _start(port=port)
+    try:
+        assert c.kv_store_get("k") == b"v", "client must survive failover"
+    finally:
+        c.close()
+        m2.stop()

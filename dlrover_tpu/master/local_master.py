@@ -174,12 +174,15 @@ class LocalJobMaster:
         """Explicit resize API (operator / Brain seam)."""
         return self.auto_scaler.scale_to(count)
 
-    def stop(self):
+    def stop(self, final_snapshot: bool = True):
+        """``final_snapshot=False`` simulates a crash for failover tests:
+        the successor restores the last AUTOSAVE (up to one interval
+        stale), the case a real master death produces."""
         self._stopped.set()
         self.auto_scaler.stop()
         self.metric_collector.stop()
         if self._state_saver is not None:
-            self._state_saver.stop()  # final snapshot
+            self._state_saver.stop(final_snapshot=final_snapshot)
         if self._server is not None:
             self._server.stop(grace=1)
             self._server = None

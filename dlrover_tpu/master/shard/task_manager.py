@@ -209,11 +209,10 @@ class TaskManager:
         # producer reports that arrived before the consumer registered the
         # streaming dataset: (records, ended) buffered per name
         self._pending_stream: Dict[str, Tuple[int, bool]] = {}
-        # failover restore that arrived before workers re-reported the
-        # dataset definition: checkpoint buffered per name, applied by
-        # new_dataset (workers always re-report on restart, so progress
-        # maps onto the recreated dataset instead of being dropped)
-        self._pending_restore: Dict[str, Dict] = {}
+        # dataset definitions, kept so a failover snapshot can recreate
+        # the datasets themselves — surviving workers never re-report
+        # params (only worker restarts do), so restore cannot wait on one
+        self._dataset_params: Dict[str, DatasetShardParams] = {}
         # per-dataset (first, last) WAIT timestamps of the CURRENT
         # continuous starvation period; cleared when a real shard ships
         self._wait_spans: Dict[str, Tuple[float, float]] = {}
@@ -240,11 +239,7 @@ class TaskManager:
             )
             ds = manager_cls(splitter, params.task_type or TaskType.TRAIN)
             self._datasets[params.dataset_name] = ds
-            pending_ckpt = self._pending_restore.pop(
-                params.dataset_name, None
-            )
-            if pending_ckpt is not None:
-                ds.restore_checkpoint(pending_ckpt)
+            self._dataset_params[params.dataset_name] = params
             pending = self._pending_stream.pop(params.dataset_name, None)
             if isinstance(ds, StreamingDatasetManager):
                 records, ended = pending or (0, False)
@@ -366,11 +361,21 @@ class TaskManager:
 
     # -- shard checkpoint ---------------------------------------------
     def checkpoint(self) -> str:
+        """Definitions AND progress: a failover restore must recreate
+        the datasets itself — surviving (non-restarted) workers only
+        call get_task, never re-report params, so a restore that waits
+        for a re-report would answer them 'dataset exhausted'."""
+        from dataclasses import asdict
+
         with self._lock:
             return json.dumps(
                 {
-                    name: ds.checkpoint()
+                    name: {
+                        "params": asdict(self._dataset_params[name]),
+                        "state": ds.checkpoint(),
+                    }
                     for name, ds in self._datasets.items()
+                    if name in self._dataset_params
                 }
             )
 
@@ -378,13 +383,10 @@ class TaskManager:
         if not content:
             return
         data = json.loads(content)
-        with self._lock:
-            for name, ckpt in data.items():
-                ds = self._datasets.get(name)
-                if ds is not None:
-                    ds.restore_checkpoint(ckpt)
-                else:
-                    # dataset not re-reported yet (master relaunch runs
-                    # restore before any worker reconnects) — apply when
-                    # new_dataset recreates it
-                    self._pending_restore[name] = ckpt
+        for name, entry in data.items():
+            # recreate the dataset from its snapshotted definition
+            # (idempotent when workers already re-reported it), then
+            # overlay the shard progress
+            self.new_dataset(DatasetShardParams(**entry["params"]))
+            with self._lock:
+                self._datasets[name].restore_checkpoint(entry["state"])

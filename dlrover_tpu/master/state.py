@@ -87,7 +87,10 @@ class MasterStateBackend:
         self.path = path
 
     def save(self, state: dict) -> None:
-        tmp = f"{self.path}.tmp"
+        # pid+thread-unique tmp (repo convention, cf. agent/monitor.py):
+        # an old master's lagging saver thread and its successor's can
+        # coexist in one process on the same path
+        tmp = f"{self.path}.tmp.{os.getpid()}.{threading.get_ident()}"
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         with open(tmp, "w") as f:
             json.dump(state, f)
@@ -112,13 +115,23 @@ class MasterStateSaver:
         self._backend = MasterStateBackend(path)
         self._interval = interval
         self._stop = threading.Event()
+        self._cleared = False
         self._thread: Optional[threading.Thread] = None
 
     def restore_if_any(self) -> bool:
         state = self._backend.load()
         if state is None:
             return False
-        restore_master(self._master, state)
+        try:
+            restore_master(self._master, state)
+        except Exception as e:
+            # a corrupt/version-skewed snapshot must degrade to a cold
+            # start, not crash-loop the relaunched master (the operator
+            # would re-read the same bad file forever)
+            logger.error(
+                f"master state restore failed; starting cold: {e!r}"
+            )
+            return False
         return True
 
     def start(self):
@@ -132,16 +145,23 @@ class MasterStateSaver:
             self._save()
 
     def _save(self):
+        if self._cleared:
+            return  # never resurrect a deliberately deleted state file
         try:
             self._backend.save(snapshot_master(self._master))
         except Exception as e:
             logger.warning(f"master state save failed: {e!r}")
 
-    def stop(self):
+    def stop(self, final_snapshot: bool = True):
+        """``final_snapshot=False`` abandons without writing — used to
+        SIMULATE a master crash in chaos tests (a real crash leaves the
+        last autosave, up to one interval stale)."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if not final_snapshot:
+            return
         # final snapshot on a helper thread with a bounded join: stop()
         # can run inside a SIGTERM handler that interrupted the main
         # thread MID-snapshot-lock (task_manager._lock is not reentrant)
@@ -157,7 +177,13 @@ class MasterStateSaver:
         """Terminal success: a finished job's state must not leak into a
         fresh run using the same state path (it would restore
         'all shards done' and train on zero data)."""
+        self._cleared = True
         self._stop.set()
+        if self._thread is not None:
+            # an in-flight autosave could otherwise publish after the
+            # remove below
+            self._thread.join(timeout=5)
+            self._thread = None
         try:
             os.remove(self._backend.path)
         except OSError:

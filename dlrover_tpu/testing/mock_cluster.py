@@ -99,6 +99,23 @@ class LocalCluster:
             logger.info(f"killing cluster node {rank} (pid {proc.pid})")
             proc.send_signal(sig)
 
+    def restart_master(self, graceful: bool = False):
+        """Master-failover chaos: drop the master and bring a new one up
+        on the SAME port (k8s: the operator relaunches the pod behind a
+        stable service address). With DLROVER_TPU_MASTER_STATE set in
+        this process, the successor restores the dropped master's state;
+        agents ride out the outage via their RPC retry paths.
+
+        Default simulates a CRASH (no final snapshot — the successor
+        restores the last autosave, up to one interval stale), the case
+        the failover feature exists for; ``graceful=True`` models a
+        planned handover."""
+        port = self.master.port
+        logger.info(f"restarting cluster master on port {port}")
+        self.master.stop(final_snapshot=graceful)
+        self.master = LocalJobMaster(port=port, node_num=self.num_nodes)
+        self.master.prepare()
+
     # -- join -----------------------------------------------------------
     def wait(self, timeout: float = 120.0) -> Dict[int, int]:
         """Join every node; returns {rank: returncode}."""

@@ -104,3 +104,66 @@ def test_client_rides_out_master_restart(state_env):
     finally:
         c.close()
         m2.stop()
+
+
+@pytest.mark.slow
+def test_master_restart_under_load(state_env, tmp_path):
+    """Whole-stack failover: the master dies and is relaunched mid-job;
+    agents and workers keep going and the job completes."""
+    import os
+    import time
+
+    from dlrover_tpu.testing.mock_cluster import LocalCluster
+
+    assets = os.path.join(os.path.dirname(__file__), "assets")
+    with LocalCluster(
+        2,
+        os.path.join(assets, "chaos_train.py"),
+        extra_args=["--max-restarts=10", "--rdzv-waiting-timeout=2",
+                    f"--log-dir={tmp_path / 'logs'}"],
+        env={
+            "CHAOS_STEPS": "40",
+            "CHAOS_STEP_SECS": "0.2",
+            "CHAOS_CKPT_DIR": str(tmp_path / "ckpt"),
+        },
+    ) as c:
+        time.sleep(10.0)  # let the job reach steady state
+        c.restart_master()
+        rcs = c.wait(timeout=300)
+    assert all(rc == 0 for rc in rcs.values()), rcs
+
+
+def test_surviving_worker_keeps_sharding(state_env):
+    """A worker that was NEVER restarted (rode out the outage) must keep
+    receiving shards from the successor master — it will not re-report
+    the dataset definition, so the snapshot must carry it."""
+    m1 = _start()
+    port = m1.port
+    c = MasterClient(m1.addr, node_id=0)
+    c.report_dataset_shard_params(
+        comm.DatasetShardParams(
+            batch_size=4,
+            num_minibatches_per_shard=2,
+            dataset_size=32,
+            num_epochs=1,
+            dataset_name="ds",
+        )
+    )
+    t0 = c.get_task("ds")
+    c.report_task_result("ds", t0.task_id)
+    # crash-style failover: successor restores the last AUTOSAVE
+    m1._state_saver._save()
+    m1.stop(final_snapshot=False)
+    m2 = _start(port=port)
+    try:
+        got = []
+        while True:
+            t = c.get_task("ds")
+            if t.is_empty:
+                break
+            got.append(t.task_id)
+            c.report_task_result("ds", t.task_id)
+        assert len(got) == 3, got  # 4 shards - 1 finished pre-failover
+    finally:
+        c.close()
+        m2.stop()

@@ -62,8 +62,15 @@ def run_network_check(
     client: MasterClient,
     device_spec: str = "",
     rounds: int = 2,
+    exclude_straggler: bool = False,
 ) -> bool:
-    """Returns True if THIS node passes the check."""
+    """Returns True if THIS node passes the check.
+
+    ``exclude_straggler``: treat a straggler verdict like a fault (the
+    reference's ``--exclude-straggler``, elastic_run.py flag): a slow
+    host leaves the job instead of dragging every synchronous collective
+    down to its pace. Default keeps stragglers (warn only) — on TPU a
+    slice is usually all-or-nothing, so dropping hosts is opt-in."""
     check_script = os.path.join(
         os.path.dirname(__file__), "..", "trainer", "node_check", "tpu_check.py"
     )
@@ -94,9 +101,25 @@ def run_network_check(
             time.sleep(0.5)
     faults, _ = client.check_fault_node()
     stragglers, _ = client.check_straggler()
+    return check_verdict(node_rank, faults, stragglers, exclude_straggler)
+
+
+def check_verdict(
+    node_rank: int,
+    faults,
+    stragglers,
+    exclude_straggler: bool,
+) -> bool:
+    """Does THIS node stay in the job after the health check?"""
     if stragglers:
         logger.warning(f"straggler hosts detected: {stragglers}")
     if node_rank in faults:
         logger.error(f"node {node_rank} is faulty (faults={faults})")
+        return False
+    if exclude_straggler and node_rank in stragglers:
+        logger.error(
+            f"node {node_rank} is a straggler and --exclude-straggler "
+            f"is set; leaving the job"
+        )
         return False
     return True

@@ -184,7 +184,9 @@ class LocalJobMaster:
         if self._state_saver is not None:
             self._state_saver.stop(final_snapshot=final_snapshot)
         if self._server is not None:
-            self._server.stop(grace=1)
+            # wait for termination: a failover successor may bind this
+            # port immediately after stop() returns
+            self._server.stop(grace=1).wait(timeout=5)
             self._server = None
 
 

@@ -375,7 +375,6 @@ class TaskManager:
                         "state": ds.checkpoint(),
                     }
                     for name, ds in self._datasets.items()
-                    if name in self._dataset_params
                 }
             )
 
@@ -384,9 +383,36 @@ class TaskManager:
             return
         data = json.loads(content)
         for name, entry in data.items():
-            # recreate the dataset from its snapshotted definition
-            # (idempotent when workers already re-reported it), then
-            # overlay the shard progress
+            if not (isinstance(entry, dict) and "params" in entry):
+                # legacy format ({name: progress}) from worker-saved
+                # shard checkpoints of an older build: applies when the
+                # dataset exists (the pre-failover contract), never
+                # fails the whole restore
+                with self._lock:
+                    ds = self._datasets.get(name)
+                if ds is not None:
+                    with self._lock:
+                        ds.restore_checkpoint(entry)
+                else:
+                    logger.warning(
+                        f"legacy shard checkpoint for unknown dataset "
+                        f"{name!r} ignored"
+                    )
+                continue
+            # buffered producer reports are NEWER than the snapshot:
+            # pull them out before new_dataset would consume them,
+            # overlay the snapshot, then re-apply them on top
+            with self._lock:
+                pending = self._pending_stream.pop(name, None)
             self.new_dataset(DatasetShardParams(**entry["params"]))
             with self._lock:
-                self._datasets[name].restore_checkpoint(entry["state"])
+                ds = self._datasets[name]
+                ds.restore_checkpoint(entry["state"])
+                if pending is not None and isinstance(
+                    ds, StreamingDatasetManager
+                ):
+                    records, ended = pending
+                    if records:
+                        ds.add_records(records)
+                    if ended:
+                        ds.end_stream()

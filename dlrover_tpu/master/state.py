@@ -59,24 +59,36 @@ def snapshot_master(master) -> dict:
 
 
 def restore_master(master, state: dict) -> None:
-    master.task_manager.restore_checkpoint(state.get("task_manager", ""))
-    master.kv_store.import_store(
-        {
-            k: base64.b64decode(v)
-            for k, v in state.get("kv_store", {}).items()
-        }
-    )
-    master.elastic_ps_service.import_state(state.get("elastic_ps", {}))
-    for name, rnd in state.get("rdzv_rounds", {}).items():
+    """Two-phase apply so a bad snapshot cannot leave the master
+    half-restored (shard progress applied but rounds reset would replay
+    rendezvous round numbers agents have seen): phase 1 decodes and
+    validates everything without touching the master; phase 2 applies,
+    hazard-critical pieces (rounds, KV) first."""
+    # -- phase 1: decode (raises -> caller starts cold, nothing applied)
+    kv = {
+        k: base64.b64decode(v)
+        for k, v in state.get("kv_store", {}).items()
+    }
+    rounds = {
+        str(name): int(rnd)
+        for name, rnd in state.get("rdzv_rounds", {}).items()
+    }
+    ps_state = state.get("elastic_ps", {})
+    step = int(state.get("completed_global_step", 0))
+    tm_content = state.get("task_manager", "")
+
+    # -- phase 2: apply
+    for name, rnd in rounds.items():
         m = master.rdzv_managers.get(name)
         if m is not None:
-            m.restore_round(int(rnd))
-    step = int(state.get("completed_global_step", 0))
+            m.restore_round(rnd)
+    master.kv_store.import_store(kv)
+    master.elastic_ps_service.import_state(ps_state)
     if step:
         master.speed_monitor.set_completed_step_baseline(step)
+    master.task_manager.restore_checkpoint(tm_content)
     logger.info(
-        f"master state restored: step={step}, "
-        f"rdzv_rounds={state.get('rdzv_rounds')}"
+        f"master state restored: step={step}, rdzv_rounds={rounds}"
     )
 
 

@@ -72,6 +72,12 @@ def parse_args(argv=None):
         help="run the paired node health check before training",
     )
     p.add_argument(
+        "--exclude-straggler",
+        action="store_true",
+        help="a straggler verdict from the network check removes the "
+        "node from the job instead of only warning",
+    )
+    p.add_argument(
         "--device-spec",
         type=str,
         default="",
@@ -150,6 +156,7 @@ def _run_network_check(args, client: MasterClient) -> bool:
         nproc_per_node=args.nproc_per_node,
         client=client,
         device_spec=args.device_spec,
+        exclude_straggler=args.exclude_straggler,
     )
 
 

@@ -236,3 +236,15 @@ class TestLifecycle:
         assert client.barrier("b1") is False
         client.barrier("b1", notify=True)
         assert client.barrier("b1") is True
+
+
+def test_check_verdict_exclude_straggler():
+    from dlrover_tpu.agent.node_check_agent import check_verdict
+
+    # default: stragglers stay (warn only)
+    assert check_verdict(1, faults=[], stragglers=[1], exclude_straggler=False)
+    # opt-in exclusion removes the straggler, only the straggler
+    assert not check_verdict(1, faults=[], stragglers=[1], exclude_straggler=True)
+    assert check_verdict(0, faults=[], stragglers=[1], exclude_straggler=True)
+    # faults always lose, regardless of the flag
+    assert not check_verdict(2, faults=[2], stragglers=[], exclude_straggler=False)

@@ -167,3 +167,35 @@ def test_surviving_worker_keeps_sharding(state_env):
     finally:
         c.close()
         m2.stop()
+
+
+def test_restore_keeps_buffered_streaming_reports():
+    """Producer reports that arrived BEFORE the consumer's shard-
+    checkpoint restore are newer than the snapshot and must survive the
+    overlay (restore recreates the dataset, overlays the snapshot, then
+    re-applies the buffered records/end-of-stream on top)."""
+    from dlrover_tpu.master.shard.task_manager import TaskManager
+
+    # master A: streaming dataset with some progress
+    tm_a = TaskManager()
+    tm_a.new_dataset(
+        comm.DatasetShardParams(
+            batch_size=2,
+            num_minibatches_per_shard=1,
+            dataset_size=-1,
+            dataset_name="s",
+            storage_type="stream",
+        )
+    )
+    tm_a.report_streaming_data("s", new_records=4)
+    snapshot = tm_a.checkpoint()
+
+    # master B: the producer's newer report lands before the restore
+    tm_b = TaskManager()
+    tm_b.report_streaming_data("s", new_records=100, end=True)
+    tm_b.restore_checkpoint(snapshot)
+    ds = tm_b._datasets["s"]
+    assert ds._splitter._ended, "buffered end-of-stream lost in restore"
+    # watermark from snapshot plus the 100 buffered records on top
+    t = ds.get_task(node_id=0)
+    assert not t.is_empty

@@ -37,6 +37,7 @@ class Checkpointer:
         step: int,
         state: Any,
         storage_type: StorageType = StorageType.MEMORY,
+        timeout: float = 600.0,
     ) -> bool:
         raise NotImplementedError
 
@@ -58,10 +59,13 @@ class FlashCheckpointer(Checkpointer):
         step: int,
         state: Any,
         storage_type: StorageType = StorageType.MEMORY,
+        timeout: float = 600.0,
     ) -> bool:
+        """``timeout`` bounds how long a DISK save waits for the global
+        commit (all nodes' shards); returns False on expiry."""
         if storage_type == StorageType.DISK:
             return self.engine.save_to_storage(
-                step, state, self.checkpoint_dir
+                step, state, self.checkpoint_dir, timeout=timeout
             )
         return self.engine.save_to_memory(step, state, self.checkpoint_dir)
 

@@ -78,6 +78,8 @@ class OrbaxCheckpointer(Checkpointer):
         step: int,
         state: Any,
         storage_type: StorageType = StorageType.MEMORY,
+        timeout: float = 600.0,  # accepted for facade parity; orbax
+        # manages its own async-commit waits
     ) -> bool:
         import orbax.checkpoint as ocp
 

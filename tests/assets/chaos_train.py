@@ -1,10 +1,11 @@
 """Chaos-soak worker: flash-checkpointed training that survives random
 SIGKILLs of whole nodes (used by the chaos soak / LocalCluster).
 
-Every step flash-saves to memory; every 5th step persists. A relaunched
-or membership-restarted worker resumes from the newest checkpoint it can
-see and keeps going until CHAOS_STEPS. Exits 0 once the target step is
-reached.
+Every step flash-saves to memory; the AGENT persists shm to storage at
+breakpoints/SIGTERM (no blocking disk saves — see the inline comment).
+A relaunched or membership-restarted worker resumes from the newest
+checkpoint it can see and keeps going until CHAOS_STEPS. Exits 0 once
+the target step is reached.
 """
 
 import os
@@ -38,10 +39,15 @@ def main() -> int:
     for step in range(int(state["step"]) + 1, total + 1):
         state = {"w": state["w"] + 1.0, "step": step}
         time.sleep(step_secs)
-        st = (
-            StorageType.DISK if step % 5 == 0 else StorageType.MEMORY
+        # memory saves only: the agent persists at breakpoints and the
+        # engine falls back to storage on restore. A blocking DISK save
+        # would be wrong here — this toy trains per-node independently
+        # (no collectives), so after an asymmetric resume one node can
+        # wait on a global commit whose peer shard never comes; real
+        # SPMD jobs execute steps in lockstep and cannot diverge
+        saved = ckptr.save_checkpoint(
+            step, state, storage_type=StorageType.MEMORY
         )
-        saved = ckptr.save_checkpoint(step, state, storage_type=st)
         if step % 10 == 0:
             print(
                 f"node {ctx.node_rank}: step {step} saved={saved}",

@@ -44,3 +44,32 @@ def test_chaos_soak(tmp_path):
             c.start_node(victim)
         rcs = c.wait(timeout=480)
     assert all(rc == 0 for rc in rcs.values()), rcs
+
+
+@pytest.mark.slow
+def test_chaos_node_and_master(tmp_path, monkeypatch):
+    """Worst-case combination: a node is SIGKILL'd AND the master
+    crashes (stale-autosave restore) in the same job — the job must
+    still complete."""
+    monkeypatch.setenv(
+        "DLROVER_TPU_MASTER_STATE", str(tmp_path / "master_state.json")
+    )
+    with LocalCluster(
+        2,
+        os.path.join(ASSETS, "chaos_train.py"),
+        extra_args=["--max-restarts=10", "--rdzv-waiting-timeout=2",
+                    f"--log-dir={tmp_path / 'logs'}"],
+        env={
+            "CHAOS_STEPS": "40",
+            "CHAOS_STEP_SECS": "0.15",
+            "CHAOS_CKPT_DIR": str(tmp_path / "ckpt"),
+        },
+    ) as c:
+        time.sleep(6.0)
+        c.kill_node(1, sig=9)
+        time.sleep(1.5)
+        c.start_node(1)
+        time.sleep(4.0)
+        c.restart_master()  # crash-style: restores the last autosave
+        rcs = c.wait(timeout=420)
+    assert all(rc == 0 for rc in rcs.values()), rcs

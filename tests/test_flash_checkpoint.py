@@ -255,3 +255,42 @@ class TestAdviceFixes:
         step, restored = eng.load({"w": jnp.zeros(8)}, str(tmp_path))
         assert step == eng.latest_step(str(tmp_path))
         np.testing.assert_allclose(restored["w"], newer["w"])
+
+
+class TestDiskSaveTimeout:
+    def test_disk_save_commits_in_agent_mode(self, saver, tmp_path):
+        """Agent-mode DISK save waits for the global commit and returns
+        True once the tracker names the step."""
+        from dlrover_tpu.ckpt.checkpointer import (
+            FlashCheckpointer,
+            StorageType,
+        )
+
+        ckptr = FlashCheckpointer(str(tmp_path / "ck"))
+        state = {"w": np.arange(8.0)}
+        assert ckptr.save_checkpoint(
+            3, state, storage_type=StorageType.DISK, timeout=30.0
+        )
+        step, restored = ckptr.load_checkpoint({"w": np.zeros(8)})
+        assert step == 3
+        np.testing.assert_array_equal(np.asarray(restored["w"]), state["w"])
+
+    def test_disk_save_timeout_returns_false(self, saver, tmp_path, monkeypatch):
+        """If the global commit never lands (e.g. a diverged peer's shard
+        is missing), the bounded wait returns False instead of hanging."""
+        from dlrover_tpu.ckpt.checkpointer import (
+            FlashCheckpointer,
+            StorageType,
+        )
+
+        ckptr = FlashCheckpointer(str(tmp_path / "ck2"))
+        monkeypatch.setattr(
+            ckptr.engine, "latest_step", lambda d: -1
+        )
+        t0 = time.time()
+        ok = ckptr.save_checkpoint(
+            5, {"w": np.zeros(4)}, storage_type=StorageType.DISK,
+            timeout=1.0,
+        )
+        assert not ok
+        assert time.time() - t0 < 10.0

@@ -78,6 +78,13 @@ def parse_args(argv=None):
         "node from the job instead of only warning",
     )
     p.add_argument(
+        "--auto-config",
+        action="store_true",
+        help="infer nnodes from NODE_NUM, nproc-per-node from the local "
+        "TPU count, and enable network-check for jobs of >=4 nodes "
+        "(parity: dlrover-run --auto-config)",
+    )
+    p.add_argument(
         "--device-spec",
         type=str,
         default="",
@@ -160,9 +167,38 @@ def _run_network_check(args, client: MasterClient) -> bool:
     )
 
 
+def auto_configure(args):
+    """--auto-config (parity: elastic_run.py:33-40 + ElasticLaunchConfig
+    .auto_configure_params training.py:140): nnodes from the platform's
+    NODE_NUM env (the operator sets it on every pod), nproc-per-node
+    from the locally visible accelerator count, and network-check on
+    for jobs of >= 4 nodes."""
+    try:
+        node_num = int(os.getenv(NodeEnv.NODE_NUM, "0") or "0")
+    except ValueError:
+        node_num = 0  # templated-but-unset env: fall back to --nnodes
+    if node_num > 0:
+        args.nnodes = str(node_num)
+    from dlrover_tpu.utils.device import local_device_count
+
+    n = local_device_count(args.device_spec)
+    if n > 0:
+        args.nproc_per_node = n
+    if node_num >= 4:
+        args.network_check = True
+    logger.info(
+        f"auto-config: nnodes={args.nnodes} "
+        f"nproc_per_node={args.nproc_per_node} "
+        f"network_check={args.network_check}"
+    )
+    return args
+
+
 def run(args) -> int:
     if args.job_name:
         os.environ[NodeEnv.JOB_NAME] = args.job_name
+    if getattr(args, "auto_config", False):
+        args = auto_configure(args)
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
     master_proc: Optional[subprocess.Popen] = None
     master_addr = args.master_addr or os.getenv(NodeEnv.MASTER_ADDR, "")

@@ -13,6 +13,11 @@ import os
 DEVICE_SPEC_ENV = "DLROVER_TPU_DEVICE_SPEC"
 
 
+def _cpu_spec_count(spec: str) -> int:
+    """``"cpu"`` -> 1, ``"cpu:N"`` -> N (single source of the syntax)."""
+    return int(spec.split(":", 1)[1]) if ":" in spec else 1
+
+
 def configure_devices(spec: str = ""):
     """Apply a device spec like ``"cpu:8"`` (virtual 8-device CPU mesh,
     multi-process capable) or ``"tpu"`` (default backend). Must run before
@@ -23,12 +28,51 @@ def configure_devices(spec: str = ""):
     import jax
 
     if spec.startswith("cpu"):
-        n = int(spec.split(":", 1)[1]) if ":" in spec else 1
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", n)
+        jax.config.update("jax_num_cpu_devices", _cpu_spec_count(spec))
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     elif spec.startswith("tpu"):
         # default backend; nothing to force
         pass
     else:
         raise ValueError(f"unknown device spec: {spec}")
+
+
+def local_device_count(spec: str = "") -> int:
+    """Locally visible accelerator count for ``--auto-config``.
+
+    For a ``cpu:N`` spec the answer is static. Otherwise the count is
+    probed in a THROWAWAY subprocess: importing jax here would
+    initialize the backend in the launcher, which must not hold the TPU
+    chip lock its workers need. Returns 0 when probing fails."""
+    import subprocess
+    import sys
+
+    from dlrover_tpu.common.log import default_logger as logger
+
+    spec = spec or os.getenv(DEVICE_SPEC_ENV, "")
+    if spec.startswith("cpu"):
+        return _cpu_spec_count(spec)
+    if spec and not spec.startswith("tpu"):
+        raise ValueError(f"unknown device spec: {spec}")
+    try:
+        p = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; print(len(jax.local_devices()))",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        if p.returncode != 0:
+            logger.warning(
+                f"device probe failed (rc={p.returncode}): "
+                f"{p.stderr[-500:]}"
+            )
+            return 0
+        return int(p.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        logger.warning(f"device probe failed: {e!r}")
+        return 0

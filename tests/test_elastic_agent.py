@@ -147,3 +147,23 @@ def test_enable_compile_cache(tmp_path, monkeypatch):
 
     monkeypatch.setenv("DLROVER_TPU_COMPILE_CACHE", "off")
     assert enable_compile_cache() == ""
+
+
+def test_auto_configure(monkeypatch):
+    from dlrover_tpu.trainer.run import auto_configure, parse_args
+
+    monkeypatch.setenv("DLROVER_TPU_NODE_NUM", "4")
+    args = parse_args(
+        ["--auto-config", "--device-spec=cpu:8", "tests/assets/exit0.py"]
+    )
+    args = auto_configure(args)
+    assert args.nnodes == "4"
+    assert args.nproc_per_node == 8  # cpu:8 spec => static count
+    assert args.network_check  # >= 4 nodes turns the check on
+
+    monkeypatch.setenv("DLROVER_TPU_NODE_NUM", "2")
+    args = parse_args(
+        ["--auto-config", "--device-spec=cpu:2", "tests/assets/exit0.py"]
+    )
+    args = auto_configure(args)
+    assert args.nnodes == "2" and not args.network_check

@@ -104,7 +104,7 @@ def auto_accelerate(
         reports = run_search(cands)
         best = reports[0]
         if (
-            not (best.ok and best.fits)
+            not (best.ok and best.fits is not False)
             and hbm_budget
             and "remat" not in opt_names
         ):
@@ -123,12 +123,12 @@ def auto_accelerate(
                 ]
             )
             best = reports[0]
-        if not (best.ok and best.fits):
-            # mem_bytes == 0 means "no memory analysis", not "needs 0
-            # bytes" — surface the per-report error instead
+        if not (best.ok and best.fits is not False):
+            # fits=None means "no memory analysis", not "needs 0 bytes"
+            # — surface the per-report error instead
             over = [
                 r for r in reports
-                if r.ok and not r.fits and r.mem_bytes > 0
+                if r.ok and r.fits is False and r.mem_bytes > 0
             ]
             detail = (
                 f"smallest candidate needs {min(r.mem_bytes for r in over):.3e} "

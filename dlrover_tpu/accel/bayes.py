@@ -24,7 +24,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from dlrover_tpu.accel.dry_runner import DryRunReport, timed_run
+from dlrover_tpu.accel.dry_runner import DryRunReport, hbm_fits, timed_run
 from dlrover_tpu.accel.strategy import Strategy
 from dlrover_tpu.common.log import default_logger as logger
 
@@ -158,17 +158,17 @@ def tpe_search(
         for r in reports:
             if r.step_s is None:
                 continue
-            if r.mem_bytes > 0:
-                r.fits = r.mem_bytes <= hbm_budget
-            else:
-                # backend offered no memory analysis: cannot vouch for
-                # the memory claim, so the candidate must not pass
-                r.fits = False
-                r.error = "no memory analysis available for HBM gate"
+            # the one shared gate (dry_runner.hbm_fits): no memory
+            # analysis -> None ("unknown"), still viable — the strategy
+            # DID run its timed steps. Failing it here while the
+            # combination path passes it would let the search-algorithm
+            # choice flip pass/fail for one job.
+            r.fits = hbm_fits(r.mem_bytes, hbm_budget)
         reports.sort(
             key=lambda r: (
                 0 if (r.step_s is not None and r.fits) else
-                1 if r.step_s is not None else 2,
+                1 if (r.step_s is not None and r.fits is None) else
+                2 if r.step_s is not None else 3,
                 r.step_s or 0.0,
             )
         )

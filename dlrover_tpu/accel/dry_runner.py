@@ -41,9 +41,28 @@ class DryRunReport:
     flops_per_device: float = 0.0
     bytes_per_device: float = 0.0
     mem_bytes: float = 0.0  # argument + temp, per device
-    fits: bool = True
+    # tri-state HBM gate: True = measured fit, False = measured overflow,
+    # None = backend offered no memory analysis ("unknown"). Unknown is
+    # VIABLE (`fits is not False`) in both search paths — the semantic
+    # must not depend on whether combination or TPE ran the search.
+    fits: Optional[bool] = True
     est_step_s: float = 0.0  # roofline estimate from the compile
     step_s: Optional[float] = None  # measured (finalists only)
+
+
+def hbm_fits(
+    mem_bytes: float, hbm_budget: Optional[float]
+) -> Optional[bool]:
+    """Tri-state HBM gate shared by BOTH search paths (combination and
+    TPE import this one function so the semantic cannot diverge):
+    True = measured fit, False = measured overflow, None = the backend
+    offered no memory analysis ("unknown" — viable but ranked below
+    measured fits)."""
+    if not hbm_budget:
+        return True
+    if mem_bytes > 0:
+        return mem_bytes <= hbm_budget
+    return None
 
 
 def _build(
@@ -162,8 +181,7 @@ def compiled_cost(
                 getattr(ma, "argument_size_in_bytes", 0)
                 + getattr(ma, "temp_size_in_bytes", 0)
             )
-        if hbm_budget:
-            report.fits = report.mem_bytes <= hbm_budget
+        report.fits = hbm_fits(report.mem_bytes, hbm_budget)
         report.est_step_s = max(
             report.flops_per_device * _SEC_PER_FLOP,
             report.bytes_per_device * _SEC_PER_BYTE,
@@ -240,19 +258,24 @@ def dry_run(
         compiled_cost(s, cfg, tx, batch, seq, devices, hbm_budget)
         for s in strategies
     ]
-    viable = [r for r in reports if r.ok and r.fits]
-    viable.sort(key=lambda r: r.est_step_s)
+    viable = [r for r in reports if r.ok and r.fits is not False]
+    # known-fit candidates get timed before unknown-memory ones
+    viable.sort(key=lambda r: (r.fits is None, r.est_step_s))
     for r in viable[:max_timed]:
         r.step_s, _ = timed_run(
             r.strategy, cfg, tx, batch, seq, devices, steps=timed_steps
         )
 
     def rank(r: DryRunReport):
-        if not (r.ok and r.fits):
-            return (2, 0.0)
+        """Same tier order as tpe_search: measured+fit < measured+unknown
+        < estimated+fit < estimated+unknown < non-viable — so the
+        search-algorithm choice cannot flip which strategy wins."""
+        if not (r.ok and r.fits is not False):
+            return (4, 0.0)
+        known = 0 if r.fits else 1  # fits is True vs None here
         if r.step_s is not None:
-            return (0, r.step_s)
-        return (1, r.est_step_s)
+            return (0 + known, r.step_s)
+        return (2 + known, r.est_step_s)
 
     reports.sort(key=rank)
     return reports

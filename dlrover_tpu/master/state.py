@@ -73,9 +73,26 @@ def restore_master(master, state: dict) -> None:
         str(name): int(rnd)
         for name, rnd in state.get("rdzv_rounds", {}).items()
     }
-    ps_state = state.get("elastic_ps", {})
+    # elastic_ps: unpack node rows into typed tuples NOW — a malformed
+    # row must fail here, not inside import_state after rounds/KV applied
+    ps_raw = state.get("elastic_ps", {}) or {}
+    ps_state = {
+        "global": int(ps_raw.get("global", 0)),
+        "nodes": [
+            [str(t), int(i), str(vt), int(v)]
+            for t, i, vt, v in ps_raw.get("nodes", [])
+        ],
+    }
     step = int(state.get("completed_global_step", 0))
     tm_content = state.get("task_manager", "")
+    # task manager: dry-run the FULL restore into a scratch TaskManager —
+    # the same code path phase 2 will take, so anything it would choke on
+    # (unconstructible params, missing/odd-arity "state" rows, name
+    # mismatches) fails here, before any phase-2 mutation
+    if tm_content:
+        from dlrover_tpu.master.shard.task_manager import TaskManager
+
+        TaskManager().restore_checkpoint(tm_content)
 
     # -- phase 2: apply
     for name, rnd in rounds.items():

@@ -17,9 +17,11 @@ functional model:
    ``mup_attn_scale`` switches attention logits from 1/sqrt(d) to
    1/d * base_head_dim**0.5 and ``mup_output_mult`` scales the logits by
    base_width/width.
-3. **optimizer**: ``scale_adam_lr_by_mup`` wraps any optax chain with
-   per-leaf LR multipliers — 1/width_mult for matrix-like (2+ dim)
+3. **optimizer**: ``scale_adam_lr_by_mup`` scales the Adam direction
+   with per-leaf LR multipliers — 1/width_mult for matrix-like (2+ dim)
    hidden params, 1 for vectors (norms, biases) and the embedding table.
+   Decoupled weight decay is applied AFTER the muP scale (see
+   ``mup_adamw``) so the decay update stays -lr*wd*param at every width.
 
 ``mup_config(cfg, base)`` returns the config with forward multipliers
 set; ``mup_lr_scales(cfg, base)`` / ``mup_adamw(lr, cfg, base)`` supply
@@ -87,8 +89,15 @@ def mup_lr_scales(cfg: TransformerConfig, base: TransformerConfig) -> Any:
 
 def scale_adam_lr_by_mup(scales: Any) -> optax.GradientTransformation:
     """Optax transform multiplying each leaf's update by its muP LR scale.
-    Chain it AFTER the Adam transform (updates, not grads, are scaled):
-    ``optax.chain(optax.adamw(lr), scale_adam_lr_by_mup(scales))``."""
+    Chain it after the Adam *direction* but BEFORE decoupled weight decay
+    and the LR (decay must not shrink with width)::
+
+        optax.chain(optax.scale_by_adam(), scale_adam_lr_by_mup(scales),
+                    optax.add_decayed_weights(wd),
+                    optax.scale_by_learning_rate(lr))
+
+    (what ``mup_adamw`` builds). Chaining it after a monolithic
+    ``optax.adamw`` would scale the decay term by 1/m too."""
 
     def init_fn(params):
         del params
@@ -113,13 +122,18 @@ def mup_adamw(
 ) -> optax.GradientTransformation:
     """AdamW under muP: base LR transfers across width.
 
-    Weight decay under muP-AdamW should stay *coupled* to the scaled LR
-    (decay strength independent of width), which optax's multiplicative
-    ``weight_decay`` inside adamw already gives when we scale the whole
-    update afterwards.
+    Only the Adam *direction* is scaled by the per-leaf muP multiplier;
+    decoupled weight decay is applied after it, so the decay update is
+    ``-lr * wd * param`` on every leaf — width-independent, matching the
+    reference's MuAdam with ``scaled_wd=True`` (atorch/mup/optim.py:71,
+    which pre-multiplies wd by width_mult to cancel its 1/m LR). Chaining
+    the mup scale after a monolithic ``optax.adamw`` instead would shrink
+    the effective decay of matrix-like params to lr*wd/m.
     """
     scales = mup_lr_scales(cfg, base)
     return optax.chain(
-        optax.adamw(lr, weight_decay=weight_decay, **adam_kwargs),
+        optax.scale_by_adam(**adam_kwargs),
         scale_adam_lr_by_mup(scales),
+        optax.add_decayed_weights(weight_decay),
+        optax.scale_by_learning_rate(lr),
     )

@@ -184,7 +184,11 @@ def auto_configure(args):
     n = local_device_count(args.device_spec)
     if n > 0:
         args.nproc_per_node = n
-    if node_num >= 4:
+    # gate on the RESOLVED min_nodes, not only the env-derived node_num:
+    # `--auto-config --nnodes=8` without the platform env must still turn
+    # the health check on (parity: training.py:154 gates on min_nodes)
+    min_nodes, _ = parse_nnodes(args.nnodes)
+    if min_nodes >= 4:
         args.network_check = True
     logger.info(
         f"auto-config: nnodes={args.nnodes} "

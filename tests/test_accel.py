@@ -158,6 +158,44 @@ def test_tpe_propose_handles_failures():
     assert pick.mesh.dp in (1, 2)
 
 
+def test_hbm_gate_tristate_consistent_across_search_paths(monkeypatch):
+    """When the backend offers NO memory analysis (mem_bytes == 0), both
+    search paths must classify the candidate identically — fits=None
+    ("unknown", still viable) — so a job cannot pass under
+    search='combination' and fail under search='bayes'."""
+    import dlrover_tpu.accel.bayes as bayes_mod
+    import dlrover_tpu.accel.dry_runner as dr_mod
+    from dlrover_tpu.accel.bayes import tpe_search
+
+    cfg = tiny(num_layers=1)
+    tx = optax.adamw(1e-3)
+    devices = jax.devices()[:2]
+    cands = [Strategy(mesh=MeshConfig(dp=2), dtype="float32")]
+
+    # backend-without-memory-analysis: timed_run measures but mem=0
+    real_timed = dr_mod.timed_run
+
+    def no_mem_timed(*a, **k):
+        t, _ = real_timed(*a, **k)
+        return t, 0.0
+
+    monkeypatch.setattr(bayes_mod, "timed_run", no_mem_timed)
+    reports = tpe_search(
+        cands, cfg, tx, 2, 16, devices, budget=1, n_init=1,
+        timed_steps=1, hbm_budget=1e9,
+    )
+    best = reports[0]
+    assert best.step_s is not None
+    assert best.fits is None, "unknown memory must not fail the TPE path"
+    # both paths import the ONE shared gate, so the semantic is
+    # structurally identical; pin its tri-state contract
+    assert bayes_mod.hbm_fits is dr_mod.hbm_fits
+    assert dr_mod.hbm_fits(0.0, 1e9) is None
+    assert dr_mod.hbm_fits(2e9, 1e9) is False
+    assert dr_mod.hbm_fits(5e8, 1e9) is True
+    assert dr_mod.hbm_fits(0.0, None) is True  # no budget -> no gate
+
+
 def test_auto_accelerate_bayes_search():
     """The TPE path returns a measured, trainable winner."""
     cfg = tiny(num_layers=2)

@@ -167,3 +167,15 @@ def test_auto_configure(monkeypatch):
     )
     args = auto_configure(args)
     assert args.nnodes == "2" and not args.network_check
+
+    # no platform env, CLI-provided --nnodes=8: the gate must fire off
+    # the parsed min_nodes, not only the env-derived node count
+    monkeypatch.delenv("DLROVER_TPU_NODE_NUM", raising=False)
+    args = parse_args(
+        [
+            "--auto-config", "--nnodes=8", "--device-spec=cpu:2",
+            "tests/assets/exit0.py",
+        ]
+    )
+    args = auto_configure(args)
+    assert args.network_check

@@ -169,6 +169,73 @@ def test_surviving_worker_keeps_sharding(state_env):
         m2.stop()
 
 
+def test_malformed_snapshot_applies_nothing():
+    """Phase 1 must validate EVERYTHING (including the task-manager JSON
+    and PS node rows) before phase 2 mutates the master: a snapshot whose
+    tail is malformed must leave rdzv rounds and KV untouched, so
+    'starting cold' in the log is actually true."""
+    import json
+
+    from dlrover_tpu.master.state import restore_master, snapshot_master
+
+    m = _start()
+    try:
+        good = snapshot_master(m)
+        params = {
+            "batch_size": 4,
+            "num_minibatches_per_shard": 2,
+            "dataset_size": 32,
+            "num_epochs": 1,
+            "dataset_name": "ds",
+        }
+        for bad_state in (
+            # task_manager params that DatasetShardParams cannot accept
+            {
+                **good,
+                "rdzv_rounds": {"elastic-training": 9},
+                "task_manager": json.dumps(
+                    {"ds": {"params": {"bogus_field": 1}, "state": {}}}
+                ),
+            },
+            # valid params but the "state" payload is missing
+            {
+                **good,
+                "rdzv_rounds": {"elastic-training": 9},
+                "task_manager": json.dumps({"ds": {"params": params}}),
+            },
+            # valid params but malformed progress rows (wrong arity)
+            {
+                **good,
+                "rdzv_rounds": {"elastic-training": 9},
+                "task_manager": json.dumps(
+                    {
+                        "ds": {
+                            "params": params,
+                            "state": {
+                                "dataset_name": "ds",
+                                "todo": [[0, 10]],
+                                "epoch": 0,
+                            },
+                        }
+                    }
+                ),
+            },
+            # malformed elastic_ps node row (too few columns)
+            {
+                **good,
+                "rdzv_rounds": {"elastic-training": 9},
+                "elastic_ps": {"global": 1, "nodes": [["ps"]]},
+            },
+        ):
+            with pytest.raises(Exception):
+                restore_master(m, bad_state)
+            assert m.rdzv_managers["elastic-training"].rdzv_round == 0, (
+                "half-restored: rounds applied before validation failed"
+            )
+    finally:
+        m.stop()
+
+
 def test_restore_keeps_buffered_streaming_reports():
     """Producer reports that arrived BEFORE the consumer's shard-
     checkpoint restore are newer than the snapshot and must survive the

@@ -63,6 +63,27 @@ def test_mup_config_multipliers():
     assert np.isclose(cfg.mup_attn_scale, (base.head_dim**0.5) / 32)
 
 
+def test_weight_decay_width_independent():
+    """The decoupled decay update must be -lr*wd*param on EVERY leaf,
+    independent of width_mult (the reference's MuAdam scaled_wd=True
+    semantics). Chaining the mup scale after optax.adamw would shrink
+    matrix-like leaves' decay to lr*wd/m — caught here with zero grads,
+    where the Adam direction vanishes and only the decay term remains."""
+    lr, wd = 1e-2, 0.1
+    base = tiny(model_dim=32, mlp_dim=64)
+    cfg = tiny(model_dim=128, mlp_dim=256)
+    tx = mup_adamw(lr, cfg, base, weight_decay=wd)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    opt = tx.init(params)
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    upd, _ = tx.update(zeros, opt, params)
+    # matrix-like leaf (wq has mup scale 1/4) still decays at full lr*wd
+    wq, d_wq = params["layers"][0]["attn"]["wq"], upd["layers"][0]["attn"]["wq"]
+    np.testing.assert_allclose(
+        np.asarray(d_wq), np.asarray(-lr * wd * wq), rtol=1e-5
+    )
+
+
 def test_coordinate_check():
     """Trained-logit magnitude ratio across a 4x width sweep stays near 1
     under muP but grows with width under SP (same base LR)."""

@@ -92,6 +92,11 @@ def _build(
             pipeline_state_shardings,
         )
 
+        virtual = (
+            strategy.pp_virtual
+            if strategy.pp_schedule == "interleaved"
+            else 1
+        )
         step_fn = build_pipeline_train_step(
             cfg,
             mesh,
@@ -99,11 +104,14 @@ def _build(
             strategy.num_microbatches,
             donate=donate,
             schedule=strategy.pp_schedule,
+            virtual_stages=strategy.pp_virtual,
         )
-        shardings = pipeline_state_shardings(cfg, mesh, tx)
+        shardings = pipeline_state_shardings(cfg, mesh, tx, virtual=virtual)
 
         def init_fn(key):
-            state, _ = init_pipeline_state(key, cfg, mesh, tx)
+            state, _ = init_pipeline_state(
+                key, cfg, mesh, tx, virtual=virtual
+            )
             return state
 
         def make_batch(batch, seq):

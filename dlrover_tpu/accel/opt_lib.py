@@ -13,6 +13,7 @@ transform of ``(TransformerConfig, Strategy)``:
 - ``bf16``/``fp32`` — compute dtype policy (AMP analog)
 - ``int8_mlp``   — int8 MXU matmuls in the MLP (FP8 analog)
 - ``1f1b``       — 1F1B pipeline schedule instead of GPipe
+- ``interleaved``— interleaved 1F1B (virtual pipeline stages)
 
 A Strategy records applied optimization *names* (``strategy.opts``), so
 the strategy stays a serializable value: ``agree_strategy`` publishes it
@@ -102,4 +103,8 @@ register_optimization(
 )
 register_optimization(
     "1f1b", lambda cfg, s: (cfg, dc_replace(s, pp_schedule="1f1b"))
+)
+register_optimization(
+    "interleaved",
+    lambda cfg, s: (cfg, dc_replace(s, pp_schedule="interleaved")),
 )

@@ -25,7 +25,9 @@ class Strategy:
     dtype: str = "bfloat16"
     # >1 runs a pipeline schedule over the mesh's pp axis
     num_microbatches: int = 1
-    pp_schedule: str = "gpipe"  # or "1f1b" (parallel/pipeline.py)
+    # "gpipe", "1f1b", or "interleaved" (parallel/pipeline.py)
+    pp_schedule: str = "gpipe"
+    pp_virtual: int = 2  # chunks/device when pp_schedule == "interleaved"
     # named optimization-library entries applied to this strategy
     # (accel/opt_lib.py re-derives the config from these on every host)
     opts: Tuple[str, ...] = ()
@@ -39,14 +41,18 @@ class Strategy:
             bits.append(f"mb{self.num_microbatches}")
         sched = "1f1b" if "1f1b" in self.opts else self.pp_schedule
         if self.mesh.pp > 1 and sched != "gpipe":
-            bits.append(sched)
+            bits.append(
+                f"interleaved{self.pp_virtual}"
+                if sched == "interleaved"
+                else sched
+            )
         if self.remat or "remat" in self.opts:
             bits.append("remat")
         bits.append(self.dtype)
         bits.extend(
             o
             for o in self.opts
-            if o not in ("remat", "bf16", "fp32", "1f1b")
+            if o not in ("remat", "bf16", "fp32", "1f1b", "interleaved")
         )
         return "/".join(bits)
 

@@ -259,11 +259,53 @@ def _mlp_block(x, layer, cfg: TransformerConfig, mesh):
     return x + out, jnp.float32(0.0)
 
 
-def embed_tokens(params: Params, tokens: jnp.ndarray, cfg: TransformerConfig):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _embed_lookup(table, tokens, mesh):
+    return table[tokens]
+
+
+def _embed_lookup_fwd(table, tokens, mesh):
+    # residuals must be JAX types: the table's shape rides along as a
+    # static int tuple; its dtype is recovered from dx (the lookup is
+    # dtype-preserving)
+    return table[tokens], (tokens, table.shape)
+
+
+def _embed_lookup_bwd(mesh, res, dx):
+    """Gather vjp (scatter-add), with the batch→feature reshard of the
+    cotangent decomposed into single-axis hops.
+
+    Under dp×fsdp, ``dx`` arrives with its batch dim sharded over BOTH
+    axes while the table cotangent wants D sharded over fsdp; XLA's SPMD
+    partitioner cannot move between those layouts in one step and falls
+    back to "involuntary full rematerialization" (replicate, then
+    re-partition — spmd_partitioner.cc:652). Pinning the intermediate
+    layout (batch@dp, D@fsdp) turns it into two expressible all-to-alls.
+    """
+    tokens, tshape = res
+    if (
+        mesh is not None
+        and mesh.shape.get("dp", 1) > 1
+        and mesh.shape.get("fsdp", 1) > 1
+    ):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P("dp", *([None] * (dx.ndim - 2)), "fsdp")
+        dx = lax.with_sharding_constraint(dx, NamedSharding(mesh, spec))
+    dtable = jnp.zeros(tshape, dx.dtype).at[tokens].add(dx)
+    return dtable, None
+
+
+_embed_lookup.defvjp(_embed_lookup_fwd, _embed_lookup_bwd)
+
+
+def embed_tokens(
+    params: Params, tokens: jnp.ndarray, cfg: TransformerConfig, mesh=None
+):
     """tokens [B,T] → residual stream [B,T,D] (token + learned positions)."""
     dt = _dtype(cfg)
     T = tokens.shape[-1]
-    x = params["embed"]["tokens"].astype(dt)[tokens]
+    x = _embed_lookup(params["embed"]["tokens"].astype(dt), tokens, mesh)
     if not cfg.rope:
         x = x + params["embed"]["positions"].astype(dt)[:T][None]
     return x
@@ -306,7 +348,7 @@ def forward(
     trunk math can never drift from the LM path).
     """
     B, T = tokens.shape
-    x = embed_tokens(params, tokens, cfg)
+    x = embed_tokens(params, tokens, cfg, mesh)
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
 
     aux_total = jnp.float32(0.0)

@@ -34,6 +34,11 @@ Schedules:
   forward completes. Activation footprint is a ring buffer of 2P-1 stage
   inputs per device — **independent of M**, the property that lets real
   pipelines run M >> P microbatches to shrink the bubble.
+- **Interleaved 1F1B** (``schedule="interleaved"``, Megatron virtual
+  pipeline stages; ref StageInterleaver.py:16): each device owns
+  ``virtual_stages`` non-contiguous layer chunks, shrinking the bubble
+  by ~v at equal M for an O(vP) activation ring buffer — see
+  ``pipeline_value_and_grad_1f1b``'s docstring for the tick algebra.
 
 Layout contract: the embedding runs before the pipeline region and the
 final-norm/LM-head after it, in plain GSPMD-auto land; only the L
@@ -78,42 +83,87 @@ def pipeline_rules(rules: Optional[ShardingRules] = None) -> ShardingRules:
     rules = rules or default_lm_rules()
     merged = dict(rules.rules)
     merged.setdefault("stage", "pp")
+    merged.setdefault("chunk", None)  # virtual stages: per-device slots
     merged.setdefault("layer_stack", None)
     return ShardingRules(rules=merged)
 
 
-def _check_pipeline_cfg(cfg: TransformerConfig, pp: int) -> None:
+def _microbatch_axes(mesh, mb: int) -> Tuple[str, ...]:
+    """Mesh axes to shard the per-microbatch batch dim over: the largest
+    prefix of ("dp", "fsdp") whose device product divides ``mb``.
+
+    Constraining mb over axes that do NOT divide it (e.g. mb=2 over
+    dp*fsdp=4) makes XLA pad-and-reshard every stage boundary — the
+    "Involuntary full rematerialization" warnings the SPMD partitioner
+    emits when it must replicate a tensor to move between such layouts.
+    """
+    axes = []
+    n = 1
+    for ax in ("dp", "fsdp"):
+        sz = mesh.shape.get(ax, 1)
+        if sz > 1 and mb % (n * sz) == 0:
+            axes.append(ax)
+            n *= sz
+    return tuple(axes)
+
+
+def _check_pipeline_cfg(
+    cfg: TransformerConfig, pp: int, virtual: int = 1
+) -> None:
     if cfg.num_experts:
         raise ValueError(
             "pipeline parallelism requires homogeneous blocks (MoE layers "
             "interleave a different tree structure); use ep without pp"
         )
-    if cfg.num_layers % pp != 0:
+    stages = pp * virtual
+    if cfg.num_layers % stages != 0:
+        what = (
+            f"pp={pp} x virtual={virtual} = {stages} chunks"
+            if virtual > 1
+            else f"pp={pp} stages"
+        )
         raise ValueError(
-            f"num_layers={cfg.num_layers} must divide into pp={pp} stages"
+            f"num_layers={cfg.num_layers} must divide into {what}"
         )
 
 
-def stack_pipeline_params(params: Any, pp: int) -> Any:
+def stack_pipeline_params(params: Any, pp: int, virtual: int = 1) -> Any:
     """{"embed","final_norm",("lm_head"),"layers":[L dicts]} →
-    same dict with "layers" replaced by "stages": leaves [pp, L/pp, ...]."""
+    same dict with "layers" replaced by "stages".
+
+    ``virtual=1``: leaves [pp, L/pp, ...] — device d owns the contiguous
+    layer block d.
+    ``virtual=v>1`` (interleaved schedules): leaves [pp, v, L/(v*pp), ...]
+    — global stage s = q*pp + d lives at [d, q], i.e. device d owns v
+    NON-contiguous layer chunks (Megatron virtual pipeline stages, ref
+    StageInterleaver.py:16)."""
     layers = params["layers"]
-    lp = len(layers) // pp
+    lc = len(layers) // (pp * virtual)
     stages = jax.tree_util.tree_map(
-        lambda *xs: jnp.stack(xs).reshape(pp, lp, *xs[0].shape), *layers
+        lambda *xs: jnp.stack(xs).reshape(
+            virtual, pp, lc, *xs[0].shape
+        ).swapaxes(0, 1)
+        if virtual > 1
+        else jnp.stack(xs).reshape(pp, lc, *xs[0].shape),
+        *layers,
     )
     out = {k: v for k, v in params.items() if k != "layers"}
     out["stages"] = stages
     return out
 
 
-def unstack_pipeline_params(pparams: Any, cfg: TransformerConfig) -> Any:
+def unstack_pipeline_params(
+    pparams: Any, cfg: TransformerConfig, virtual: int = 1
+) -> Any:
     """Inverse of ``stack_pipeline_params`` (for checkpoints / eval)."""
     stages = pparams["stages"]
     L = cfg.num_layers
 
     def leaf(x):
-        return x.reshape(L, *x.shape[2:])
+        if virtual > 1:
+            # [pp, v, lc, ...] -> stage-major [v, pp, lc, ...] -> [L, ...]
+            x = x.swapaxes(0, 1)
+        return x.reshape(L, *x.shape[2 + (virtual > 1):])
 
     flat = jax.tree_util.tree_map(leaf, stages)
     layers = [
@@ -124,14 +174,20 @@ def unstack_pipeline_params(pparams: Any, cfg: TransformerConfig) -> Any:
     return out
 
 
-def pipeline_logical_axes(cfg: TransformerConfig, pp: int) -> Any:
+def pipeline_logical_axes(
+    cfg: TransformerConfig, pp: int, virtual: int = 1
+) -> Any:
     """Logical-axis pytree congruent with ``stack_pipeline_params``'s
-    output: per-layer axes prefixed with the (stage, layer_stack) axes."""
+    output: per-layer axes prefixed with the (stage[, chunk], layer_stack)
+    axes."""
     axes = logical_axes(cfg)
     layer0 = axes["layers"][0]
+    prefix = (
+        ("stage", "chunk", "layer_stack") if virtual > 1 else STAGE_AXES
+    )
 
     def prefixed(t):
-        return STAGE_AXES + t
+        return prefix + t
 
     stages = jax.tree_util.tree_map(
         prefixed,
@@ -145,10 +201,10 @@ def pipeline_logical_axes(cfg: TransformerConfig, pp: int) -> Any:
 
 
 def pipeline_param_shardings(
-    cfg: TransformerConfig, mesh, pp: int, rules=None
+    cfg: TransformerConfig, mesh, pp: int, rules=None, virtual: int = 1
 ):
     return apply_rules(
-        pipeline_logical_axes(cfg, pp), pipeline_rules(rules), mesh
+        pipeline_logical_axes(cfg, pp, virtual), pipeline_rules(rules), mesh
     )
 
 
@@ -177,11 +233,17 @@ def pipeline_forward(
         raise ValueError(f"batch {B} must divide into {M} microbatches")
     mb = B // M
 
-    # embedding: before the pipeline region, plain GSPMD
-    x = embed_tokens(pparams, tokens, cfg)
-    x = x.reshape(M, mb, T, cfg.model_dim)
+    # embedding: before the pipeline region, plain GSPMD. Reshape the
+    # token ids into microbatch layout FIRST and pin the layout, so the
+    # [M, mb, T, D] activations are BORN in the spec the pipeline body
+    # uses — never resharded at the region boundary
+    mb_axes = _microbatch_axes(mesh, mb)
+    tok_mb = lax.with_sharding_constraint(
+        tokens.reshape(M, mb, T), NamedSharding(mesh, P(None, mb_axes))
+    )
+    x = embed_tokens(pparams, tok_mb, cfg)
     x = lax.with_sharding_constraint(
-        x, NamedSharding(mesh, P(None, ("dp", "fsdp")))
+        x, NamedSharding(mesh, P(None, mb_axes))
     )
 
     def block(x, layer):
@@ -245,7 +307,9 @@ def pipeline_forward(
         # full batch)
         axis_names={"pp"},
     )(pparams["stages"], x)
-    y = outs[pp - 1].reshape(B, T, cfg.model_dim)
+    y = lax.with_sharding_constraint(
+        outs[pp - 1], NamedSharding(mesh, P(None, mb_axes))
+    ).reshape(B, T, cfg.model_dim)
 
     # final norm + head: after the pipeline region, plain GSPMD
     return lm_head(pparams, y, cfg)
@@ -261,6 +325,41 @@ def pipeline_loss_fn(
 # ---------------------------------------------------------------------------
 # 1F1B schedule (manual backward)
 # ---------------------------------------------------------------------------
+def schedule_occupancy(pp: int, M: int, virtual: int = 1):
+    """Pure-Python occupancy model of the (interleaved) 1F1B tick clock —
+    the same index algebra the compiled scan uses. Returns
+    ``(n_ticks, busy_slots, total_slots)`` where each device contributes
+    2 slots per tick (one forward, one backward) and a slot is busy when
+    its decomposition lands on a real (microbatch, chunk) pair.
+
+    Bubble fraction = 1 - busy/total = (v+1)(P-1)/(vM + (v+1)(P-1))
+    — interleaving with v chunks divides the non-overlapped pipeline
+    fill/drain by v relative to the work, the Megatron virtual-pipeline
+    effect (bubble (P-1)/(vM+P-1) in their accounting, which counts the
+    overlapped last-stage fwd+bwd tick once)."""
+    v = virtual
+    # v>1: microbatches enter in lane groups of P; a partial last group
+    # still takes a full group's ticks (its empty lanes are bubbles)
+    m_pad = M if v == 1 else -(-M // pp) * pp
+    n_ticks = v * m_pad + (v + 1) * pp - 2
+    busy = 0
+    for d in range(pp):
+        for t in range(n_ticks):
+            u = t - d
+            if u >= 0:
+                i, r = u % pp, u // pp
+                if (r // v) * pp + i < M:
+                    busy += 1
+            wb = t + d - 2 * (pp - 1)
+            if wb >= 0:
+                i, r = wb % pp, wb // pp
+                q = (2 * v - 2 - r) % v
+                g = (r - (2 * v - 2 - q)) // v
+                if g >= 0 and g * pp + i < M:
+                    busy += 1
+    return n_ticks, busy, 2 * pp * n_ticks
+
+
 def pipeline_value_and_grad_1f1b(
     pparams: Any,
     tokens: jnp.ndarray,
@@ -268,19 +367,38 @@ def pipeline_value_and_grad_1f1b(
     cfg: TransformerConfig,
     mesh,
     num_microbatches: int,
+    virtual: int = 1,
 ) -> Tuple[jnp.ndarray, Any]:
     """(loss, grads) under the 1F1B schedule; grads congruent to pparams.
 
-    Tick clock: stage i runs forward of microbatch j at tick ``i + j`` and
-    backward of microbatch j at tick ``2(P-1) - i + j`` (so the last stage
-    does fwd+bwd of the same microbatch in one tick, stage 0's backward
-    lags its forward by 2(P-1) ticks — the classic 1F1B picture). Both
-    hops (activations forward, cotangents backward) are next-tick
-    ``ppermute`` neighbours, so one scan over ``M + 2(P-1)`` ticks runs
-    the whole schedule. Stage inputs wait in a ring buffer of ``2P-1``
-    slots (max residency 2(P-1) ticks < 2P-1); the stage forward is
-    recomputed inside ``jax.vjp`` at the backward tick, so nothing else
-    is stored.
+    Tick clock (``virtual=1``): stage i runs forward of microbatch j at
+    tick ``i + j`` and backward of microbatch j at tick ``2(P-1) - i + j``
+    (so the last stage does fwd+bwd of the same microbatch in one tick,
+    stage 0's backward lags its forward by 2(P-1) ticks — the classic
+    1F1B picture). Both hops (activations forward, cotangents backward)
+    are next-tick ``ppermute`` neighbours, so one scan over ``M + 2(P-1)``
+    ticks runs the whole schedule. Stage inputs wait in a ring buffer of
+    ``2P-1`` slots (max residency 2(P-1) ticks < 2P-1); the stage forward
+    is recomputed inside ``jax.vjp`` at the backward tick, so nothing
+    else is stored.
+
+    **Interleaved 1F1B** (``virtual=v>1``, ref StageInterleaver.py:16 /
+    Megatron virtual pipeline stages): device d owns v layer *chunks* —
+    global stage s = q*P + d — so each microbatch rides the same P-device
+    ring v times. The whole schedule stays one scan because every
+    transition remains a single-tick ring hop: forward of (microbatch
+    group g, lane i, chunk q) on device d fires at tick
+    ``t = g*vP + q*P + i + d`` and its backward at
+    ``t + (2v-2-2q)*P + 2(P-1-d)`` — both decompositions are unique per
+    (device, tick), so each device runs exactly one chunk-forward and one
+    chunk-backward per tick, picking its chunk by ``q = (u div P) mod v``.
+    The chunk-(v-1)→chunk-q+1 wraparound rides the SAME ppermute as the
+    stage hops (ring edge P-1 → 0). Per-tick work is 1/v of a ``virtual=1``
+    stage, so the fill/drain bubble shrinks by ~v at equal microbatch
+    count: bubble (v+1)(P-1) slot-pairs against vM of work (see
+    ``schedule_occupancy``). Cost: the activation ring buffer grows to
+    ``2vP-1`` *chunk* inputs (same bytes per entry), the known memory
+    trade of interleaving.
 
     Only *token ids* ([M, mb, T] int32 — no model-dim factor) cross the
     shard_map boundary per microbatch: the embedding lookup runs inside
@@ -298,7 +416,8 @@ def pipeline_value_and_grad_1f1b(
     """
     pp = mesh.shape["pp"]
     M = num_microbatches
-    _check_pipeline_cfg(cfg, pp)
+    v = virtual
+    _check_pipeline_cfg(cfg, pp, v)
     if mesh.shape.get("sp", 1) > 1:
         raise ValueError("sp (ring attention) inside pp stages not supported")
     B, T = tokens.shape
@@ -313,11 +432,15 @@ def pipeline_value_and_grad_1f1b(
     else:
         head_params["lm_head"] = pparams["lm_head"]
 
+    mb_axes = _microbatch_axes(mesh, mb)
     tok = lax.with_sharding_constraint(
         tokens.reshape(M, mb, T),
-        NamedSharding(mesh, P(None, ("dp", "fsdp"))),
+        NamedSharding(mesh, P(None, mb_axes)),
     )
-    tgt = targets.reshape(M, mb, T)
+    tgt = lax.with_sharding_constraint(
+        targets.reshape(M, mb, T),
+        NamedSharding(mesh, P(None, mb_axes)),
+    )
 
     def block(xx, layer):
         positions = jnp.broadcast_to(jnp.arange(T), xx.shape[:2])
@@ -339,8 +462,14 @@ def pipeline_value_and_grad_1f1b(
         # of the mean-over-microbatches loss
         return token_nll(lm_head(hp, y, cfg), t_mb) / M
 
-    n_ticks = M + 2 * (pp - 1)
-    buf_n = 2 * pp - 1
+    # v>1: microbatches enter in lane groups of P; when M is not a
+    # multiple of P the last (partial) group still occupies a full
+    # group's ticks — without the pad, the final group's backward slots
+    # would fall past the scan end and their gradient contributions
+    # silently vanish. v=1 injects at rate 1 (j == t - d), no pad needed.
+    m_pad = M if v == 1 else -(-M // pp) * pp
+    n_ticks = v * m_pad + (v + 1) * pp - 2
+    buf_n = 2 * v * pp - 1
 
     def pipelined(stages, head_p, emb_p, tok_all, tgt_all):
         stages_loc = jax.tree_util.tree_map(lambda a: a[0], stages)
@@ -348,55 +477,76 @@ def pipeline_value_and_grad_1f1b(
         fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
         bwd_perm = [((i + 1) % pp, i) for i in range(pp)]
 
-        def v(a):
+        def vary(a):
             return lax.pcast(a, ("pp",), to="varying")
 
-        tok_loc = v(tok_all)
-        tgt_loc = v(tgt_all)
-        head_loc = jax.tree_util.tree_map(v, head_p)
-        emb_loc = jax.tree_util.tree_map(v, emb_p)
+        tok_loc = vary(tok_all)
+        tgt_loc = vary(tgt_all)
+        head_loc = jax.tree_util.tree_map(vary, head_p)
+        emb_loc = jax.tree_util.tree_map(vary, emb_p)
+
+        def chunk_of(tree, q_c):
+            """Select chunk q's [lc, ...] slice of a [v, lc, ...] tree
+            (identity when virtual == 1 — leaves carry no chunk axis)."""
+            if v == 1:
+                return tree
+            return jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(
+                    a, q_c, 0, keepdims=False
+                ),
+                tree,
+            )
 
         act_dt = jnp.dtype(cfg.dtype)
-        zeros_mb = v(jnp.zeros((mb, T, D), act_dt))
+        zeros_mb = vary(jnp.zeros((mb, T, D), act_dt))
         carry0 = (
             zeros_mb,  # act: activation arriving from the previous stage
             zeros_mb,  # gin: cotangent arriving from the next stage
-            v(jnp.zeros((buf_n, mb, T, D), act_dt)),
+            vary(jnp.zeros((buf_n, mb, T, D), act_dt)),
             jax.tree_util.tree_map(jnp.zeros_like, stages_loc),
             jax.tree_util.tree_map(jnp.zeros_like, head_loc),
             jax.tree_util.tree_map(jnp.zeros_like, emb_loc),
-            v(jnp.float32(0.0)),  # loss accumulator (last stage)
+            vary(jnp.float32(0.0)),  # loss accumulator (last stage)
         )
 
         def tick(carry, t):
             act, gin, buf, gstage, ghead, gemb, loss_acc = carry
             last = idx == pp - 1
 
-            # -- forward slot: microbatch jf enters this stage
-            jf = t - idx
-            fwd_on = (jf >= 0) & (jf < M)
+            # -- forward slot: unique (group g, lane i, chunk q) for this
+            # (device, tick): u = g*vP + q*P + i
+            u = t - idx
+            i_f = u % pp
+            r_f = u // pp
+            q_f = r_f % v
+            jf = (r_f // v) * pp + i_f
+            fwd_on = (u >= 0) & (jf < M)
             jf_c = jnp.clip(jf, 0, M - 1)
+            q_f_c = jnp.clip(q_f, 0, v - 1)
             tok_mb = lax.dynamic_index_in_dim(
                 tok_loc, jf_c, 0, keepdims=False
             )
             inject = embed_tokens({"embed": emb_loc}, tok_mb, cfg)
-            x_in = jnp.where(idx == 0, inject.astype(act_dt), act)
-            y = stage_fn(stages_loc, x_in)
+            x_in = jnp.where(
+                (idx == 0) & (q_f == 0), inject.astype(act_dt), act
+            )
+            y = stage_fn(chunk_of(stages_loc, q_f_c), x_in)
             buf = jnp.where(
                 fwd_on,
                 lax.dynamic_update_index_in_dim(buf, x_in, t % buf_n, 0),
                 buf,
             )
 
-            # -- last stage: loss -> d(loss)/dy the same tick (the "1B"
-            # of this tick consumes it below, jb == jf there)
+            # -- global last stage (chunk v-1 on device P-1): loss ->
+            # d(loss)/dy the same tick (the "1B" of this tick consumes it
+            # below: jb == jf and q_b == v-1 there)
             t_mb = lax.dynamic_index_in_dim(
                 tgt_loc, jf_c, 0, keepdims=False
             )
             loss_mb, (dhead, dy_head) = jax.value_and_grad(
                 head_loss, argnums=(0, 1)
             )(head_loc, y, t_mb)
-            loss_on = last & fwd_on
+            loss_on = last & fwd_on & (q_f == v - 1)
             loss_w = loss_on.astype(jnp.float32)
             loss_acc = loss_acc + loss_mb * loss_w
             # mask by scalar multiply, not where-select: a 0/1 scale
@@ -406,25 +556,59 @@ def pipeline_value_and_grad_1f1b(
                 lambda g, d: g + d * loss_w.astype(d.dtype), ghead, dhead
             )
 
-            # -- backward slot: microbatch jb leaves this stage
-            jb = t - 2 * (pp - 1) + idx
-            bwd_on = (jb >= 0) & (jb < M)
+            # -- backward slot: wb = g*vP + (2v-2-q)*P + i
+            wb = t + idx - 2 * (pp - 1)
+            i_b = wb % pp
+            r_b = wb // pp
+            q_b = (2 * v - 2 - r_b) % v
+            g_b = (r_b - (2 * v - 2 - q_b)) // v
+            jb = g_b * pp + i_b
+            bwd_on = (wb >= 0) & (g_b >= 0) & (jb < M)
             jb_c = jnp.clip(jb, 0, M - 1)
-            x_saved = lax.dynamic_index_in_dim(
-                buf, (idx + jb_c) % buf_n, 0, keepdims=False
+            q_b_c = jnp.clip(q_b, 0, v - 1)
+            # the forward of (jb, q_b) on this device ran at
+            # t - (2v-2-2q_b)*P - 2(P-1-idx); its input sits at that
+            # tick's ring-buffer slot
+            t_f_saved = (
+                t - (2 * v - 2 - 2 * q_b_c) * pp - 2 * (pp - 1 - idx)
             )
-            dy = jnp.where(last, dy_head.astype(x_saved.dtype), gin)
-            _, svjp = jax.vjp(stage_fn, stages_loc, x_saved)
+            x_saved = lax.dynamic_index_in_dim(
+                buf, t_f_saved % buf_n, 0, keepdims=False
+            )
+            dy = jnp.where(
+                last & (q_b == v - 1), dy_head.astype(x_saved.dtype), gin
+            )
+            chunk_b = chunk_of(stages_loc, q_b_c)
+            _, svjp = jax.vjp(stage_fn, chunk_b, x_saved)
             dstage, dxi = svjp(dy)
             bwd_w = bwd_on.astype(jnp.float32)
-            gstage = jax.tree_util.tree_map(
-                lambda g, d: g + d * bwd_w.astype(d.dtype), gstage, dstage
-            )
+            if v == 1:
+                gstage = jax.tree_util.tree_map(
+                    lambda g, d: g + d * bwd_w.astype(d.dtype),
+                    gstage,
+                    dstage,
+                )
+            else:
+                # accumulate into chunk q_b's rows (a masked-off tick
+                # writes back chunk + 0 — a no-op)
+                gstage = jax.tree_util.tree_map(
+                    lambda g, d: lax.dynamic_update_index_in_dim(
+                        g,
+                        lax.dynamic_index_in_dim(
+                            g, q_b_c, 0, keepdims=False
+                        )
+                        + d * bwd_w.astype(d.dtype),
+                        q_b_c,
+                        0,
+                    ),
+                    gstage,
+                    dstage,
+                )
 
-            # -- embedding backward (stage 0): the gather's vjp is a
-            # scatter-add touching only the mb*T gathered rows — never a
-            # dense [vocab, D] cotangent
-            emb_w = ((idx == 0) & bwd_on).astype(jnp.float32)
+            # -- embedding backward (global stage 0 = chunk 0, device 0):
+            # the gather's vjp is a scatter-add touching only the mb*T
+            # gathered rows — never a dense [vocab, D] cotangent
+            emb_w = ((idx == 0) & (q_b == 0) & bwd_on).astype(jnp.float32)
             tok_jb = lax.dynamic_index_in_dim(
                 tok_loc, jb_c, 0, keepdims=False
             )
@@ -490,14 +674,14 @@ def pipeline_value_and_grad_1f1b(
 # training
 # ---------------------------------------------------------------------------
 def pipeline_state_shardings(
-    cfg: TransformerConfig, mesh, tx, rules=None
+    cfg: TransformerConfig, mesh, tx, rules=None, virtual: int = 1
 ) -> TrainState:
     pp = mesh.shape["pp"]
-    p_sh = pipeline_param_shardings(cfg, mesh, pp, rules)
+    p_sh = pipeline_param_shardings(cfg, mesh, pp, rules, virtual)
     replicated = NamedSharding(mesh, P())
     params_shape = jax.eval_shape(
         lambda: stack_pipeline_params(
-            init_params(jax.random.PRNGKey(0), cfg), pp
+            init_params(jax.random.PRNGKey(0), cfg), pp, virtual
         )
     )
     opt_sh = opt_state_shardings(params_shape, p_sh, tx, mesh)
@@ -505,16 +689,16 @@ def pipeline_state_shardings(
 
 
 def init_pipeline_state(
-    key, cfg: TransformerConfig, mesh, tx, rules=None
+    key, cfg: TransformerConfig, mesh, tx, rules=None, virtual: int = 1
 ) -> Tuple[TrainState, TrainState]:
     """Initialize stacked pipeline params/opt state directly into their
     shardings (stage s's rows materialize on stage s's devices)."""
     pp = mesh.shape["pp"]
-    _check_pipeline_cfg(cfg, pp)
-    sh = pipeline_state_shardings(cfg, mesh, tx, rules)
+    _check_pipeline_cfg(cfg, pp, virtual)
+    sh = pipeline_state_shardings(cfg, mesh, tx, rules, virtual)
 
     def _init(key):
-        return stack_pipeline_params(init_params(key, cfg), pp)
+        return stack_pipeline_params(init_params(key, cfg), pp, virtual)
 
     params = jax.jit(_init, out_shardings=sh.params)(key)
     opt_state = jax.jit(tx.init, out_shardings=sh.opt_state)(params)
@@ -530,21 +714,29 @@ def build_pipeline_train_step(
     rules: Optional[ShardingRules] = None,
     donate: bool = True,
     schedule: str = "gpipe",
+    virtual_stages: int = 2,
 ):
     """jitted (state, tokens, targets) → (state, metrics) over pp.
 
-    ``schedule``: "gpipe" (AD backward, O(M) activation footprint) or
-    "1f1b" (manual backward, O(P) footprint — see module docstring).
+    ``schedule``: "gpipe" (AD backward, O(M) activation footprint),
+    "1f1b" (manual backward, O(P) footprint), or "interleaved"
+    (1F1B with ``virtual_stages`` chunks per device — smaller bubble,
+    O(vP) footprint; state must come from
+    ``init_pipeline_state(..., virtual=virtual_stages)``).
     """
     import optax
 
-    if schedule not in ("gpipe", "1f1b"):
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    virtual = virtual_stages if schedule == "interleaved" else 1
+    if schedule == "interleaved" and virtual < 2:
+        raise ValueError("interleaved schedule needs virtual_stages >= 2")
 
     def train_step(state: TrainState, tokens, targets):
-        if schedule == "1f1b":
+        if schedule in ("1f1b", "interleaved"):
             loss, grads = pipeline_value_and_grad_1f1b(
-                state.params, tokens, targets, cfg, mesh, num_microbatches
+                state.params, tokens, targets, cfg, mesh,
+                num_microbatches, virtual=virtual,
             )
         else:
 

@@ -104,7 +104,9 @@ def _build(
             strategy.num_microbatches,
             donate=donate,
             schedule=strategy.pp_schedule,
-            virtual_stages=strategy.pp_virtual,
+            # the resolved value: one source of truth with the state
+            # layout below ([pp, v, lc] iff virtual > 1)
+            virtual_stages=virtual,
         )
         shardings = pipeline_state_shardings(cfg, mesh, tx, virtual=virtual)
 

@@ -39,7 +39,9 @@ class Strategy:
         bits = ["x".join(f"{a}{s}" for a, s in axes.items())]
         if self.num_microbatches > 1:
             bits.append(f"mb{self.num_microbatches}")
-        sched = "1f1b" if "1f1b" in self.opts else self.pp_schedule
+        # pp_schedule is kept in sync by the opt registry ("1f1b"/
+        # "interleaved" entries rewrite it), so it is the single truth
+        sched = self.pp_schedule
         if self.mesh.pp > 1 and sched != "gpipe":
             bits.append(
                 f"interleaved{self.pp_virtual}"

@@ -329,6 +329,174 @@ void kv_sparse_group_ftrl(void* h, const int64_t* keys, int64_t nkeys,
   }
 }
 
+// Fused sparse Group Adam (parity: training_ops.cc
+// KvVariableGroupSparseApplyAdamNewV2, python group_adam.py — the
+// "Adaptive Optimizers with Sparse Group Lasso" construction): Adam
+// moments drive an FTRL-style linear accumulator, and the weight is the
+// CLOSED-FORM solution of the proximal problem with elementwise L1,
+// ridge L2 and row-group L2,1 penalties — rarely-useful keys collapse to
+// exact zero rows. Slots: 0=linear, 1=m, 2=v (num_slots >= 3).
+void kv_sparse_group_adam(void* h, const int64_t* keys, int64_t nkeys,
+                          const float* grads, float lr, float beta1,
+                          float beta2, float eps, float l1, float l2,
+                          float l21, int64_t step, int64_t now) {
+  Store* s = static_cast<Store*>(h);
+  const float b1p = __builtin_powf(beta1, (float)step);
+  const float b2p = __builtin_powf(beta2, (float)step);
+  const float alpha = __builtin_sqrtf(1.0f - b2p) / (1.0f - b1p);
+  const float l21_norm =
+      l21 * __builtin_sqrtf(static_cast<float>(s->dim));
+  for (int64_t i = 0; i < nkeys; ++i) {
+    Bucket& b = s->bucket(keys[i]);
+    std::lock_guard<std::mutex> g(b.mu);
+    Row& row = find_or_create(s, b, keys[i], now, nullptr);
+    float* w = row.data.data();
+    float* linear = w + s->dim;
+    float* m = w + 2 * s->dim;
+    float* v = w + 3 * s->dim;
+    const float* gr = grads + i * s->dim;
+    float norm2 = 0.0f;
+    for (int64_t d = 0; d < s->dim; ++d) {
+      m[d] = beta1 * m[d] + (1.0f - beta1) * gr[d];
+      const float new_v =
+          beta2 * v[d] + (1.0f - beta2) * gr[d] * gr[d];
+      // the reference drops eps from the sigma term after step 1
+      // (beta1 > beta1^t), keeping it only for the t=1 edge
+      const float sigma =
+          (__builtin_sqrtf(new_v) - __builtin_sqrtf(v[d]) +
+           (beta1 > b1p ? 0.0f : eps)) /
+          lr;
+      linear[d] += alpha * m[d] - sigma * w[d];
+      v[d] = new_v;
+      const float clipped =
+          linear[d] > l1 ? l1 : (linear[d] < -l1 ? -l1 : linear[d]);
+      const float u = clipped - linear[d];  // soft-thresholded direction
+      w[d] = u;  // stash; scaled (or zeroed) below
+      norm2 += u * u;
+    }
+    const float norm = __builtin_sqrtf(norm2);
+    if (norm > l21_norm) {
+      const float scale = 1.0f - l21_norm / norm;
+      for (int64_t d = 0; d < s->dim; ++d) {
+        const float y =
+            (__builtin_sqrtf(v[d]) + eps) / lr + 2.0f * l2;
+        w[d] = w[d] * scale / y;
+      }
+    } else {
+      // group lasso zeroes the whole row (the reference blacklists the
+      // key; here the zero row IS the tombstone — eviction reclaims it)
+      std::memset(w, 0, sizeof(float) * s->dim);
+    }
+    row.ts = now;
+    row.version = s->next_version();
+  }
+}
+
+// Fused sparse LAMB (parity: training_ops.cc sparse Lamb family /
+// python lamb_optimizer.py): Adam direction with decoupled weight decay,
+// rescaled per EMBEDDING ROW by the trust ratio ||w|| / ||update|| — the
+// row is the natural "layer" of a kv table. Slots: 0=m, 1=v.
+void kv_sparse_lamb(void* h, const int64_t* keys, int64_t nkeys,
+                    const float* grads, float lr, float beta1,
+                    float beta2, float eps, float weight_decay,
+                    int64_t step, int64_t now) {
+  Store* s = static_cast<Store*>(h);
+  const float bc1 = 1.0f - __builtin_powf(beta1, (float)step);
+  const float bc2 = 1.0f - __builtin_powf(beta2, (float)step);
+  std::vector<float> r(s->dim);
+  for (int64_t i = 0; i < nkeys; ++i) {
+    Bucket& b = s->bucket(keys[i]);
+    std::lock_guard<std::mutex> g(b.mu);
+    Row& row = find_or_create(s, b, keys[i], now, nullptr);
+    float* w = row.data.data();
+    float* m = w + s->dim;
+    float* v = w + 2 * s->dim;
+    const float* gr = grads + i * s->dim;
+    float wnorm2 = 0.0f, rnorm2 = 0.0f;
+    for (int64_t d = 0; d < s->dim; ++d) {
+      m[d] = beta1 * m[d] + (1.0f - beta1) * gr[d];
+      v[d] = beta2 * v[d] + (1.0f - beta2) * gr[d] * gr[d];
+      const float mhat = m[d] / bc1;
+      const float vhat = v[d] / bc2;
+      r[d] = mhat / (__builtin_sqrtf(vhat) + eps) + weight_decay * w[d];
+      wnorm2 += w[d] * w[d];
+      rnorm2 += r[d] * r[d];
+    }
+    const float wn = __builtin_sqrtf(wnorm2);
+    const float rn = __builtin_sqrtf(rnorm2);
+    const float ratio = (wn > 0.0f && rn > 0.0f) ? wn / rn : 1.0f;
+    for (int64_t d = 0; d < s->dim; ++d) w[d] -= lr * ratio * r[d];
+    row.ts = now;
+    row.version = s->next_version();
+  }
+}
+
+// Fused sparse AdaBelief (parity: atorch low-bit optim family's
+// AdaBelief / tfplus adabelief): second moment tracks the variance of
+// the gradient around its EMA — (g - m)^2 — so steps grow where the
+// gradient is consistent and shrink where it is noisy.
+// Slots: 0=m, 1=s.
+void kv_sparse_adabelief(void* h, const int64_t* keys, int64_t nkeys,
+                         const float* grads, float lr, float beta1,
+                         float beta2, float eps, int64_t step,
+                         int64_t now) {
+  Store* s_ = static_cast<Store*>(h);
+  const float bc1 = 1.0f - __builtin_powf(beta1, (float)step);
+  const float bc2 = 1.0f - __builtin_powf(beta2, (float)step);
+  for (int64_t i = 0; i < nkeys; ++i) {
+    Bucket& b = s_->bucket(keys[i]);
+    std::lock_guard<std::mutex> g(b.mu);
+    Row& row = find_or_create(s_, b, keys[i], now, nullptr);
+    float* w = row.data.data();
+    float* m = w + s_->dim;
+    float* sv = w + 2 * s_->dim;
+    const float* gr = grads + i * s_->dim;
+    for (int64_t d = 0; d < s_->dim; ++d) {
+      m[d] = beta1 * m[d] + (1.0f - beta1) * gr[d];
+      const float diff = gr[d] - m[d];
+      sv[d] = beta2 * sv[d] + (1.0f - beta2) * diff * diff + eps;
+      const float mhat = m[d] / bc1;
+      const float shat = sv[d] / bc2;
+      w[d] -= lr * mhat / (__builtin_sqrtf(shat) + eps);
+    }
+    row.ts = now;
+    row.version = s_->next_version();
+  }
+}
+
+// Fused sparse AMSGrad (parity: tfplus adam family with amsgrad):
+// Adam with a monotone max over the second moment, so the effective LR
+// never grows back after a large gradient. Slots: 0=m, 1=v, 2=vmax
+// (num_slots >= 3).
+void kv_sparse_amsgrad(void* h, const int64_t* keys, int64_t nkeys,
+                       const float* grads, float lr, float beta1,
+                       float beta2, float eps, int64_t step,
+                       int64_t now) {
+  Store* s = static_cast<Store*>(h);
+  const float bc1 = 1.0f - __builtin_powf(beta1, (float)step);
+  const float bc2 = 1.0f - __builtin_powf(beta2, (float)step);
+  for (int64_t i = 0; i < nkeys; ++i) {
+    Bucket& b = s->bucket(keys[i]);
+    std::lock_guard<std::mutex> g(b.mu);
+    Row& row = find_or_create(s, b, keys[i], now, nullptr);
+    float* w = row.data.data();
+    float* m = w + s->dim;
+    float* v = w + 2 * s->dim;
+    float* vmax = w + 3 * s->dim;
+    const float* gr = grads + i * s->dim;
+    for (int64_t d = 0; d < s->dim; ++d) {
+      m[d] = beta1 * m[d] + (1.0f - beta1) * gr[d];
+      v[d] = beta2 * v[d] + (1.0f - beta2) * gr[d] * gr[d];
+      if (v[d] > vmax[d]) vmax[d] = v[d];
+      const float mhat = m[d] / bc1;
+      const float vhat = vmax[d] / bc2;
+      w[d] -= lr * mhat / (__builtin_sqrtf(vhat) + eps);
+    }
+    row.ts = now;
+    row.version = s->next_version();
+  }
+}
+
 // Export rows whose version > since (0 = full export). Two-phase: count,
 // then fill caller-allocated buffers. Rows: full row incl. slots.
 int64_t kv_export_count(void* h, uint64_t since) {

@@ -77,6 +77,19 @@ def _load_library() -> ctypes.CDLL:
         lib.kv_sparse_group_ftrl.argtypes = [
             p, I64P, i64, F32P, f32, f32, f32, f32, i64,
         ]
+        lib.kv_sparse_group_adam.argtypes = [
+            p, I64P, i64, F32P, f32, f32, f32, f32, f32, f32, f32,
+            i64, i64,
+        ]
+        lib.kv_sparse_lamb.argtypes = [
+            p, I64P, i64, F32P, f32, f32, f32, f32, f32, i64, i64,
+        ]
+        lib.kv_sparse_adabelief.argtypes = [
+            p, I64P, i64, F32P, f32, f32, f32, f32, i64, i64,
+        ]
+        lib.kv_sparse_amsgrad.argtypes = [
+            p, I64P, i64, F32P, f32, f32, f32, f32, i64, i64,
+        ]
         lib.kv_export_count.restype = i64
         lib.kv_export_count.argtypes = [p, u64]
         lib.kv_export.restype = i64
@@ -150,25 +163,21 @@ class KvEmbeddingStore:
 
     def scatter(self, keys, values, op: str = "update"):
         k = self._keys(keys)
-        v = np.ascontiguousarray(values, dtype=np.float32).reshape(
-            len(k), self.dim
+        self._lib.kv_scatter(
+            self._h, k, len(k), self._grads(k, values),
+            _SCATTER_OPS[op], _now(),
         )
-        self._lib.kv_scatter(self._h, k, len(k), v, _SCATTER_OPS[op], _now())
 
     def sparse_adagrad(self, keys, grads, lr: float, eps: float = 1e-8):
         k = self._keys(keys)
-        g = np.ascontiguousarray(grads, dtype=np.float32).reshape(
-            len(k), self.dim
+        self._lib.kv_sparse_adagrad(
+            self._h, k, len(k), self._grads(k, grads), lr, eps, _now()
         )
-        self._lib.kv_sparse_adagrad(self._h, k, len(k), g, lr, eps, _now())
 
     def sparse_momentum(self, keys, grads, lr: float, momentum: float = 0.9):
         k = self._keys(keys)
-        g = np.ascontiguousarray(grads, dtype=np.float32).reshape(
-            len(k), self.dim
-        )
         self._lib.kv_sparse_momentum(
-            self._h, k, len(k), g, lr, momentum, _now()
+            self._h, k, len(k), self._grads(k, grads), lr, momentum, _now()
         )
 
     def sparse_adam(
@@ -185,16 +194,11 @@ class KvEmbeddingStore:
         ``step`` is the 1-based update count for bias correction."""
         if self.num_slots < 2:
             raise ValueError("sparse_adam needs num_slots >= 2 (m, v)")
-        if step < 1:
-            # step=0 would make the bias correction 1-beta^0 = 0 and
-            # divide every update into inf/NaN
-            raise ValueError(f"step must be >= 1 (got {step})")
+        self._check_step(step)
         k = self._keys(keys)
-        g = np.ascontiguousarray(grads, dtype=np.float32).reshape(
-            len(k), self.dim
-        )
         self._lib.kv_sparse_adam(
-            self._h, k, len(k), g, lr, beta1, beta2, eps, step, _now()
+            self._h, k, len(k), self._grads(k, grads), lr, beta1,
+            beta2, eps, step, _now(),
         )
 
     def sparse_group_ftrl(
@@ -212,11 +216,116 @@ class KvEmbeddingStore:
         if self.num_slots < 2:
             raise ValueError("sparse_group_ftrl needs num_slots >= 2")
         k = self._keys(keys)
-        g = np.ascontiguousarray(grads, dtype=np.float32).reshape(
+        self._lib.kv_sparse_group_ftrl(
+            self._h, k, len(k), self._grads(k, grads), alpha, beta,
+            l1, l21, _now(),
+        )
+
+    def _grads(self, k, grads) -> np.ndarray:
+        return np.ascontiguousarray(grads, dtype=np.float32).reshape(
             len(k), self.dim
         )
-        self._lib.kv_sparse_group_ftrl(
-            self._h, k, len(k), g, alpha, beta, l1, l21, _now()
+
+    @staticmethod
+    def _check_step(step: int):
+        if step < 1:
+            raise ValueError(f"step must be >= 1 (got {step})")
+
+    def sparse_group_adam(
+        self,
+        keys,
+        grads,
+        lr: float,
+        step: int,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+        eps: float = 1e-8,
+        l1: float = 0.0,
+        l2: float = 0.0,
+        l21: float = 0.0,
+    ):
+        """Fused Group Adam (slots: linear, m, v; needs num_slots >= 3)
+        — Adam moments feeding an FTRL-style linear accumulator with a
+        closed-form L1/L2/L2,1 proximal solve; ``l21 > 0`` zeroes whole
+        rows (parity: training_ops.cc GroupSparseApplyAdamNewV2,
+        group_adam.py:272)."""
+        if self.num_slots < 3:
+            raise ValueError(
+                "sparse_group_adam needs num_slots >= 3 (linear, m, v)"
+            )
+        self._check_step(step)
+        k = self._keys(keys)
+        self._lib.kv_sparse_group_adam(
+            self._h, k, len(k), self._grads(k, grads), lr, beta1,
+            beta2, eps, l1, l2, l21, step, _now(),
+        )
+
+    def sparse_lamb(
+        self,
+        keys,
+        grads,
+        lr: float,
+        step: int,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+        eps: float = 1e-6,
+        weight_decay: float = 0.0,
+    ):
+        """Fused sparse LAMB (slots: m, v; needs num_slots >= 2): Adam
+        direction + decoupled decay, rescaled per embedding row by the
+        trust ratio ||w||/||update||."""
+        if self.num_slots < 2:
+            raise ValueError("sparse_lamb needs num_slots >= 2 (m, v)")
+        self._check_step(step)
+        k = self._keys(keys)
+        self._lib.kv_sparse_lamb(
+            self._h, k, len(k), self._grads(k, grads), lr, beta1,
+            beta2, eps, weight_decay, step, _now(),
+        )
+
+    def sparse_adabelief(
+        self,
+        keys,
+        grads,
+        lr: float,
+        step: int,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+        eps: float = 1e-12,
+    ):
+        """Fused sparse AdaBelief (slots: m, s; needs num_slots >= 2):
+        the second moment tracks (g - m)^2 — gradient variance around
+        its EMA — instead of g^2."""
+        if self.num_slots < 2:
+            raise ValueError("sparse_adabelief needs num_slots >= 2")
+        self._check_step(step)
+        k = self._keys(keys)
+        self._lib.kv_sparse_adabelief(
+            self._h, k, len(k), self._grads(k, grads), lr, beta1,
+            beta2, eps, step, _now(),
+        )
+
+    def sparse_amsgrad(
+        self,
+        keys,
+        grads,
+        lr: float,
+        step: int,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+        eps: float = 1e-8,
+    ):
+        """Fused sparse AMSGrad (slots: m, v, vmax; needs
+        num_slots >= 3): Adam with a monotone max on the second moment."""
+        if self.num_slots < 3:
+            raise ValueError(
+                "sparse_amsgrad needs num_slots >= 3 (m, v, vmax)"
+            )
+        self._check_step(step)
+        k = self._keys(keys)
+        self._lib.kv_sparse_amsgrad(
+            self._h, k, len(k), self._grads(k, grads), lr, beta1,
+            beta2, eps, step, _now(),
         )
 
     def meta(self, keys) -> Tuple[np.ndarray, np.ndarray]:
@@ -365,6 +474,68 @@ class ShardedKvEmbedding:
     ):
         self._per_shard(
             "sparse_group_ftrl", keys, grads, alpha, beta, l1, l21
+        )
+
+    def sparse_group_adam(
+        self,
+        keys,
+        grads,
+        lr: float,
+        step: int,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+        eps: float = 1e-8,
+        l1: float = 0.0,
+        l2: float = 0.0,
+        l21: float = 0.0,
+    ):
+        self._per_shard(
+            "sparse_group_adam", keys, grads, lr, step, beta1, beta2,
+            eps, l1, l2, l21,
+        )
+
+    def sparse_lamb(
+        self,
+        keys,
+        grads,
+        lr: float,
+        step: int,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+        eps: float = 1e-6,
+        weight_decay: float = 0.0,
+    ):
+        self._per_shard(
+            "sparse_lamb", keys, grads, lr, step, beta1, beta2, eps,
+            weight_decay,
+        )
+
+    def sparse_adabelief(
+        self,
+        keys,
+        grads,
+        lr: float,
+        step: int,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+        eps: float = 1e-12,
+    ):
+        self._per_shard(
+            "sparse_adabelief", keys, grads, lr, step, beta1, beta2, eps
+        )
+
+    def sparse_amsgrad(
+        self,
+        keys,
+        grads,
+        lr: float,
+        step: int,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+        eps: float = 1e-8,
+    ):
+        self._per_shard(
+            "sparse_amsgrad", keys, grads, lr, step, beta1, beta2, eps
         )
 
     def meta(self, keys) -> Tuple[np.ndarray, np.ndarray]:

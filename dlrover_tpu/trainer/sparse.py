@@ -70,6 +70,22 @@ class SparseTrainer:
             self.embedding.sparse_momentum(keys, grads, lr=self._lr)
         elif self._opt == "group_ftrl":
             self.embedding.sparse_group_ftrl(keys, grads, alpha=self._lr)
+        elif self._opt == "group_adam":
+            self.embedding.sparse_group_adam(
+                keys, grads, lr=self._lr, step=self.step + 1
+            )
+        elif self._opt == "lamb":
+            self.embedding.sparse_lamb(
+                keys, grads, lr=self._lr, step=self.step + 1
+            )
+        elif self._opt == "adabelief":
+            self.embedding.sparse_adabelief(
+                keys, grads, lr=self._lr, step=self.step + 1
+            )
+        elif self._opt == "amsgrad":
+            self.embedding.sparse_amsgrad(
+                keys, grads, lr=self._lr, step=self.step + 1
+            )
         else:
             raise ValueError(f"unknown sparse optimizer {self._opt!r}")
 

@@ -49,9 +49,15 @@ class TestSparseTrainer:
         ]
         assert losses[-1] < losses[0] * 0.6, losses[::8]
 
-    @pytest.mark.parametrize("opt", ["adam", "momentum", "group_ftrl"])
+    @pytest.mark.parametrize(
+        "opt",
+        [
+            "adam", "momentum", "group_ftrl", "group_adam", "lamb",
+            "adabelief", "amsgrad",
+        ],
+    )
     def test_all_sparse_optimizers_run(self, opt):
-        emb = ShardedKvEmbedding(2, DIM, seed=0, num_slots=2)
+        emb = ShardedKvEmbedding(2, DIM, seed=0, num_slots=3)
         t = SparseTrainer(
             emb, jnp.zeros((DIM,)), _dense_step_factory(),
             sparse_optimizer=opt, sparse_lr=0.05,

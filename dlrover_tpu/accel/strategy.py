@@ -39,9 +39,16 @@ class Strategy:
         bits = ["x".join(f"{a}{s}" for a, s in axes.items())]
         if self.num_microbatches > 1:
             bits.append(f"mb{self.num_microbatches}")
-        # pp_schedule is kept in sync by the opt registry ("1f1b"/
-        # "interleaved" entries rewrite it), so it is the single truth
-        sched = self.pp_schedule
+        # the opt registry rewrites pp_schedule when opts are APPLIED;
+        # a candidate logged before apply_optimizations still carries
+        # the schedule only in opts — honor either source
+        sched = (
+            "interleaved"
+            if "interleaved" in self.opts
+            else "1f1b"
+            if "1f1b" in self.opts
+            else self.pp_schedule
+        )
         if self.mesh.pp > 1 and sched != "gpipe":
             bits.append(
                 f"interleaved{self.pp_virtual}"

@@ -1,0 +1,193 @@
+"""Cluster-level Brain optimize algorithms.
+
+Parity: the reference Brain's pluggable algorithm registry
+(dlrover/go/brain/pkg/optimizer/implementation/optalgorithm/
+optimize_algorithm.go, registerOptimizeAlgorithm) and the three
+algorithm families that give it cluster-level intelligence the job-local
+optimizer cannot have:
+
+- ``cold_start_resources`` — optimize_job_worker_create_resource.go /
+  optimize_job_worker_resource.go:400: a BRAND-NEW job (zero samples of
+  its own) is resourced from *completed jobs'* histories — memory from
+  the fleet's observed per-worker peaks plus a margin, worker count from
+  the cross-job size→throughput curve walked while the marginal speedup
+  stays worth a node-unit.
+- ``oom_adjust`` — optimize_job_ps_oom_resource.go: a *recent* OOM
+  incident doubles the observed peak (or the incident's own memory
+  reading). Merged INTO whatever plan else applies (it owns only the
+  memory field), and time-windowed so one startup OOM cannot shadow the
+  throughput algorithms for the rest of the job's life.
+- ``bad_node_exclusion`` — the hot-PS detection family
+  (optimize_job_hot_ps_resource.go): hostnames that misbehave (oom /
+  failed / sustained-hot events) across MULTIPLE jobs are a cluster
+  fact, not a job fact — they go on the exclude list of every plan.
+  Condemnation decays: only events inside ``BAD_NODE_WINDOW_S`` count
+  (an OOM from months ago is a workload fact, not a hardware fact).
+
+All algorithms are pure functions over the datastore protocol the
+servicer implements (``job_metrics`` / ``fleet_size_curve`` /
+``node_events``), so they are unit-testable without the gRPC surface.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Protocol, Tuple
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.resource.optimizer import ResourcePlan
+
+# cold-start knobs (parity: DefaultMemoryMarginPercent and the speedup
+# thresholds in optimplcomm)
+MEMORY_MARGIN = 0.2
+MIN_SPEEDUP_PER_UNIT = 0.6
+DEFAULT_COLD_MEMORY_MB = 8192
+# incident windows: OOMs older than this no longer drive memory bumps;
+# node condemnation decays after BAD_NODE_WINDOW_S
+RECENT_OOM_WINDOW_S = 6 * 3600.0
+BAD_NODE_WINDOW_S = 7 * 24 * 3600.0
+# bad-node knobs: an incident in >= MIN_JOBS distinct jobs condemns a host
+BAD_NODE_MIN_JOBS = 2
+HOT_CPU_THRESHOLD = 90.0
+HOT_MIN_EVENTS = 3
+
+
+class Datastore(Protocol):  # pragma: no cover - typing only
+    def job_metrics(
+        self, job: str, last_n: int = 0
+    ) -> List[comm.JobMetricsSample]: ...
+
+    def fleet_size_curve(self) -> Tuple[Dict[int, float], float, int]: ...
+
+    def node_events(
+        self, job: str = "", event: str = "", since_ts: float = 0.0
+    ) -> List[comm.BrainNodeEventReport]: ...
+
+
+def cold_start_resources(
+    ds: Datastore, job: str, node_unit: int = 1
+) -> Optional[ResourcePlan]:
+    """Resource a job that has no history of its own from the fleet's
+    completed jobs (one SQL aggregate — not a per-job series fetch).
+    Returns None when there is no completed-job history."""
+    speed_by_size, peak_mb, n_jobs = ds.fleet_size_curve()
+    if n_jobs == 0:
+        return None
+
+    plan = ResourcePlan()
+    if speed_by_size:
+        # walk the size curve while the marginal speedup stays worth it —
+        # the fit the job-local optimizer cannot do with zero samples
+        sizes = sorted(speed_by_size)
+        pick = sizes[0]
+        for prev, cur in zip(sizes, sizes[1:]):
+            actual = speed_by_size[cur] / max(speed_by_size[prev], 1e-9)
+            linear = cur / prev
+            if actual < 1 + MIN_SPEEDUP_PER_UNIT * (linear - 1):
+                break
+            pick = cur
+        pick = max(node_unit, pick - pick % node_unit)
+        plan.worker_count = pick
+    plan.worker_memory_mb = int(
+        peak_mb * (1 + MEMORY_MARGIN) if peak_mb > 0 else DEFAULT_COLD_MEMORY_MB
+    )
+    plan.reason = (
+        f"cold-start fit from {n_jobs} completed jobs "
+        f"(sizes seen: {sorted(speed_by_size) or 'none'})"
+    )
+    return plan
+
+
+def oom_adjust(
+    ds: Datastore,
+    job: str,
+    now: Optional[float] = None,
+    samples: Optional[List[comm.JobMetricsSample]] = None,
+) -> Optional[ResourcePlan]:
+    """An OOM incident means the limit, not the workload, was wrong:
+    recommend 2x the largest of (incident reading, observed per-worker
+    peak). None when the job has no *recent* OOM events — stale
+    incidents must not shadow the throughput algorithms forever.
+    ``samples``: the job's series if the caller already fetched it."""
+    now = time.time() if now is None else now
+    ooms = ds.node_events(
+        job=job, event="oom", since_ts=now - RECENT_OOM_WINDOW_S
+    )
+    if not ooms:
+        return None
+    base = max((e.memory_mb for e in ooms), default=0)
+    for s in samples if samples is not None else ds.job_metrics(job):
+        if s.alive_nodes > 0:
+            base = max(base, s.total_memory_mb // s.alive_nodes)
+    if base <= 0:
+        base = DEFAULT_COLD_MEMORY_MB
+    return ResourcePlan(
+        worker_memory_mb=int(base * 2),
+        reason=f"oom adjust: {len(ooms)} OOM event(s), 2x of {base} MB",
+    )
+
+
+def bad_node_exclusion(
+    ds: Datastore, now: Optional[float] = None
+) -> Tuple[str, ...]:
+    """Hostnames condemned by the CLUSTER's recent evidence: an
+    oom/failed event in >= BAD_NODE_MIN_JOBS distinct jobs, or sustained
+    hot-cpu events (>= HOT_MIN_EVENTS at >= HOT_CPU_THRESHOLD%), all
+    within ``BAD_NODE_WINDOW_S``."""
+    now = time.time() if now is None else now
+    jobs_by_host: Dict[str, set] = {}
+    hot_counts: Dict[str, int] = {}
+    for e in ds.node_events(since_ts=now - BAD_NODE_WINDOW_S):
+        if not e.hostname:
+            continue
+        if e.event in ("oom", "failed"):
+            jobs_by_host.setdefault(e.hostname, set()).add(e.job_name)
+        elif e.event == "hot" and e.cpu_percent >= HOT_CPU_THRESHOLD:
+            hot_counts[e.hostname] = hot_counts.get(e.hostname, 0) + 1
+    bad = {
+        h
+        for h, jobs in jobs_by_host.items()
+        if len(jobs) >= BAD_NODE_MIN_JOBS
+    }
+    bad |= {h for h, n in hot_counts.items() if n >= HOT_MIN_EVENTS}
+    return tuple(sorted(bad))
+
+
+def run_algorithms(
+    ds: Datastore,
+    job: str,
+    node_unit: int = 1,
+    local=None,
+    now: Optional[float] = None,
+) -> ResourcePlan:
+    """The suite the servicer's optimize() runs. Plans MERGE rather than
+    first-match-win: the base plan is cold-start (sample-less job) or
+    the job-local optimizer (job with history); a recent-OOM memory bump
+    overlays only the memory field; cluster bad-node exclusion rides on
+    every plan."""
+    samples = ds.job_metrics(job)
+    if not samples:
+        plan = cold_start_resources(ds, job, node_unit)
+        if plan is not None:
+            logger.info(f"brain cold-start for {job}: {plan.reason}")
+        else:
+            plan = ResourcePlan()
+    else:
+        if local is None:
+            from dlrover_tpu.master.resource.optimizer import (
+                JobResourceOptimizer,
+            )
+
+            local = JobResourceOptimizer(node_unit=node_unit)
+        plan = local.plan_from_samples(samples)
+
+    oom = oom_adjust(ds, job, now=now, samples=samples)
+    if oom is not None and (plan.worker_memory_mb or 0) < (
+        oom.worker_memory_mb or 0
+    ):
+        plan.worker_memory_mb = oom.worker_memory_mb
+        plan.reason = "; ".join(p for p in (plan.reason, oom.reason) if p)
+
+    plan.exclude_nodes = bad_node_exclusion(ds, now=now)
+    return plan

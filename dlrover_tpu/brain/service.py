@@ -24,7 +24,33 @@ CREATE TABLE IF NOT EXISTS job_metrics (
     total_memory_mb INTEGER
 );
 CREATE INDEX IF NOT EXISTS job_metrics_job ON job_metrics (job, ts);
+CREATE TABLE IF NOT EXISTS job_end (
+    job TEXT PRIMARY KEY,
+    exit_reason TEXT NOT NULL,
+    worker_count INTEGER,
+    worker_memory_mb INTEGER
+);
+CREATE TABLE IF NOT EXISTS node_events (
+    job TEXT NOT NULL,
+    ts REAL NOT NULL,
+    node_id INTEGER,
+    hostname TEXT,
+    event TEXT NOT NULL,
+    memory_mb INTEGER,
+    cpu_percent REAL
+);
+CREATE INDEX IF NOT EXISTS node_events_job ON node_events (job, event);
+CREATE INDEX IF NOT EXISTS node_events_ts ON node_events (ts);
 """
+
+# incident rows older than this are useless to every consumer (the
+# widest algorithm window is BAD_NODE_WINDOW_S = 7 days)
+_NODE_EVENT_RETENTION_S = 30 * 24 * 3600.0
+
+# batched prune: run the per-job retention DELETE only once per this
+# many inserts — per-insert pruning held the global lock for a
+# DELETE..NOT IN subquery on every sample (quadratic-ish at the cap)
+_PRUNE_EVERY = 256
 
 
 class BrainServicer:
@@ -37,6 +63,7 @@ class BrainServicer:
         self._conn.executescript(_SCHEMA)
         self._lock = threading.Lock()
         self._max_rows = max_rows_per_job
+        self._inserts_since_prune: dict = {}
 
     # -- RPC entrypoints (bytes in/out) --------------------------------
     def report(self, request_bytes: bytes, context=None) -> bytes:
@@ -46,6 +73,10 @@ class BrainServicer:
         try:
             if isinstance(message, comm.BrainMetricsReport):
                 self.persist_metrics(message.job_name, message.sample)
+            elif isinstance(message, comm.BrainJobEndReport):
+                self.record_job_end(message)
+            elif isinstance(message, comm.BrainNodeEventReport):
+                self.record_node_event(message)
             else:
                 response.success = False
                 response.message = f"unknown {type(message).__name__}"
@@ -66,6 +97,7 @@ class BrainServicer:
                     worker_count=plan.worker_count or 0,
                     worker_memory_mb=plan.worker_memory_mb or 0,
                     reason=plan.reason,
+                    exclude_nodes=list(plan.exclude_nodes),
                 )
                 response.data = comm.serialize_message(result)
             elif isinstance(message, comm.BrainJobMetricsRequest):
@@ -95,14 +127,101 @@ class BrainServicer:
                 ),
             )
             # bound the series per job (parity: the reference prunes by
-            # retention policy in its DB)
+            # retention policy in its DB) — batched: the retention limit
+            # only needs to hold within _PRUNE_EVERY slack
+            n = self._inserts_since_prune.get(job, 0) + 1
+            if n >= _PRUNE_EVERY:
+                self._conn.execute(
+                    "DELETE FROM job_metrics WHERE job = ? AND ts NOT IN "
+                    "(SELECT ts FROM job_metrics WHERE job = ? "
+                    " ORDER BY ts DESC LIMIT ?)",
+                    (job, job, self._max_rows),
+                )
+                n = 0
+            self._inserts_since_prune[job] = n
+            self._conn.commit()
+
+    def record_job_end(self, r: comm.BrainJobEndReport):
+        with self._lock:
             self._conn.execute(
-                "DELETE FROM job_metrics WHERE job = ? AND ts NOT IN "
-                "(SELECT ts FROM job_metrics WHERE job = ? "
-                " ORDER BY ts DESC LIMIT ?)",
-                (job, job, self._max_rows),
+                "INSERT OR REPLACE INTO job_end VALUES (?,?,?,?)",
+                (
+                    r.job_name, r.exit_reason, r.worker_count,
+                    r.worker_memory_mb,
+                ),
             )
             self._conn.commit()
+
+    def record_node_event(self, r: comm.BrainNodeEventReport):
+        import time as _time
+
+        now = _time.time()
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO node_events VALUES (?,?,?,?,?,?,?)",
+                (
+                    r.job_name, now, r.node_id, r.hostname, r.event,
+                    r.memory_mb, r.cpu_percent,
+                ),
+            )
+            # incidents are rare, so per-insert retention is cheap (an
+            # indexed range delete) — unlike the per-sample metric prune
+            self._conn.execute(
+                "DELETE FROM node_events WHERE ts < ?",
+                (now - _NODE_EVENT_RETENTION_S,),
+            )
+            self._conn.commit()
+
+    def fleet_size_curve(self):
+        """(size -> best steps/sec, fleet per-worker memory peak MB,
+        completed-job count) over COMPLETED jobs, as one SQL aggregate —
+        cold start must not fetch every history job's full series."""
+        with self._lock:
+            n_jobs = self._conn.execute(
+                "SELECT COUNT(*) FROM job_end WHERE exit_reason = "
+                "'completed'"
+            ).fetchone()[0]
+            rows = self._conn.execute(
+                "SELECT alive_nodes, MAX(steps_per_sec), "
+                "MAX(total_memory_mb * 1.0 / alive_nodes) "
+                "FROM job_metrics WHERE alive_nodes > 0 AND job IN "
+                "(SELECT job FROM job_end WHERE exit_reason = 'completed') "
+                "GROUP BY alive_nodes"
+            ).fetchall()
+        speed = {
+            int(r[0]): float(r[1]) for r in rows if (r[1] or 0) > 0
+        }
+        peak = max((float(r[2] or 0.0) for r in rows), default=0.0)
+        return speed, peak, int(n_jobs)
+
+    def node_events(
+        self, job: str = "", event: str = "", since_ts: float = 0.0
+    ):
+        query = (
+            "SELECT job, node_id, hostname, event, memory_mb, "
+            "cpu_percent FROM node_events"
+        )
+        clauses, args = [], []
+        if job:
+            clauses.append("job = ?")
+            args.append(job)
+        if event:
+            clauses.append("event = ?")
+            args.append(event)
+        if since_ts:
+            clauses.append("ts >= ?")
+            args.append(since_ts)
+        if clauses:
+            query += " WHERE " + " AND ".join(clauses)
+        with self._lock:
+            rows = self._conn.execute(query, args).fetchall()
+        return [
+            comm.BrainNodeEventReport(
+                job_name=r[0], node_id=r[1] or 0, hostname=r[2] or "",
+                event=r[3], memory_mb=r[4] or 0, cpu_percent=r[5] or 0.0,
+            )
+            for r in rows
+        ]
 
     def job_metrics(
         self, job: str, last_n: int = 0
@@ -136,11 +255,16 @@ class BrainServicer:
 
     # -- optimization algorithms ---------------------------------------
     def optimize(self, job: str, node_unit: int = 1) -> ResourcePlan:
-        """Run the algorithm suite over the job's stored series
-        (parity: optalgorithm/*.go — worker-resource + OOM-adjust)."""
-        samples = self.job_metrics(job)
-        opt = JobResourceOptimizer(node_unit=node_unit)
-        return opt.plan_from_samples(samples)
+        """Run the cluster-level algorithm suite (brain/algorithms.py:
+        OOM-adjust, cross-job cold-start, bad-node exclusion), falling
+        through to the job-local optimizer when no cluster algorithm
+        applies (parity: optalgorithm/*.go)."""
+        from dlrover_tpu.brain.algorithms import run_algorithms
+
+        return run_algorithms(
+            self, job, node_unit,
+            local=JobResourceOptimizer(node_unit=node_unit),
+        )
 
     def close(self):
         with self._lock:
@@ -175,6 +299,39 @@ class BrainClient:
             comm.BrainMetricsReport(job_name=self._job, sample=sample)
         )
 
+    def report_job_end(
+        self,
+        exit_reason: str = "completed",
+        worker_count: int = 0,
+        worker_memory_mb: int = 0,
+    ):
+        """Terminal summary — makes this job part of the history future
+        cold-starts fit from."""
+        return self._client.report(
+            comm.BrainJobEndReport(
+                job_name=self._job, exit_reason=exit_reason,
+                worker_count=worker_count,
+                worker_memory_mb=worker_memory_mb,
+            )
+        )
+
+    def report_node_event(
+        self,
+        node_id: int,
+        hostname: str,
+        event: str,
+        memory_mb: int = 0,
+        cpu_percent: float = 0.0,
+    ):
+        """oom / failed / hot incidents — feeds OOM-adjust and
+        cluster-level bad-node detection."""
+        return self._client.report(
+            comm.BrainNodeEventReport(
+                job_name=self._job, node_id=node_id, hostname=hostname,
+                event=event, memory_mb=memory_mb, cpu_percent=cpu_percent,
+            )
+        )
+
     def optimize(self, node_unit: int = 1) -> ResourcePlan:
         resp = self._client.get(
             comm.BrainOptimizeRequest(
@@ -187,6 +344,7 @@ class BrainClient:
             worker_count=resp.worker_count or None,
             worker_memory_mb=resp.worker_memory_mb or None,
             reason=resp.reason,
+            exclude_nodes=tuple(getattr(resp, "exclude_nodes", ()) or ()),
         )
 
     def get_job_metrics(self, last_n: int = 0) -> List[comm.JobMetricsSample]:

@@ -346,6 +346,34 @@ class BrainOptimizePlan(Message):
     worker_count: int = 0  # 0 = no recommendation
     worker_memory_mb: int = 0
     reason: str = ""
+    # hostnames the scheduler should avoid (cluster-level bad-node /
+    # hot-node detection, parity: hot-PS exclusion in optalgorithm/)
+    exclude_nodes: List[str] = field(default_factory=list)
+
+
+@dataclass
+class BrainJobEndReport(Message):
+    """Terminal summary of a job — the rows cross-job cold-start
+    resourcing fits from (parity: the reference Brain's job_metrics
+    table keyed by ExitReason, optimize_job_worker_create_resource.go)."""
+
+    job_name: str = ""
+    exit_reason: str = "completed"  # completed | failed | oom
+    worker_count: int = 0
+    worker_memory_mb: int = 0
+
+
+@dataclass
+class BrainNodeEventReport(Message):
+    """One node-level incident (oom/failed/hot) with its host — feeds
+    OOM-adjust and cluster-level bad-node detection."""
+
+    job_name: str = ""
+    node_id: int = 0
+    hostname: str = ""
+    event: str = ""  # oom | failed | hot
+    memory_mb: int = 0
+    cpu_percent: float = 0.0
 
 
 @dataclass

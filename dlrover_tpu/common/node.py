@@ -131,6 +131,11 @@ class Node:
         self.group = group
         self.group_size = group_size
         self.create_time: float = time.time()
+        # physical host identity (k8s spec.nodeName / VM hostname) — set
+        # by watchers/agents; "" when the platform doesn't expose it.
+        # Cluster-level bad-node detection keys on THIS, never on the
+        # per-job logical name (every job has a "worker-0")
+        self.hostname: str = ""
         self.start_time: Optional[float] = None
         self.finish_time: Optional[float] = None
         self.heartbeat_time: float = 0.0

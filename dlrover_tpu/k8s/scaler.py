@@ -33,6 +33,7 @@ def build_worker_pod(
     template: Optional[dict] = None,
     master_addr: str = "",
     namespace: str = "default",
+    exclude_hosts=(),
 ) -> dict:
     """Worker pod body from the replica template (parity: pod_scaler
     _create_pod + resource.go NewPod). The template comes from the
@@ -78,6 +79,27 @@ def build_worker_pod(
         sel["cloud.google.com/gke-tpu-accelerator"] = res.tpu_type
         if res.tpu_topology:
             sel["cloud.google.com/gke-tpu-topology"] = res.tpu_topology
+    if exclude_hosts:
+        # Brain bad-node exclusion: hard anti-affinity on hostname (the
+        # hot-PS exclusion analog — condemned hosts must not receive
+        # replacements for the very failures they caused)
+        terms = (
+            body["spec"]
+            .setdefault("affinity", {})
+            .setdefault("nodeAffinity", {})
+            .setdefault(
+                "requiredDuringSchedulingIgnoredDuringExecution",
+                {"nodeSelectorTerms": [{}]},
+            )
+        )
+        for term in terms["nodeSelectorTerms"]:
+            term.setdefault("matchExpressions", []).append(
+                {
+                    "key": "kubernetes.io/hostname",
+                    "operator": "NotIn",
+                    "values": sorted(exclude_hosts),
+                }
+            )
     return body
 
 
@@ -99,11 +121,15 @@ class PodScaler(Scaler):
         self._ns = namespace
         self._template = pod_template
         self._master_addr = master_addr
+        self._exclude_hosts: tuple = ()
 
     def set_master_addr(self, addr: str):
         """The master learns its bound address after construction; it
         must be stamped into every worker pod's env."""
         self._master_addr = addr
+
+    def set_exclude_hosts(self, hosts) -> None:
+        self._exclude_hosts = tuple(sorted(set(hosts)))
 
     def scale(self, plan: ScalePlan) -> None:
         for node in plan.remove_nodes:
@@ -117,6 +143,7 @@ class PodScaler(Scaler):
                 template=self._template,
                 master_addr=self._master_addr,
                 namespace=self._ns,
+                exclude_hosts=self._exclude_hosts,
             )
             logger.info(f"pod scaler creating {body['metadata']['name']}")
             try:

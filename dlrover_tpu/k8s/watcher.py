@@ -44,6 +44,9 @@ def pod_to_node(pod: dict) -> Optional[Node]:
     )
     phase = pod.get("status", {}).get("phase", "Pending")
     node.status = _PHASE_TO_STATUS.get(phase, NodeStatus.PENDING)
+    # physical host: scheduler-assigned nodeName (feeds cluster-level
+    # bad-node detection — never the per-job pod name)
+    node.hostname = pod.get("spec", {}).get("nodeName", "")
     return node
 
 

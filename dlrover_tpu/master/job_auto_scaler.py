@@ -73,6 +73,10 @@ class JobAutoScaler(PollingDaemon):
         worker-count recommendation is acted on here; memory changes
         apply at the next relaunch through node config_resource."""
         plan = self._optimizer.generate_plan()
+        if self._scaler is not None:
+            # applied UNCONDITIONALLY (including empty) so condemnation
+            # decay actually clears stale anti-affinity from the scaler
+            self._scaler.set_exclude_hosts(plan.exclude_nodes)
         if plan.empty():
             return
         logger.info(f"resource plan: {plan}")

@@ -41,7 +41,12 @@ class JobManager:
         speed_monitor=None,
         scaler=None,
         max_relaunch_count: int = 3,
+        brain_reporter: Optional[Callable] = None,
     ):
+        # brain_reporter(node_id, hostname, event, memory_mb): incident
+        # feed for the cluster Brain (BrainClient.report_node_event) —
+        # fire-and-forget, failures never block relaunch
+        self._brain_reporter = brain_reporter
         self._lock = threading.Lock()
         # serializes replacement decisions between the servicer's event
         # path (_relaunch_node) and the auto-scaler thread, so a node in
@@ -144,6 +149,8 @@ class JobManager:
             node.update_status(NodeStatus.DELETED)
         else:
             node.exit_reason = event.node.exit_reason or node.exit_reason
+            if event.node.hostname:
+                node.hostname = event.node.hostname
             node.update_status(event.node.status)
         if node.status in (NodeStatus.FAILED, NodeStatus.BREAKDOWN):
             self._handle_node_failure(node)
@@ -164,6 +171,31 @@ class JobManager:
     def _handle_node_failure(self, node: Node):
         if self._speed_monitor:
             self._speed_monitor.remove_running_worker(node.id)
+        # only report incidents with a PHYSICAL host identity: falling
+        # back to the per-job logical name would let two unrelated jobs'
+        # "worker-0" failures condemn a phantom host cluster-wide
+        if self._brain_reporter is not None and node.hostname:
+            # fire-and-forget on a daemon thread: the client retries with
+            # backoff, so an unreachable Brain would otherwise stall the
+            # servicer's event path (and every relaunch) for ~30s
+            args = (
+                node.id,
+                node.hostname,
+                "oom"
+                if node.exit_reason == NodeExitReason.OOM
+                else "failed",
+                node.config_resource.memory_mb,
+            )
+
+            def _report():
+                try:
+                    self._brain_reporter(*args)
+                except Exception as e:
+                    logger.warning(f"brain node-event report failed: {e!r}")
+
+            threading.Thread(
+                target=_report, name="brain-node-event", daemon=True
+            ).start()
         if node.exit_reason == NodeExitReason.OOM:
             # give the replacement more memory (parity: reference doubles
             # memory on OOM relaunch via the resource optimizer)

@@ -8,12 +8,17 @@ test_utils.py ``start_local_master``).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Optional
 
 from dlrover_tpu.common import comm
-from dlrover_tpu.common.constants import JobExitReason, RendezvousName
+from dlrover_tpu.common.constants import (
+    JobExitReason,
+    NodeEnv,
+    RendezvousName,
+)
 from dlrover_tpu.common.global_context import Context
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.elastic_ps import ElasticPsService
@@ -44,16 +49,46 @@ class LocalJobMaster:
         node_unit: int = 1,
     ):
         self.port = port or comm.find_free_port()
+        # cluster Brain: DLROVER_TPU_BRAIN_ADDR wires metric reporting,
+        # node-incident events and the terminal job summary (the rows
+        # cross-job cold-start fits from) into the Brain datastore
+        self._brain_client = None
+        brain_addr = os.getenv("DLROVER_TPU_BRAIN_ADDR", "")
+        if brain_addr:
+            from dlrover_tpu.brain.service import BrainClient
+
+            self._brain_client = BrainClient(
+                brain_addr, os.getenv(NodeEnv.JOB_NAME, "local-job")
+            )
         self.speed_monitor = SpeedMonitor()
         self.job_manager = LocalJobManager(
-            speed_monitor=self.speed_monitor, scaler=scaler
+            speed_monitor=self.speed_monitor,
+            scaler=scaler,
+            brain_reporter=(
+                (
+                    lambda nid, host, ev, mem: self._brain_client
+                    .report_node_event(nid, host, ev, memory_mb=mem)
+                )
+                if self._brain_client
+                else None
+            ),
         )
         self.job_manager.create_initial_nodes(node_num)
         self.metric_collector = JobMetricCollector(
-            self.job_manager, self.speed_monitor
+            self.job_manager,
+            self.speed_monitor,
+            reporter=(
+                self._brain_client.reporter() if self._brain_client else None
+            ),
         )
         self.resource_optimizer = JobResourceOptimizer(
-            metric_collector=self.metric_collector, node_unit=node_unit
+            metric_collector=self.metric_collector,
+            node_unit=node_unit,
+            brain=(
+                self._brain_client.optimizer(node_unit=node_unit)
+                if self._brain_client
+                else None
+            ),
         )
         self.auto_scaler = JobAutoScaler(
             self.job_manager,
@@ -84,6 +119,7 @@ class LocalJobMaster:
             metric_collector=self.metric_collector,
         )
         self._server = None
+        self._brain_end_thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
         # master failover: snapshot/restore through a state file when
         # DLROVER_TPU_MASTER_STATE names one (the k8s operator relaunches
@@ -137,6 +173,7 @@ class LocalJobMaster:
                     # (an externally-stopped master keeps its state —
                     # that IS the failover case)
                     self._state_saver.clear()
+                self._report_job_end("completed")
                 return JobExitReason.SUCCEEDED
             if self.job_manager.all_running_node_hanged() and not (
                 # data starvation is not a hang: consumers parked on a
@@ -160,6 +197,7 @@ class LocalJobMaster:
                         f"job still hanged after {hang_recoveries} "
                         f"restart rounds; stopping"
                     )
+                    self._report_job_end("failed")
                     return JobExitReason.HANG_ERROR
                 hang_recoveries += 1
                 logger.error(
@@ -173,6 +211,34 @@ class LocalJobMaster:
     def scale_to(self, count: int):
         """Explicit resize API (operator / Brain seam)."""
         return self.auto_scaler.scale_to(count)
+
+    def _report_job_end(self, exit_reason: str):
+        """Terminal summary → Brain (the rows cross-job cold-start fits
+        from). Fire-and-forget: a dead Brain must not block job exit.
+        The client is captured locally and stop() joins this thread
+        before closing it, so a prompt stop() cannot lose the report."""
+        client = self._brain_client
+        if client is None:
+            return
+        nodes = self.job_manager.get_running_nodes()
+        mem = max(
+            (n.config_resource.memory_mb for n in nodes), default=0
+        )
+
+        def _report():
+            try:
+                client.report_job_end(
+                    exit_reason,
+                    worker_count=len(nodes),
+                    worker_memory_mb=mem,
+                )
+            except Exception as e:
+                logger.warning(f"brain job-end report failed: {e!r}")
+
+        self._brain_end_thread = threading.Thread(
+            target=_report, name="brain-job-end", daemon=True
+        )
+        self._brain_end_thread.start()
 
     def stop(self, final_snapshot: bool = True):
         """``final_snapshot=False`` simulates a crash for failover tests:
@@ -188,6 +254,13 @@ class LocalJobMaster:
             # port immediately after stop() returns
             self._server.stop(grace=1).wait(timeout=5)
             self._server = None
+        if self._brain_client is not None:
+            if self._brain_end_thread is not None:
+                # bounded wait so a prompt stop() after run() returns
+                # doesn't close the channel under the job-end report
+                self._brain_end_thread.join(timeout=10)
+            self._brain_client.close()
+            self._brain_client = None
 
 
 def start_local_master(

@@ -34,9 +34,15 @@ class ResourcePlan:
     worker_count: Optional[int] = None
     worker_memory_mb: Optional[int] = None
     reason: str = ""
+    # hostnames to schedule away from (Brain bad-node detection)
+    exclude_nodes: tuple = ()
 
     def empty(self) -> bool:
-        return self.worker_count is None and self.worker_memory_mb is None
+        return (
+            self.worker_count is None
+            and self.worker_memory_mb is None
+            and not self.exclude_nodes
+        )
 
 
 class JobResourceOptimizer:

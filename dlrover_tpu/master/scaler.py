@@ -54,6 +54,10 @@ class Scaler(abc.ABC):
     def relaunch_node(self, old: Node, new: Node) -> None:
         self.scale(ScalePlan(launch_nodes=[new], remove_nodes=[old]))
 
+    def set_exclude_hosts(self, hosts) -> None:
+        """Hosts future launches must avoid (Brain bad-node exclusion).
+        Default no-op: platforms without host placement ignore it."""
+
 
 class CallbackScaler(Scaler):
     """Test/embedding seam: forwards the plan to a callable."""

@@ -102,3 +102,176 @@ class TestBrain:
             c2.close()
             server2.stop(grace=1)
             servicer2.close()
+
+
+class TestClusterAlgorithms:
+    """The cluster-level algorithms a job-local optimizer provably
+    cannot reproduce (they need OTHER jobs' data)."""
+
+    def test_cold_start_from_other_jobs_histories(self, brain):
+        """Two completed jobs' histories produce a plan for a brand-new
+        third job; the job-local optimizer with the same (empty) view of
+        that job returns nothing."""
+        a = BrainClient(brain, "hist-a")
+        b = BrainClient(brain, "hist-b")
+        new = BrainClient(brain, "fresh-job")
+        try:
+            # hist-a scaled 2->4 efficiently (1.9x), peak 500 MB/worker
+            a.persist_metrics(_sample(2, 10.0, mem=800, ts=1.0))
+            a.persist_metrics(_sample(4, 19.0, mem=2000, ts=2.0))
+            a.report_job_end("completed", worker_count=4)
+            # hist-b pushed 4->8 for only 1.2x: past the knee
+            b.persist_metrics(_sample(4, 19.0, mem=2000, ts=1.0))
+            b.persist_metrics(_sample(8, 23.0, mem=4000, ts=2.0))
+            b.report_job_end("completed", worker_count=8)
+
+            plan = new.optimize()
+            # fit: scale to 4 (worth it), stop before 8 (1.2x < 0.6-rule)
+            assert plan.worker_count == 4, plan
+            # memory: fleet peak/worker = 500 MB * 1.2 margin
+            assert plan.worker_memory_mb == 600, plan
+            assert "cold-start" in plan.reason
+
+            # the job-local optimizer cannot: zero samples -> empty plan
+            local = JobResourceOptimizer().plan_from_samples(
+                new.get_job_metrics()
+            )
+            assert local.empty()
+        finally:
+            a.close(); b.close(); new.close()
+
+    def test_oom_adjust_beats_cold_start(self, brain):
+        c = BrainClient(brain, "oomy")
+        try:
+            c.persist_metrics(_sample(2, 5.0, mem=3000, ts=1.0))
+            c.report_node_event(0, "host-1", "oom", memory_mb=1800)
+            plan = c.optimize()
+            # 2x of max(incident 1800, observed 1500/worker)
+            assert plan.worker_memory_mb == 3600, plan
+            assert "oom adjust" in plan.reason
+        finally:
+            c.close()
+
+    def test_cross_job_bad_node_exclusion(self, brain):
+        """A hostname misbehaving across >= 2 DIFFERENT jobs lands on
+        every new plan's exclude list — one job's events alone do not."""
+        a = BrainClient(brain, "ex-a")
+        b = BrainClient(brain, "ex-b")
+        c = BrainClient(brain, "ex-c")
+        try:
+            a.report_node_event(3, "node-bad", "oom", memory_mb=900)
+            plan = c.optimize()
+            assert "node-bad" not in plan.exclude_nodes  # 1 job only
+            b.report_node_event(5, "node-bad", "failed")
+            plan = c.optimize()
+            assert plan.exclude_nodes == ("node-bad",), plan
+        finally:
+            a.close(); b.close(); c.close()
+
+    def test_hot_node_exclusion(self, brain):
+        a = BrainClient(brain, "hot-a")
+        c = BrainClient(brain, "hot-c")
+        try:
+            for _ in range(3):
+                a.report_node_event(1, "node-hot", "hot", cpu_percent=97.0)
+            a.report_node_event(2, "node-warm", "hot", cpu_percent=50.0)
+            plan = c.optimize()
+            assert plan.exclude_nodes == ("node-hot",), plan
+        finally:
+            a.close(); c.close()
+
+    def test_prune_is_batched_but_bounded(self):
+        from dlrover_tpu.brain.service import BrainServicer, _PRUNE_EVERY
+
+        s = BrainServicer(max_rows_per_job=100)
+        try:
+            n = 100 + 2 * _PRUNE_EVERY
+            for i in range(n):
+                s.persist_metrics("j", _sample(1, 1.0, ts=float(i + 1)))
+            rows = s.job_metrics("j")
+            # bounded within one prune batch of slack, and the retained
+            # rows are the newest
+            assert len(rows) <= 100 + _PRUNE_EVERY
+            assert rows[-1].timestamp == float(n)
+        finally:
+            s.close()
+
+
+def test_job_manager_feeds_brain_node_events(brain):
+    """OOM/failure incidents flow master -> Brain through the
+    brain_reporter seam, and surface in another job's exclude list once
+    a second job condemns the same host."""
+    from dlrover_tpu.common.constants import NodeEventType
+    from dlrover_tpu.common.node import Node, NodeExitReason, NodeStatus
+    from dlrover_tpu.master.job_manager import JobManager, NodeEvent
+
+    a = BrainClient(brain, "jm-a")
+    b = BrainClient(brain, "jm-b")
+    c = BrainClient(brain, "jm-c")
+    try:
+        for cli in (a, b):
+            jm = JobManager(
+                brain_reporter=lambda nid, host, ev, mem, _c=cli: (
+                    _c.report_node_event(nid, host, ev, memory_mb=mem)
+                )
+            )
+            n = Node("worker", 0)
+            n.update_status(NodeStatus.RUNNING)
+            jm.add_node(n)
+            failed = Node("worker", 0)
+            # the PHYSICAL host (pod spec.nodeName), carried by the
+            # watcher's event node — logical "worker-0" must never be
+            # what condemns a host cluster-wide
+            failed.hostname = "flaky-host"
+            failed.exit_reason = NodeExitReason.OOM
+            failed.update_status(NodeStatus.FAILED)
+            jm.process_event(NodeEvent(NodeEventType.MODIFIED, failed))
+        # the reporter is fire-and-forget on a daemon thread (it must
+        # never block relaunch) — poll for delivery
+        deadline = time.time() + 10
+        plan = c.optimize()
+        while plan.exclude_nodes != ("flaky-host",) and time.time() < deadline:
+            time.sleep(0.1)
+            plan = c.optimize()
+        assert plan.exclude_nodes == ("flaky-host",), plan
+    finally:
+        a.close(); b.close(); c.close()
+
+
+def test_exclusion_enforced_via_pod_anti_affinity(brain):
+    """The full enforcement chain: Brain condemns a host -> auto-scaler
+    pushes the exclude list into the scaler -> every launched pod
+    carries hostname NotIn anti-affinity."""
+    from dlrover_tpu.common.node import Node, NodeResource
+    from dlrover_tpu.k8s.client import FakeK8sApi
+    from dlrover_tpu.k8s.scaler import PodScaler
+    from dlrover_tpu.master.job_auto_scaler import JobAutoScaler
+    from dlrover_tpu.master.job_manager import JobManager
+    from dlrover_tpu.master.resource.optimizer import JobResourceOptimizer
+    from dlrover_tpu.master.scaler import ScalePlan
+
+    a = BrainClient(brain, "aff-a")
+    b = BrainClient(brain, "aff-b")
+    c = BrainClient(brain, "aff-c")
+    try:
+        a.report_node_event(0, "cursed-host", "oom", memory_mb=512)
+        b.report_node_event(0, "cursed-host", "failed")
+
+        api = FakeK8sApi()
+        scaler = PodScaler(api, "aff-job")
+        opt = JobResourceOptimizer(brain=c.optimizer())
+        auto = JobAutoScaler(
+            JobManager(), scaler=scaler, resource_optimizer=opt
+        )
+        auto.run_optimization_pass()
+        scaler.scale(
+            ScalePlan(launch_nodes=[Node("worker", 0, rank_index=0)])
+        )
+        pod = api.pods["aff-job-worker-0"]
+        expr = pod["spec"]["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"][0]["matchExpressions"][0]
+        assert expr["operator"] == "NotIn"
+        assert expr["values"] == ["cursed-host"]
+    finally:
+        a.close(); b.close(); c.close()

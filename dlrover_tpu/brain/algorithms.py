@@ -36,12 +36,14 @@ from typing import Dict, List, Optional, Protocol, Tuple
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.master.resource.optimizer import ResourcePlan
+from dlrover_tpu.master.resource.optimizer import (
+    ResourcePlan,
+    scaling_worth_it,
+)
 
-# cold-start knobs (parity: DefaultMemoryMarginPercent and the speedup
-# thresholds in optimplcomm)
+# cold-start knobs (parity: DefaultMemoryMarginPercent in optimplcomm;
+# the speedup rule is THE shared one from resource/optimizer.py)
 MEMORY_MARGIN = 0.2
-MIN_SPEEDUP_PER_UNIT = 0.6
 DEFAULT_COLD_MEMORY_MB = 8192
 # incident windows: OOMs older than this no longer drive memory bumps;
 # node condemnation decays after BAD_NODE_WINDOW_S
@@ -82,9 +84,9 @@ def cold_start_resources(
         sizes = sorted(speed_by_size)
         pick = sizes[0]
         for prev, cur in zip(sizes, sizes[1:]):
-            actual = speed_by_size[cur] / max(speed_by_size[prev], 1e-9)
-            linear = cur / prev
-            if actual < 1 + MIN_SPEEDUP_PER_UNIT * (linear - 1):
+            if not scaling_worth_it(
+                prev, cur, speed_by_size[prev], speed_by_size[cur]
+            ):
                 break
             pick = cur
         pick = max(node_unit, pick - pick % node_unit)

@@ -17,6 +17,7 @@ consumes resource-optimizer plans. The TPU job is the allreduce shape
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional
 
 from dlrover_tpu.common.constants import (
@@ -56,6 +57,7 @@ class JobAutoScaler(PollingDaemon):
         self._optimizer = resource_optimizer
         self._optimize_every = max(1, optimize_every_ticks)
         self._ticks = 0
+        self._opt_thread: Optional[threading.Thread] = None
 
     @property
     def has_scaler(self) -> bool:
@@ -65,7 +67,16 @@ class JobAutoScaler(PollingDaemon):
         self.check_and_scale()
         self._ticks += 1
         if self._optimizer and self._ticks % self._optimize_every == 0:
-            self.run_optimization_pass()
+            # off-tick thread: the Brain optimize RPC retries with
+            # backoff on outage (~30s+) and must not stall the next
+            # check_and_scale (dead-node replacement)
+            if self._opt_thread is None or not self._opt_thread.is_alive():
+                self._opt_thread = threading.Thread(
+                    target=self.run_optimization_pass,
+                    name="optimization-pass",
+                    daemon=True,
+                )
+                self._opt_thread.start()
 
     def run_optimization_pass(self):
         """Consult the resource optimizer (parity: PSTrainingAutoScaler
@@ -73,9 +84,12 @@ class JobAutoScaler(PollingDaemon):
         worker-count recommendation is acted on here; memory changes
         apply at the next relaunch through node config_resource."""
         plan = self._optimizer.generate_plan()
-        if self._scaler is not None:
-            # applied UNCONDITIONALLY (including empty) so condemnation
-            # decay actually clears stale anti-affinity from the scaler
+        if self._scaler is not None and plan.exclude_nodes is not None:
+            # authoritative statements only: a Brain outage falls back
+            # to the local optimizer whose plan carries None ("no
+            # statement") — standing exclusions must survive it. An
+            # EMPTY tuple from the Brain means condemnation decayed and
+            # clears stale anti-affinity.
             self._scaler.set_exclude_hosts(plan.exclude_nodes)
         if plan.empty():
             return

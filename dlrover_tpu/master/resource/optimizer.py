@@ -27,6 +27,29 @@ from dlrover_tpu.common import comm
 from dlrover_tpu.common.log import default_logger as logger
 
 
+# one shared notion of "scaling one step up was worth it": the larger
+# size must buy at least this fraction of linear speedup. Used by BOTH
+# the job-local scale-down heuristic and the Brain's cross-job
+# cold-start sizing — tune it in one place.
+DEFAULT_MIN_SPEEDUP_PER_UNIT = 0.6
+
+
+def scaling_worth_it(
+    prev_size: int,
+    cur_size: int,
+    prev_speed: float,
+    cur_speed: float,
+    min_speedup: float = DEFAULT_MIN_SPEEDUP_PER_UNIT,
+) -> bool:
+    """True when growing prev_size -> cur_size bought at least
+    ``min_speedup`` of the linear throughput gain."""
+    if prev_speed <= 0:
+        return False
+    actual = cur_speed / prev_speed
+    linear = cur_size / prev_size
+    return actual >= 1 + min_speedup * (linear - 1)
+
+
 @dataclass
 class ResourcePlan:
     """What the optimizer recommends (parity: common ResourcePlan)."""
@@ -34,8 +57,11 @@ class ResourcePlan:
     worker_count: Optional[int] = None
     worker_memory_mb: Optional[int] = None
     reason: str = ""
-    # hostnames to schedule away from (Brain bad-node detection)
-    exclude_nodes: tuple = ()
+    # hostnames to schedule away from (Brain bad-node detection).
+    # Tri-state: None = "no statement" (job-local plans — a Brain outage
+    # falling back to local must NOT clear standing exclusions);
+    # () = authoritative "nothing condemned" (clears stale exclusions).
+    exclude_nodes: Optional[tuple] = None
 
     def empty(self) -> bool:
         return (
@@ -51,7 +77,7 @@ class JobResourceOptimizer:
         metric_collector=None,
         node_unit: int = 1,
         memory_headroom: float = 1.5,
-        min_speedup_per_unit: float = 0.6,
+        min_speedup_per_unit: float = DEFAULT_MIN_SPEEDUP_PER_UNIT,
         brain: Optional[Callable[[List[comm.JobMetricsSample]], ResourcePlan]] = None,
     ):
         self._collector = metric_collector
@@ -115,7 +141,9 @@ class JobResourceOptimizer:
             return
         actual = speed_big / speed_small
         linear = big / small
-        if actual < 1 + self._min_speedup * (linear - 1):
+        if not scaling_worth_it(
+            small, big, speed_small, speed_big, self._min_speedup
+        ):
             # slice-align DOWNWARD: rounding up could re-recommend (or
             # exceed) the size already judged inefficient, turning a
             # scale-down into a no-op or a scale-UP

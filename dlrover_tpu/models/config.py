@@ -32,6 +32,11 @@ class TransformerConfig:
     num_experts: int = 0
     moe_every: int = 2
     capacity_factor: float = 1.25
+    # experts per token (1 = Switch, 2 = GShard-style top-2; parity:
+    # switch_gating.py:154 covers both) and the router z-loss weight
+    # (keeps gate logits small; 0 disables)
+    moe_top_k: int = 1
+    router_z_weight: float = 1e-3
     # numerics
     dtype: str = "bfloat16"  # activation/compute dtype
     param_dtype: str = "float32"

@@ -138,21 +138,29 @@ def build_train_step(
 
     def train_step(state: TrainState, tokens, targets):
         def lf(p):
-            return loss_fn(p, tokens, targets, cfg, mesh)
+            return loss_fn(
+                p, tokens, targets, cfg, mesh, return_aux=True
+            )
 
-        loss, grads = jax.value_and_grad(lf)(state.params)
+        (loss, aux), grads = jax.value_and_grad(lf, has_aux=True)(
+            state.params
+        )
         updates, new_opt = tx.update(
             grads, state.opt_state, state.params
         )
         new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
+        metrics = {"loss": loss, "grad_norm": gnorm}
+        if cfg.num_experts:
+            metrics["moe_balance_loss"] = aux["balance"]
+            metrics["moe_z_loss"] = aux["z"]
         return (
             TrainState(
                 step=state.step + 1,
                 params=new_params,
                 opt_state=new_opt,
             ),
-            {"loss": loss, "grad_norm": gnorm},
+            metrics,
         )
 
     donate_argnums = (0,) if donate else ()

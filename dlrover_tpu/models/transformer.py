@@ -222,12 +222,18 @@ def _attention_block(x, layer, cfg: TransformerConfig, mesh, positions):
     )
 
 
+def _zero_aux():
+    return {"balance": jnp.float32(0.0), "z": jnp.float32(0.0)}
+
+
 def _mlp_block(x, layer, cfg: TransformerConfig, mesh):
     h = _norm(x, layer["mlp_norm"], cfg)
     if "moe" in layer:
         if mesh is not None:
             out, aux = moe_layer(
-                layer["moe"], h, mesh, capacity_factor=cfg.capacity_factor
+                layer["moe"], h, mesh,
+                capacity_factor=cfg.capacity_factor,
+                top_k=cfg.moe_top_k,
             )
         else:
             B, T, d = h.shape
@@ -236,6 +242,7 @@ def _mlp_block(x, layer, cfg: TransformerConfig, mesh):
                 h.reshape(B * T, d),
                 axis_name=None,
                 capacity_factor=cfg.capacity_factor,
+                top_k=cfg.moe_top_k,
             )
             out = out.reshape(B, T, d)
         return x + out, aux
@@ -256,7 +263,7 @@ def _mlp_block(x, layer, cfg: TransformerConfig, mesh):
     out = mm(z, mlp["w_down"])
     if not cfg.swiglu:
         out = out + mlp["b_down"].astype(h.dtype)
-    return x + out, jnp.float32(0.0)
+    return x + out, _zero_aux()
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -340,7 +347,9 @@ def forward(
     mesh=None,
     return_hidden: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """tokens [B,T] int32 → (logits [B,T,vocab] fp32, moe_aux_loss).
+    """tokens [B,T] int32 → (logits [B,T,vocab] fp32, moe aux dict
+    {"balance": load-balance loss, "z": router z-loss} — zeros for dense
+    models).
 
     ``return_hidden=True`` returns the final-norm'd residual stream
     [B,T,D] instead of logits and skips the vocab projection entirely —
@@ -351,7 +360,7 @@ def forward(
     x = embed_tokens(params, tokens, cfg, mesh)
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
 
-    aux_total = jnp.float32(0.0)
+    aux_total = _zero_aux()
 
     def block(x, layer):
         x = _attention_block(x, layer, cfg, mesh, positions)
@@ -362,7 +371,7 @@ def forward(
         block = jax.checkpoint(block)
     for layer in params["layers"]:
         x, aux = block(x, layer)
-        aux_total = aux_total + aux
+        aux_total = jax.tree_util.tree_map(jnp.add, aux_total, aux)
 
     if return_hidden:
         return _norm(x, params["final_norm"], cfg), aux_total
@@ -376,9 +385,20 @@ def loss_fn(
     cfg: TransformerConfig,
     mesh=None,
     moe_aux_weight: float = 0.01,
-) -> jnp.ndarray:
+    return_aux: bool = False,
+):
+    """Mean NLL + weighted MoE aux losses (load balance at
+    ``moe_aux_weight``, router z at ``cfg.router_z_weight``).
+    ``return_aux=True`` → (loss, aux dict) for metric surfacing."""
     logits, aux = forward(params, tokens, cfg, mesh)
-    return token_nll(logits, targets) + moe_aux_weight * aux
+    loss = (
+        token_nll(logits, targets)
+        + moe_aux_weight * aux["balance"]
+        + cfg.router_z_weight * aux["z"]
+    )
+    if return_aux:
+        return loss, aux
+    return loss
 
 
 # ---------------------------------------------------------------------------

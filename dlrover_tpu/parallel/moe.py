@@ -82,6 +82,58 @@ def top1_gating(
     return dispatch, combine, aux
 
 
+def topk_gating(
+    logits: jnp.ndarray,
+    num_experts: int,
+    capacity: int,
+    k: int = 2,
+    normalize: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-k gating (parity: switch_gating.py:154's top-k path /
+    GShard top-2): each token is routed to its k best experts, with
+    rank-0 assignments taking capacity priority over rank-1 (the GShard
+    rule — a token's secondary expert must not evict another token's
+    primary).
+
+    Returns (dispatch [T,E,C], combine [T,E,C], balance_aux, z_loss):
+    - balance_aux: Switch load-balance loss over PRIMARY assignments
+      (E * sum(density * density_proxy));
+    - z_loss: mean(logsumexp(logits)^2) — keeps router logits from
+      drifting large (ST-MoE router z-loss), weighted by the caller.
+    """
+    T = logits.shape[0]
+    probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+    vals, idx = lax.top_k(probs, k)  # [T, k]
+    gates = (
+        vals / (jnp.sum(vals, axis=-1, keepdims=True) + 1e-9)
+        if normalize and k > 1
+        else vals
+    )
+    onehots = jax.nn.one_hot(idx, num_experts, dtype=logits.dtype)  # [T,k,E]
+
+    # capacity accounting rank-major: all rank-0 rows first, then rank-1
+    # continues the same per-expert counters
+    flat = onehots.transpose(1, 0, 2).reshape(k * T, num_experts)
+    pos_flat = jnp.sum(jnp.cumsum(flat, axis=0) * flat, axis=-1) - 1.0
+    pos = pos_flat.reshape(k, T).T  # [T, k]
+    keep = pos < capacity
+    gate_val = gates * keep
+    pos_oh = jax.nn.one_hot(
+        jnp.where(keep, pos, capacity).astype(jnp.int32),
+        capacity,
+        dtype=logits.dtype,
+    )  # [T, k, C]
+    routed = onehots[..., None] * pos_oh[:, :, None, :]  # [T,k,E,C]
+    dispatch = jnp.sum(routed, axis=1)  # experts are distinct per token
+    combine = jnp.sum(routed * gate_val[..., None, None], axis=1)
+
+    density = jnp.mean(onehots[:, 0, :], axis=0)  # primary assignment
+    density_proxy = jnp.mean(probs, axis=0)
+    balance = jnp.sum(density * density_proxy) * num_experts
+    z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+    return dispatch, combine, balance, z
+
+
 def moe_layer_local(
     params: MoEParams,
     x: jnp.ndarray,
@@ -89,6 +141,7 @@ def moe_layer_local(
     axis_name: str = "ep",
     capacity_factor: float = 1.25,
     activation=jax.nn.gelu,
+    top_k: int = 1,
 ):
     """Per-device MoE FFN body (call inside ``shard_map``).
 
@@ -99,10 +152,21 @@ def moe_layer_local(
     e_local = params.w_up.shape[0]
     e_global = e_local * ep
     T, model = x.shape
-    capacity = max(1, int(capacity_factor * T / e_global))
+    # top-k routes k slots per token; capacity scales with k so the
+    # same capacity_factor keeps the same drop rate
+    capacity = max(1, int(capacity_factor * top_k * T / e_global))
 
     logits = x @ params.gate  # [T, E_global]
-    dispatch, combine, aux = top1_gating(logits, e_global, capacity)
+    if top_k == 1:
+        dispatch, combine, balance = top1_gating(
+            logits, e_global, capacity
+        )
+        z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+    else:
+        dispatch, combine, balance, z = topk_gating(
+            logits, e_global, capacity, k=top_k
+        )
+    aux = {"balance": balance, "z": z}
 
     # bucket tokens: [E_global, C, model]; global expert id is
     # (owner_device, local_expert) row-major
@@ -152,15 +216,17 @@ def moe_layer(params: MoEParams, x, mesh, **kw):
         B, S, m = xb.shape
         flat = xb.reshape(B * S, m)
         out, aux = moe_layer_local(p, flat, **kw)
-        # gating is per-local-token-group; average the balance loss over
-        # every shard so the returned scalar really is replicated
-        aux = lax.pmean(aux, ("dp", "fsdp", "sp", "ep"))
+        # gating is per-local-token-group; average the aux losses over
+        # every shard so the returned scalars really are replicated
+        aux = jax.tree_util.tree_map(
+            lambda a: lax.pmean(a, ("dp", "fsdp", "sp", "ep")), aux
+        )
         return out.reshape(B, S, m), aux
 
     return shard_map(
         body,
         mesh=mesh,
         in_specs=(pspec, xspec),
-        out_specs=(xspec, P()),
+        out_specs=(xspec, {"balance": P(), "z": P()}),
         check_vma=False,
     )(params, x)

@@ -275,3 +275,37 @@ def test_exclusion_enforced_via_pod_anti_affinity(brain):
         assert expr["values"] == ["cursed-host"]
     finally:
         a.close(); b.close(); c.close()
+
+
+def test_brain_outage_keeps_standing_exclusions():
+    """A Brain outage falls back to the job-local optimizer, whose plan
+    carries exclude_nodes=None ("no statement") — standing anti-affinity
+    must survive; only an authoritative empty tuple clears it."""
+    from dlrover_tpu.master.job_auto_scaler import JobAutoScaler
+    from dlrover_tpu.master.job_manager import JobManager
+    from dlrover_tpu.master.resource.optimizer import (
+        JobResourceOptimizer, ResourcePlan,
+    )
+    from dlrover_tpu.master.scaler import CallbackScaler
+
+    calls = []
+
+    class _Scaler(CallbackScaler):
+        def set_exclude_hosts(self, hosts):
+            calls.append(tuple(hosts))
+
+    scaler = _Scaler(lambda plan: None)
+    auto = JobAutoScaler(JobManager(), scaler=scaler)
+
+    def _down(samples):
+        raise ConnectionError("brain down")
+
+    auto._optimizer = JobResourceOptimizer(brain=_down)
+    auto.run_optimization_pass()
+    assert calls == [], "outage fallback must not touch exclusions"
+
+    auto._optimizer = JobResourceOptimizer(
+        brain=lambda s: ResourcePlan(exclude_nodes=())
+    )
+    auto.run_optimization_pass()
+    assert calls == [()], "authoritative empty tuple clears exclusions"

@@ -239,6 +239,15 @@ class MasterClient:
             )
         )
 
+    def report_train_metrics(self, step: int, metrics: dict):
+        """Scalar training metrics (loss/eval_loss/lr …) → the master's
+        collector (the trainer's periodic metric-logging leg)."""
+        return self.report(
+            comm.TrainMetricsReport(
+                node_id=self._node_id, step=step, metrics=dict(metrics)
+            )
+        )
+
     def report_training_status(self, status: int):
         return self.report(
             comm.TrainingStatusReport(

@@ -111,6 +111,16 @@ class TrainingMonitor(PollingDaemon):
         if step > self._last_step:
             self._last_step = step
             self._client.report_global_step(step)
+            # forward whatever scalar metrics the train proc published
+            # (loss / eval_loss / lr …) to the master's collector
+            scalars = {
+                k: float(v)
+                for k, v in metrics.items()
+                if k not in ("global_step", "timestamp")
+                and isinstance(v, (int, float))
+            }
+            if scalars:
+                self._client.report_train_metrics(step, scalars)
 
 
 class ParalConfigTuner(PollingDaemon):

@@ -388,6 +388,17 @@ class JobMetrics(Message):
 
 
 @dataclass
+class TrainMetricsReport(Message):
+    """Periodic scalar training metrics (loss / eval_loss / lr / ...)
+    from a worker to the master's collector — the AtorchTrainer
+    metric-logging hook's master leg (ref atorch_trainer.py:127)."""
+
+    node_id: int = 0
+    step: int = 0
+    metrics: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
 class TrainingStatusReport(Message):
     node_id: int = 0
     status: int = 0  # TrainingLoopStatus

@@ -78,12 +78,24 @@ class JobAutoScaler(PollingDaemon):
                 )
                 self._opt_thread.start()
 
+    def stop(self):
+        super().stop()
+        # an in-flight optimization pass must not emit plans mid-teardown
+        if self._opt_thread is not None:
+            self._opt_thread.join(timeout=5)
+            self._opt_thread = None
+
     def run_optimization_pass(self):
         """Consult the resource optimizer (parity: PSTrainingAutoScaler
         executing optimizer plans, job_auto_scaler.py:98). Only the
-        worker-count recommendation is acted on here; memory changes
-        apply at the next relaunch through node config_resource."""
+        worker-count recommendation is acted on here (scale_to does its
+        mutations under the job manager's scale_lock — the `want !=
+        _target` pre-check is advisory, worst case a redundant
+        idempotent plan); memory changes apply at the next relaunch
+        through node config_resource."""
         plan = self._optimizer.generate_plan()
+        if self._stopped.is_set():
+            return  # shutdown raced the (possibly slow) optimize RPC
         if self._scaler is not None and plan.exclude_nodes is not None:
             # authoritative statements only: a Brain outage falls back
             # to the local optimizer whose plan carries None ("no

@@ -298,6 +298,12 @@ class MasterServicer:
                     message.step, message.timestamp or time.time()
                 )
             return True
+        if isinstance(message, comm.TrainMetricsReport):
+            if self._metric_collector is not None:
+                self._metric_collector.report_train_metrics(
+                    message.node_id, message.step, message.metrics
+                )
+            return True
         if isinstance(message, comm.TrainingStatusReport):
             if self._speed_monitor and message.status == 1:
                 self._speed_monitor.set_start_timestamp()

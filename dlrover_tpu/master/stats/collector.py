@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from typing import Callable, Deque, Optional
+from typing import Callable, Deque, Dict, Optional
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.daemon import PollingDaemon
@@ -42,6 +42,10 @@ class JobMetricCollector(PollingDaemon):
         )
         self._reporter = reporter
         self._report_thread = None
+        # latest scalar training metrics per node (loss/eval_loss/lr …)
+        # reported through TrainMetricsReport — the trainer's periodic
+        # metric-logging leg (ref atorch_trainer.py:127)
+        self.train_metrics: Dict[int, dict] = {}
 
     def collect(self) -> comm.JobMetricsSample:
         running = (
@@ -90,6 +94,13 @@ class JobMetricCollector(PollingDaemon):
 
     def _tick(self):
         self.collect()
+
+    def report_train_metrics(self, node_id: int, step: int, metrics: dict):
+        self.train_metrics[node_id] = {
+            "step": step,
+            "timestamp": time.time(),
+            **{k: float(v) for k, v in metrics.items()},
+        }
 
     def flush_reports(self, timeout: float = 10.0):
         """Join the in-flight reporter dispatch (tests / shutdown)."""

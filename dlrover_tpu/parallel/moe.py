@@ -54,32 +54,13 @@ def init_moe_params(
 def top1_gating(
     logits: jnp.ndarray, num_experts: int, capacity: int
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Switch-style top-1 gating (parity: switch_gating.py:154).
-
-    Returns (dispatch [T, E, C] one-hot, combine [T, E, C] weights,
-    aux_loss scalar)."""
-    T = logits.shape[0]
-    probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
-    expert = jnp.argmax(probs, axis=-1)  # [T]
-    onehot = jax.nn.one_hot(expert, num_experts, dtype=logits.dtype)
-    # load-balancing aux loss (Switch Transformer eq. 4)
-    density = jnp.mean(onehot, axis=0)
-    density_proxy = jnp.mean(probs, axis=0)
-    aux = jnp.sum(density * density_proxy) * num_experts
-
-    # position of each token within its expert's capacity bucket
-    pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot  # [T,E]
-    pos = jnp.sum(pos_in_expert, axis=-1) - 1.0  # [T]
-    keep = pos < capacity
-    gate_val = jnp.sum(probs * onehot, axis=-1) * keep  # [T]
-    pos_oh = jax.nn.one_hot(
-        jnp.where(keep, pos, capacity).astype(jnp.int32),
-        capacity,
-        dtype=logits.dtype,
-    )  # [T,C] (dropped tokens one-hot nothing)
-    dispatch = onehot[:, :, None] * pos_oh[:, None, :]  # [T,E,C]
-    combine = dispatch * gate_val[:, None, None]
-    return dispatch, combine, aux
+    """Switch-style top-1 gating (parity: switch_gating.py:154) —
+    ``topk_gating`` with k=1 (ONE routing implementation to maintain),
+    minus the z-loss for the legacy 3-tuple signature."""
+    dispatch, combine, balance, _ = topk_gating(
+        logits, num_experts, capacity, k=1
+    )
+    return dispatch, combine, balance
 
 
 def topk_gating(
@@ -157,15 +138,9 @@ def moe_layer_local(
     capacity = max(1, int(capacity_factor * top_k * T / e_global))
 
     logits = x @ params.gate  # [T, E_global]
-    if top_k == 1:
-        dispatch, combine, balance = top1_gating(
-            logits, e_global, capacity
-        )
-        z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
-    else:
-        dispatch, combine, balance, z = topk_gating(
-            logits, e_global, capacity, k=top_k
-        )
+    dispatch, combine, balance, z = topk_gating(
+        logits, e_global, capacity, k=top_k
+    )
     aux = {"balance": balance, "z": z}
 
     # bucket tokens: [E_global, C, model]; global expert id is

@@ -46,6 +46,89 @@ class TrainerConfig:
     save_storage_interval: int = 500
     report_metrics: bool = True
     log_interval: int = 10
+    # eval loop: 0 disables; otherwise run ``eval_steps`` batches of the
+    # eval dataset every ``eval_interval`` optimizer steps
+    eval_interval: int = 0
+    eval_steps: int = 50
+
+
+def build_optimizer(
+    name: str = "adamw",
+    lr: float = 3e-4,
+    schedule: str = "constant",
+    warmup_steps: int = 0,
+    total_steps: int = 10_000,
+    weight_decay: float = 0.0,
+    **kwargs,
+):
+    """Optimizer + LR schedule, retune-compatible (the AtorchTrainer
+    ``lr_scheduler_type`` surface, ref atorch_trainer.py:127).
+
+    The returned transform is built with ``optax.inject_hyperparams`` so
+    two knobs stay live in ``opt_state.hyperparams``:
+
+    - ``learning_rate`` — driven per-step by the chosen schedule
+      ("constant" | "cosine" | "linear"; warmup_steps prepends a linear
+      warmup);
+    - ``retune_scale`` — the master's batch-size linear-scaling factor
+      (ElasticTrainer._apply_lr_scale writes it), COMPOSED with the
+      schedule instead of being overwritten by it.
+    """
+    import optax
+
+    if schedule == "constant":
+        lr_fn = (
+            optax.linear_schedule(0.0, lr, warmup_steps)
+            if warmup_steps
+            else lr
+        )
+    elif schedule == "cosine":
+        lr_fn = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=lr,
+            warmup_steps=max(warmup_steps, 1),
+            decay_steps=total_steps,
+        )
+    elif schedule == "linear":
+        lr_fn = optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, lr, max(warmup_steps, 1)),
+                optax.linear_schedule(
+                    lr, 0.0, max(total_steps - warmup_steps, 1)
+                ),
+            ],
+            [max(warmup_steps, 1)],
+        )
+    else:
+        raise ValueError(f"unknown lr schedule {schedule!r}")
+
+    if name not in ("adamw", "adam", "sgd"):
+        raise ValueError(f"unknown optimizer {name!r}")
+
+    def make(learning_rate, retune_scale):
+        # weight_decay applies to EVERY optimizer: decoupled (after the
+        # adaptive direction) for adamw/adam, classic L2-into-update for
+        # sgd. add_decayed_weights(0.0) is a no-op.
+        if name == "adamw":
+            opt = optax.adamw(
+                learning_rate, weight_decay=weight_decay, **kwargs
+            )
+        elif name == "adam":
+            opt = optax.chain(
+                optax.scale_by_adam(**kwargs),
+                optax.add_decayed_weights(weight_decay),
+                optax.scale_by_learning_rate(learning_rate),
+            )
+        else:
+            opt = optax.chain(
+                optax.add_decayed_weights(weight_decay),
+                optax.sgd(learning_rate, **kwargs),
+            )
+        return optax.chain(opt, optax.scale(retune_scale))
+
+    return optax.inject_hyperparams(make)(
+        learning_rate=lr_fn, retune_scale=1.0
+    )
 
 
 class ElasticTrainer:
@@ -59,6 +142,7 @@ class ElasticTrainer:
         devices=None,
         collate_fn: Optional[Callable] = None,
         metrics_hook: Optional[Callable[[int, Dict], None]] = None,
+        eval_dataset=None,
     ):
         import jax
 
@@ -89,6 +173,9 @@ class ElasticTrainer:
             sampler=self.sampler,
             collate_fn=collate_fn,
         )
+        self._eval_dataset = eval_dataset
+        self._collate_fn = collate_fn
+        self._eval_step_fn = None  # built lazily on first evaluate()
         self._ckptr: Optional[FlashCheckpointer] = None
         if self.tcfg.ckpt_dir:
             self._ckptr = FlashCheckpointer(self.tcfg.ckpt_dir)
@@ -127,12 +214,92 @@ class ElasticTrainer:
         sharded = shard_batch({"x": bx, "y": by}, self.mesh)
         return sharded["x"], sharded["y"]
 
+    # -- eval ----------------------------------------------------------
+    def _build_eval_step(self):
+        import jax
+
+        cfg, mesh = self.cfg, self.mesh
+        if self.accel.strategy.mesh.pp > 1:
+            from dlrover_tpu.parallel.pipeline import pipeline_loss_fn
+
+            mb = self.accel.strategy.num_microbatches
+
+            def eval_loss(params, x, y):
+                return pipeline_loss_fn(params, x, y, cfg, mesh, mb)
+
+        else:
+            from dlrover_tpu.models.transformer import forward, token_nll
+
+            def eval_loss(params, x, y):
+                # PURE NLL — no MoE aux regularizers, so eval_loss/ppl
+                # are comparable across parallelism modes and configs
+                logits, _ = forward(params, x, cfg, mesh)
+                return token_nll(logits, y)
+
+        return jax.jit(eval_loss)
+
+    def _eval_batches(self, max_batches: int):
+        """Sequential fixed-size batches over the eval set (no sampler
+        elasticity — eval restarts from the top every call)."""
+        bs = self.tcfg.batch_size
+        n = len(self._eval_dataset)
+        for start in range(0, min(max_batches * bs, n - bs + 1), bs):
+            rows = [self._eval_dataset[i] for i in range(start, start + bs)]
+            if self._collate_fn is not None:
+                yield self._collate_fn(rows)
+            elif isinstance(rows[0], dict):
+                yield {
+                    k: np.stack([r[k] for r in rows]) for k in rows[0]
+                }
+            else:
+                yield tuple(
+                    np.stack([r[j] for r in rows])
+                    for j in range(len(rows[0]))
+                )
+
+    def evaluate(self, max_batches: Optional[int] = None) -> Dict[str, float]:
+        """Run the eval set through a grad-free sharded loss step.
+        Returns {"eval_loss": mean NLL, "eval_ppl": exp(mean NLL)}."""
+        if self._eval_dataset is None:
+            raise ValueError("ElasticTrainer built without eval_dataset")
+        if self._eval_step_fn is None:
+            self._eval_step_fn = self._build_eval_step()
+        max_batches = max_batches or self.tcfg.eval_steps
+        losses = []
+        for batch in self._eval_batches(max_batches):
+            x, y = self._device_batch(batch)
+            losses.append(float(self._eval_step_fn(self.state.params, x, y)))
+        if not losses:
+            # a silent NaN here would poison every later metrics report
+            raise ValueError(
+                f"eval dataset ({len(self._eval_dataset)} rows) yields "
+                f"zero batches of size {self.tcfg.batch_size}"
+            )
+        mean = float(np.mean(losses))
+        return {
+            "eval_loss": mean,
+            "eval_ppl": float(np.exp(min(mean, 20.0))),
+        }
+
+    def current_lr(self) -> Optional[float]:
+        """The live EFFECTIVE learning rate (schedule value x the
+        master's retune scale) when the optimizer was built with
+        ``build_optimizer`` / ``optax.inject_hyperparams``."""
+        hp = getattr(self.state.opt_state, "hyperparams", None)
+        if hp and "learning_rate" in hp:
+            lr = float(hp["learning_rate"])
+            if "retune_scale" in hp:
+                lr *= float(hp["retune_scale"])
+            return lr
+        return None
+
     def train(self, num_steps: int) -> Any:
         """Run up to ``num_steps`` optimizer steps (across epochs)."""
         import jax
 
         t0 = time.time()
         start_step = self.global_step
+        self._last_eval: Dict[str, float] = {}
         while self.global_step < num_steps:
             self.dataloader.load_config()  # master-retuned batch size
             self._apply_lr_scale(self.dataloader.lr_scale)
@@ -151,14 +318,37 @@ class ElasticTrainer:
                     # at log cadence, not every step (async dispatch stays
                     # ahead of the host otherwise)
                     loss = float(metrics["loss"])
+                    scalars = {"loss": loss}
+                    lr = self.current_lr()
+                    if lr is not None:
+                        scalars["lr"] = lr
+                    if self._last_eval:
+                        scalars.update(self._last_eval)
                     if self.tcfg.report_metrics:
-                        report_runtime_metrics(step, loss=loss)
+                        # the agent's TrainingMonitor forwards these to
+                        # the master's collector (TrainMetricsReport)
+                        report_runtime_metrics(step, **scalars)
                     rate = (step - start_step) / max(
                         time.time() - t0, 1e-9
                     )
+                    lr_s = f" lr={lr:.2e}" if lr is not None else ""
                     logger.info(
-                        f"step {step}: loss={loss:.4f} ({rate:.2f} it/s)"
+                        f"step {step}: loss={loss:.4f}{lr_s} "
+                        f"({rate:.2f} it/s)"
                     )
+                if (
+                    self._eval_dataset is not None
+                    and self.tcfg.eval_interval
+                    and step % self.tcfg.eval_interval == 0
+                ):
+                    self._last_eval = self.evaluate()
+                    logger.info(
+                        f"step {step}: "
+                        f"eval_loss={self._last_eval['eval_loss']:.4f} "
+                        f"ppl={self._last_eval['eval_ppl']:.2f}"
+                    )
+                    if self._metrics_hook is not None:
+                        self._metrics_hook(step, dict(self._last_eval))
                 if self._ckptr is not None:
                     if step % self.tcfg.save_storage_interval == 0:
                         self.save(StorageType.DISK)
@@ -171,23 +361,32 @@ class ElasticTrainer:
 
     def _apply_lr_scale(self, scale: float):
         """Linear-scaling rule: when the master retunes the batch size it
-        also publishes optimizer.batch_size_factor; if the optimizer was
-        built with ``optax.inject_hyperparams`` the learning rate is
-        rescaled in place (otherwise a one-time warning is logged)."""
+        also publishes optimizer.batch_size_factor. Optimizers from
+        ``build_optimizer`` carry a dedicated ``retune_scale`` hyperparam
+        that COMPOSES with the LR schedule (the schedule rewrites
+        ``learning_rate`` every step, so multiplying that would be
+        overwritten); plain ``optax.inject_hyperparams`` optimizers fall
+        back to rescaling ``learning_rate`` in place."""
         if scale == getattr(self, "_applied_lr_scale", 1.0):
             return
         hp = getattr(self.state.opt_state, "hyperparams", None)
-        if hp is None or "learning_rate" not in hp:
+        if hp is None or (
+            "retune_scale" not in hp and "learning_rate" not in hp
+        ):
             if not getattr(self, "_warned_lr_scale", False):
                 logger.warning(
                     f"master suggests lr scale {scale} but the optimizer "
                     "has no injected hyperparams; build tx with "
-                    "optax.inject_hyperparams to enable retuning"
+                    "build_optimizer (or optax.inject_hyperparams) to "
+                    "enable retuning"
                 )
                 self._warned_lr_scale = True
             return
         prev = getattr(self, "_applied_lr_scale", 1.0)
-        hp["learning_rate"] = hp["learning_rate"] * (scale / prev)
+        if "retune_scale" in hp:
+            hp["retune_scale"] = hp["retune_scale"] * (scale / prev)
+        else:
+            hp["learning_rate"] = hp["learning_rate"] * (scale / prev)
         self._applied_lr_scale = scale
         logger.info(f"learning rate rescaled x{scale} (linear scaling)")
 

@@ -9,10 +9,13 @@ recovery — lives behind ElasticTrainer.
 """
 
 import numpy as np
-import optax
 
 from dlrover_tpu.models import gpt2_small
-from dlrover_tpu.trainer.elastic.trainer import ElasticTrainer, TrainerConfig
+from dlrover_tpu.trainer.elastic.trainer import (
+    ElasticTrainer,
+    TrainerConfig,
+    build_optimizer,
+)
 
 
 class RandomTokens:
@@ -33,10 +36,17 @@ class RandomTokens:
 def main():
     trainer = ElasticTrainer(
         model_cfg=gpt2_small(),
-        tx=optax.adamw(3e-4, weight_decay=0.01),
+        # warmup + cosine decay, retune-compatible (the master's
+        # batch-size linear-scaling factor composes with the schedule)
+        tx=build_optimizer(
+            "adamw", lr=3e-4, schedule="cosine", warmup_steps=100,
+            total_steps=1000, weight_decay=0.01,
+        ),
         dataset=RandomTokens(),
+        eval_dataset=RandomTokens(n=512, seed=1),
         trainer_cfg=TrainerConfig(
-            batch_size=8, seq_len=128, ckpt_dir="/tmp/gpt2_flash_ckpt"
+            batch_size=8, seq_len=128, ckpt_dir="/tmp/gpt2_flash_ckpt",
+            eval_interval=200, eval_steps=16,
         ),
     )
     trainer.train(num_steps=1000)

@@ -111,13 +111,19 @@ class TrainingMonitor(PollingDaemon):
         if step > self._last_step:
             self._last_step = step
             self._client.report_global_step(step)
-            # forward whatever scalar metrics the train proc published
-            # (loss / eval_loss / lr …) to the master's collector
+            # forward TRAINING scalars (loss / eval_loss / lr …) to the
+            # master's collector — not bools, and not the resource stats
+            # the ResourceMonitor already reports through its own channel
+            skip = (
+                "global_step", "timestamp", "tpu_duty_cycle",
+                "tpu_hbm_used_mb", "cpu_percent", "used_memory_mb",
+            )
             scalars = {
                 k: float(v)
                 for k, v in metrics.items()
-                if k not in ("global_step", "timestamp")
+                if k not in skip
                 and isinstance(v, (int, float))
+                and not isinstance(v, bool)
             }
             if scalars:
                 self._client.report_train_metrics(step, scalars)

@@ -4,12 +4,17 @@ replay buffer, generation backend).
 TPU-native re-design: the reference juggles four torch models across a
 DeepSpeed hybrid engine (train ↔ inference mode switches,
 ds_hybrid_engine/hybrid_engine.py:378) and an external vLLM-style
-backend. On TPU none of that split exists: generation is the same jitted
-program family as training (a ``lax.scan`` decode loop over a static
-KV cache, models/transformer.forward_step), so actor rollouts, reward
-scoring and PPO updates all run under one mesh with no weight shuttling.
+backend. On TPU generation is the same jitted program family as training
+(a ``lax.scan`` decode loop over a static KV cache,
+models/transformer.forward_step); when train and rollout use DIFFERENT
+layouts (ZeRO-3 training, replicated decode), the hybrid engine's weight
+remap collapses to one ``jax.device_put`` into the rollout shardings
+(RLHFEngine(train_mesh=, rollout_mesh=)). The reward model is trainable
+from preference pairs (rl/reward.py, Bradley–Terry) behind the same
+reward_fn seam a programmatic reward uses.
 """
 
 from dlrover_tpu.rl.generation import generate  # noqa: F401
 from dlrover_tpu.rl.buffer import ReplayBuffer  # noqa: F401
 from dlrover_tpu.rl.ppo import PPOConfig, RLHFEngine  # noqa: F401
+from dlrover_tpu.rl.reward import RewardModel  # noqa: F401

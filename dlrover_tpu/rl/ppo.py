@@ -98,7 +98,15 @@ class RLHFEngine:
         reward_fn: Callable[[np.ndarray, int], np.ndarray],
         ppo: Optional[PPOConfig] = None,
         seed: int = 0,
+        train_mesh=None,
+        rollout_mesh=None,
     ):
+        """``train_mesh``/``rollout_mesh``: when both are given, actor
+        weights live TRAIN-sharded (e.g. ZeRO-3 over fsdp) and are
+        explicitly resharded to the rollout layout before every
+        generation phase — the DS hybrid engine's train↔inference weight
+        remap (ref hybrid_engine.py:378), expressed as one
+        ``jax.device_put`` (XLA emits the all-gather/all-to-all)."""
         self.cfg = cfg
         self.ppo = ppo or PPOConfig()
         self.reward_fn = reward_fn
@@ -110,6 +118,34 @@ class RLHFEngine:
         self.critic_params = init_critic_params(
             jax.random.fold_in(key, 7), cfg
         )
+        self._train_shardings = None
+        self._rollout_shardings = None
+        if train_mesh is not None and rollout_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from dlrover_tpu.models.transformer import logical_axes
+            from dlrover_tpu.parallel.sharding_rules import (
+                apply_rules,
+                default_lm_rules,
+            )
+
+            # train layout: the LM rule table (fsdp/tp as the mesh says)
+            self._train_shardings = apply_rules(
+                logical_axes(cfg), default_lm_rules(), train_mesh
+            )
+            # rollout layout: weights REPLICATED on the rollout mesh —
+            # decode is latency-bound and batch-parallel, per-step
+            # weight all-gathers would dominate it
+            self._rollout_shardings = jax.tree_util.tree_map(
+                lambda _: NamedSharding(rollout_mesh, P()),
+                self._train_shardings,
+            )
+            self.actor_params = jax.device_put(
+                self.actor_params, self._train_shardings
+            )
+            self.ref_params = jax.device_put(
+                self.ref_params, self._rollout_shardings
+            )  # ref only ever scores rollouts
         self.tx = optax.adamw(self.ppo.learning_rate)
         self.opt_state = self.tx.init(
             {"actor": self.actor_params, "critic": self.critic_params}
@@ -141,8 +177,15 @@ class RLHFEngine:
         against the frozen ref, GAE with the critic."""
         P = prompts.shape[1]
         self._key, k = jax.random.split(self._key)
+        # the hybrid-engine weight flow: reshard the (train-layout)
+        # actor weights into the rollout layout before generating
+        rollout_params = self.actor_params
+        if self._rollout_shardings is not None:
+            rollout_params = jax.device_put(
+                self.actor_params, self._rollout_shardings
+            )
         tokens, logprobs = generate(
-            self.actor_params,
+            rollout_params,
             jnp.asarray(prompts),
             k,
             self.cfg,

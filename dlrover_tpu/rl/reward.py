@@ -1,0 +1,99 @@
+"""Trainable reward model for RLHF.
+
+Parity: the reference's reward model is one of the four managed models
+in its RL engine (atorch/rl/model_engine/model_engine.py — actor /
+critic / ref / reward), trained separately on preference pairs and then
+frozen for PPO. Here the reward model is the same transformer trunk as
+the actor/critic (``forward(..., return_hidden=True)`` — reward math can
+never drift from the model path) with a scalar head read at each
+sequence's LAST token, trained with the Bradley–Terry pairwise loss
+-log σ(r_chosen − r_rejected) (the InstructGPT recipe).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.models.config import TransformerConfig
+from dlrover_tpu.models.transformer import forward, init_params
+
+
+def init_reward_params(key, cfg: TransformerConfig):
+    """Reward model = transformer trunk + scalar reward head."""
+    trunk = init_params(key, cfg)
+    head = (
+        jax.random.normal(jax.random.fold_in(key, 2), (cfg.model_dim,))
+        * cfg.model_dim**-0.5
+    )
+    return {"trunk": trunk, "reward_head": head}
+
+
+def reward_scores(rparams, tokens: jnp.ndarray, cfg: TransformerConfig):
+    """tokens [B, T] → scalar reward per sequence [B] (head applied to
+    the final position's hidden state)."""
+    hidden, _ = forward(rparams["trunk"], tokens, cfg, return_hidden=True)
+    return jnp.einsum(
+        "bd,d->b", hidden[:, -1].astype(jnp.float32), rparams["reward_head"]
+    )
+
+
+def preference_loss(rparams, chosen, rejected, cfg: TransformerConfig):
+    """Bradley–Terry: -log σ(r_chosen − r_rejected), plus accuracy."""
+    r_c = reward_scores(rparams, chosen, cfg)
+    r_r = reward_scores(rparams, rejected, cfg)
+    loss = -jnp.mean(jax.nn.log_sigmoid(r_c - r_r))
+    acc = jnp.mean((r_c > r_r).astype(jnp.float32))
+    return loss, acc
+
+
+class RewardModel:
+    """Preference-trained reward model + the ``reward_fn`` adapter the
+    PPO engine consumes."""
+
+    def __init__(self, cfg: TransformerConfig, lr: float = 1e-4, seed: int = 0):
+        self.cfg = cfg
+        self.params = init_reward_params(jax.random.PRNGKey(seed), cfg)
+        self.tx = optax.adamw(lr)
+        self.opt_state = self.tx.init(self.params)
+        self._step = jax.jit(
+            functools.partial(_reward_update, cfg=cfg, tx=self.tx)
+        )
+        self._scores = jax.jit(functools.partial(reward_scores, cfg=cfg))
+
+    def train_on_preferences(
+        self, chosen: np.ndarray, rejected: np.ndarray, epochs: int = 1
+    ) -> dict:
+        """chosen/rejected [N, T] token pairs (chosen preferred).
+        Returns the last step's {loss, accuracy}."""
+        metrics = {}
+        for _ in range(epochs):
+            self.params, self.opt_state, metrics = self._step(
+                self.params,
+                self.opt_state,
+                jnp.asarray(chosen),
+                jnp.asarray(rejected),
+            )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def score(self, tokens: np.ndarray) -> np.ndarray:
+        return np.asarray(self._scores(self.params, jnp.asarray(tokens)))
+
+    def as_reward_fn(self):
+        """The (tokens, prompt_len) → [B] callable RLHFEngine takes —
+        a TRAINED model behind the same seam a programmatic reward uses."""
+        return lambda tokens, prompt_len: self.score(tokens)
+
+
+def _reward_update(params, opt_state, chosen, rejected, *, cfg, tx):
+    (loss, acc), grads = jax.value_and_grad(
+        preference_loss, has_aux=True
+    )(params, chosen, rejected, cfg)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, {"loss": loss, "accuracy": acc}

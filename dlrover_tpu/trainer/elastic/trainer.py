@@ -83,21 +83,29 @@ def build_optimizer(
             else lr
         )
     elif schedule == "cosine":
-        lr_fn = optax.warmup_cosine_decay_schedule(
-            init_value=0.0,
-            peak_value=lr,
-            warmup_steps=max(warmup_steps, 1),
-            decay_steps=total_steps,
+        # warmup_steps=0 means NO warmup: start at peak (forcing a
+        # 1-step warmup would make the first update a dead lr=0 step)
+        lr_fn = (
+            optax.warmup_cosine_decay_schedule(
+                init_value=0.0,
+                peak_value=lr,
+                warmup_steps=warmup_steps,
+                decay_steps=total_steps,
+            )
+            if warmup_steps
+            else optax.cosine_decay_schedule(lr, total_steps)
         )
     elif schedule == "linear":
-        lr_fn = optax.join_schedules(
-            [
-                optax.linear_schedule(0.0, lr, max(warmup_steps, 1)),
-                optax.linear_schedule(
-                    lr, 0.0, max(total_steps - warmup_steps, 1)
-                ),
-            ],
-            [max(warmup_steps, 1)],
+        decay = optax.linear_schedule(
+            lr, 0.0, max(total_steps - warmup_steps, 1)
+        )
+        lr_fn = (
+            optax.join_schedules(
+                [optax.linear_schedule(0.0, lr, warmup_steps), decay],
+                [warmup_steps],
+            )
+            if warmup_steps
+            else decay
         )
     else:
         raise ValueError(f"unknown lr schedule {schedule!r}")
@@ -370,15 +378,25 @@ class ElasticTrainer:
         if scale == getattr(self, "_applied_lr_scale", 1.0):
             return
         hp = getattr(self.state.opt_state, "hyperparams", None)
-        if hp is None or (
-            "retune_scale" not in hp and "learning_rate" not in hp
-        ):
+        # a SCHEDULE-driven learning_rate is recomputed from the step
+        # count on every update, so multiplying it in place would be
+        # silently discarded — only retune_scale can compose with it
+        lr_is_scheduled = bool(
+            getattr(self.state.opt_state, "hyperparams_states", {}).get(
+                "learning_rate"
+            )
+        )
+        can_apply = hp is not None and (
+            "retune_scale" in hp
+            or ("learning_rate" in hp and not lr_is_scheduled)
+        )
+        if not can_apply:
             if not getattr(self, "_warned_lr_scale", False):
                 logger.warning(
                     f"master suggests lr scale {scale} but the optimizer "
-                    "has no injected hyperparams; build tx with "
-                    "build_optimizer (or optax.inject_hyperparams) to "
-                    "enable retuning"
+                    "cannot accept it (no injected hyperparams, or a "
+                    "schedule without a retune_scale knob); build tx "
+                    "with build_optimizer to enable retuning"
                 )
                 self._warned_lr_scale = True
             return

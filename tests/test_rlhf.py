@@ -153,3 +153,104 @@ class TestPPO:
         after = mean_reward()
         assert after > before + 0.2, (before, after, metrics)
         assert np.isfinite(metrics["loss"])
+
+
+class TestRewardModel:
+    def test_learns_preferences(self, cfg):
+        """Bradley-Terry training: after fitting preference pairs, the
+        reward head scores chosen sequences above rejected ones on
+        HELD-OUT pairs."""
+        from dlrover_tpu.rl.reward import RewardModel
+
+        rng = np.random.default_rng(0)
+
+        def make_pairs(n):
+            # preference signal: "chosen" sequences are dominated by
+            # token 3, "rejected" by token 11
+            chosen = rng.choice([3, 4], size=(n, 12), p=[0.9, 0.1])
+            rejected = rng.choice([11, 4], size=(n, 12), p=[0.9, 0.1])
+            return chosen.astype(np.int32), rejected.astype(np.int32)
+
+        rm = RewardModel(cfg, lr=1e-3, seed=0)
+        c_tr, r_tr = make_pairs(64)
+        for _ in range(30):
+            m = rm.train_on_preferences(c_tr, r_tr)
+        assert m["accuracy"] == 1.0, m
+        c_te, r_te = make_pairs(32)
+        assert (rm.score(c_te) > rm.score(r_te)).mean() > 0.9
+
+    def test_trained_reward_drives_ppo(self, cfg):
+        """The trained reward model plugs into the PPO engine behind the
+        same reward_fn seam, and PPO moves rollouts toward the preferred
+        token distribution."""
+        from dlrover_tpu.rl.reward import RewardModel
+
+        rng = np.random.default_rng(1)
+        chosen = rng.choice([3, 4], size=(64, 12), p=[0.9, 0.1]).astype(np.int32)
+        rejected = rng.choice([11, 4], size=(64, 12), p=[0.9, 0.1]).astype(np.int32)
+        rm = RewardModel(cfg, lr=1e-3, seed=0)
+        for _ in range(30):
+            rm.train_on_preferences(chosen, rejected)
+
+        engine = RLHFEngine(
+            cfg,
+            rm.as_reward_fn(),
+            ppo=PPOConfig(
+                rollout_batch=16, max_new_tokens=8, minibatch_size=16,
+                ppo_epochs=2, learning_rate=5e-3, kl_coef=0.01,
+            ),
+            seed=0,
+        )
+        prompts = np.zeros((16, 4), dtype=np.int32)
+        before = float(rm.score(np.asarray(generate(
+            engine.actor_params, jnp.asarray(prompts),
+            jax.random.PRNGKey(9), cfg, max_new_tokens=8,
+        )[0])).mean())
+        for _ in range(6):
+            engine.make_experience(prompts)
+            engine.train(prompt_len=4)
+        after = float(rm.score(np.asarray(generate(
+            engine.actor_params, jnp.asarray(prompts),
+            jax.random.PRNGKey(9), cfg, max_new_tokens=8,
+        )[0])).mean())
+        assert after > before, (before, after)
+
+
+class TestHybridPlacement:
+    def test_train_and_rollout_use_different_shardings(self, cfg):
+        """The weight-flow analog of the DS hybrid engine: actor weights
+        train ZeRO-3-sharded (fsdp) and are explicitly resharded to the
+        replicated rollout layout each generation phase; the cycle still
+        learns and the two layouts are demonstrably different."""
+        from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+        train_mesh = build_mesh(MeshConfig(fsdp=4, dp=2))
+        rollout_mesh = build_mesh(MeshConfig(dp=8))
+        target = 7
+
+        def reward_fn(tokens, prompt_len):
+            return (tokens[:, prompt_len:] == target).mean(axis=1) * 4.0
+
+        engine = RLHFEngine(
+            cfg,
+            reward_fn,
+            ppo=PPOConfig(
+                rollout_batch=16, max_new_tokens=8, minibatch_size=16,
+                ppo_epochs=1, learning_rate=5e-3, kl_coef=0.01,
+            ),
+            seed=0,
+            train_mesh=train_mesh,
+            rollout_mesh=rollout_mesh,
+        )
+        # train layout: wq sharded over fsdp; ref (rollout) replicated
+        wq = engine.actor_params["layers"][0]["attn"]["wq"]
+        ref_wq = engine.ref_params["layers"][0]["attn"]["wq"]
+        assert not wq.sharding.is_fully_replicated
+        assert ref_wq.sharding.is_fully_replicated
+        for _ in range(2):
+            exp = engine.make_experience(np.zeros((16, 4), dtype=np.int32))
+            metrics = engine.train(prompt_len=4)
+        assert np.isfinite(metrics["loss"])
+        # actor weights stayed in the TRAIN layout across the cycle
+        wq2 = engine.actor_params["layers"][0]["attn"]["wq"]
+        assert not wq2.sharding.is_fully_replicated

@@ -3,8 +3,9 @@ scheduler/watcher/scaler layers, SURVEY §2.5).
 
 Pieces:
 - ``crds/``: ElasticJob + ScalePlan CRD manifests (the contract).
-- ``client.K8sApi``: narrow API seam; ``RealK8sApi`` (kubernetes SDK,
-  import-gated) or ``FakeK8sApi`` (tests/simulation).
+- ``client.K8sApi``: narrow API seam; ``RealK8sApi`` (stdlib HTTP
+  against the API server's REST protocol — no SDK dependency) or
+  ``FakeK8sApi`` (tests/simulation).
 - ``scaler.PodScaler`` / ``scaler.ElasticJobScaler``: the master-side
   Scaler implementations.
 - ``watcher.PodWatcher``: pod lifecycle → NodeEvents.

@@ -1,16 +1,19 @@
 """K8s API seam: the narrow surface the scalers/watcher/operator need.
 
 Parity: dlrover/python/scheduler/kubernetes.py:121 (k8sClient wrapper).
-The real implementation is gated on the ``kubernetes`` SDK (not part of
-the base image); ``FakeK8sApi`` is a complete in-memory double — the
-same test strategy as the reference (SURVEY §4: "K8s faked, not spoken
-to", mock_k8s_client in test_utils.py) — and also powers local
-simulation runs of the operator.
+``RealK8sApi`` speaks the API server's REST protocol directly over
+stdlib HTTP (service-account token + CA in-cluster) — no SDK
+dependency, and testable against a recorded/replay HTTP server (the
+envtest analog, ref go/operator suite_test.go). ``FakeK8sApi`` is a
+complete in-memory double — the same test strategy as the reference
+(SURVEY §4: "K8s faked, not spoken to", mock_k8s_client in
+test_utils.py) — and also powers local simulation runs of the operator.
 """
 
 from __future__ import annotations
 
 import copy
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -78,110 +81,197 @@ class K8sApi:
         raise NotImplementedError
 
 
+class ApiError(Exception):
+    """Non-2xx API-server response (other than the mapped 404/409)."""
+
+    def __init__(self, status: int, body: str = ""):
+        super().__init__(f"API server returned {status}: {body[:200]}")
+        self.status = status
+
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
 class RealK8sApi(K8sApi):
-    """Backed by the official SDK (import gated)."""
+    """Speaks the K8s REST API directly over stdlib HTTP — no SDK
+    dependency (the base image has none, and the Go operator's
+    client-go is just this protocol anyway).
 
-    def __init__(self, namespace: str = "default", in_cluster: bool = True):
-        try:
-            from kubernetes import client, config
-        except ImportError as e:  # pragma: no cover - sdk not in image
-            raise ImportError(
-                "the 'kubernetes' package is required for the k8s "
-                "platform (pip install kubernetes)"
-            ) from e
-        if in_cluster:
-            config.load_incluster_config()
-        else:
-            config.load_kube_config()
-        self._core = client.CoreV1Api()
-        self._objs = client.CustomObjectsApi()
+    In-cluster defaults: ``https://kubernetes.default.svc`` with the
+    mounted service-account bearer token and CA. Tests point
+    ``base_url`` at a local recorded/replay server — the envtest analog
+    (ref go/operator suite_test.go) that keeps this class covered
+    without a cluster.
+    """
+
+    def __init__(
+        self,
+        namespace: str = "default",
+        base_url: str = "",
+        token: str = "",
+        ca_file: str = "",
+        timeout: float = 10.0,
+    ):
+        import ssl
+
         self.namespace = namespace
+        in_cluster = os.path.exists(f"{_SA_DIR}/token")
+        if not base_url and not in_cluster:
+            # outside a pod the in-cluster DNS default would fail with
+            # an opaque URLError; demand explicit wiring instead
+            raise ValueError(
+                "RealK8sApi outside a cluster needs explicit base_url "
+                "(your API server URL) and token/ca_file — e.g. from "
+                "`kubectl config view` / a service-account secret"
+            )
+        self._base = (
+            base_url or "https://kubernetes.default.svc"
+        ).rstrip("/")
+        self._token = token
+        self._timeout = timeout
+        self._ssl_ctx = None
+        if self._base.startswith("https"):
+            ca = ca_file or (
+                f"{_SA_DIR}/ca.crt"
+                if os.path.exists(f"{_SA_DIR}/ca.crt")
+                else ""
+            )
+            self._ssl_ctx = (
+                ssl.create_default_context(cafile=ca)
+                if ca
+                else ssl.create_default_context()
+            )
 
-    def create_pod(self, namespace, body):  # pragma: no cover - needs cluster
-        from kubernetes.client.rest import ApiException
+    # -- HTTP core -----------------------------------------------------
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        content_type: str = "application/json",
+    ):
+        import json as _json
+        import urllib.error
+        import urllib.request
 
+        url = f"{self._base}{path}"
+        data = (
+            _json.dumps(body).encode() if body is not None else None
+        )
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        # projected service-account tokens are time-bound and rotated by
+        # the kubelet: re-read the mounted file per request (what
+        # client-go does), falling back to the constructor-given token
+        token = self._token
+        if not token:
+            try:
+                with open(f"{_SA_DIR}/token") as f:
+                    token = f.read().strip()
+            except OSError:
+                token = ""
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
         try:
-            return self._core.create_namespaced_pod(namespace, body)
-        except ApiException as e:
+            with urllib.request.urlopen(
+                req, timeout=self._timeout, context=self._ssl_ctx
+            ) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, e.read().decode(errors="replace")) from e
+        return _json.loads(payload) if payload else None
+
+    @staticmethod
+    def _pods(ns: str) -> str:
+        return f"/api/v1/namespaces/{ns}/pods"
+
+    @staticmethod
+    def _services(ns: str) -> str:
+        return f"/api/v1/namespaces/{ns}/services"
+
+    @staticmethod
+    def _crs(ns: str, plural: str) -> str:
+        return f"/apis/{GROUP}/{VERSION}/namespaces/{ns}/{plural}"
+
+    # -- pods / services -----------------------------------------------
+    def create_pod(self, namespace, body):
+        try:
+            return self._request("POST", self._pods(namespace), body)
+        except ApiError as e:
             if e.status == 409:
                 raise AlreadyExists(body["metadata"]["name"]) from e
             raise
 
-    def create_service(self, namespace, body):  # pragma: no cover
-        from kubernetes.client.rest import ApiException
-
+    def create_service(self, namespace, body):
         try:
-            return self._core.create_namespaced_service(namespace, body)
-        except ApiException as e:
+            return self._request("POST", self._services(namespace), body)
+        except ApiError as e:
             if e.status == 409:
                 raise AlreadyExists(body["metadata"]["name"]) from e
             raise
 
-    def list_services(self, namespace):  # pragma: no cover
-        ret = self._core.list_namespaced_service(namespace)
-        return [s.to_dict() for s in ret.items]
+    def list_services(self, namespace):
+        ret = self._request("GET", self._services(namespace))
+        return (ret or {}).get("items", [])
 
-    def delete_pod(self, namespace, name):  # pragma: no cover
-        from kubernetes.client.rest import ApiException
-
+    def delete_pod(self, namespace, name):
         try:
-            self._core.delete_namespaced_pod(name, namespace)
+            self._request("DELETE", f"{self._pods(namespace)}/{name}")
             return True
-        except ApiException as e:
+        except ApiError as e:
             return e.status == 404
 
-    def list_pods(self, namespace, label_selector=""):  # pragma: no cover
-        ret = self._core.list_namespaced_pod(
-            namespace, label_selector=label_selector
-        )
-        return [p.to_dict() for p in ret.items]
+    def list_pods(self, namespace, label_selector=""):
+        import urllib.parse
 
-    def get_custom_object(self, namespace, plural, name):  # pragma: no cover
-        from kubernetes.client.rest import ApiException
+        path = self._pods(namespace)
+        if label_selector:
+            path += "?labelSelector=" + urllib.parse.quote(label_selector)
+        ret = self._request("GET", path)
+        return (ret or {}).get("items", [])
 
+    # -- custom objects ------------------------------------------------
+    def get_custom_object(self, namespace, plural, name):
         try:
-            return self._objs.get_namespaced_custom_object(
-                GROUP, VERSION, namespace, plural, name
+            return self._request(
+                "GET", f"{self._crs(namespace, plural)}/{name}"
             )
-        except ApiException as e:
+        except ApiError as e:
             if e.status == 404:
                 return None
             raise
 
-    def list_custom_objects(self, namespace, plural):  # pragma: no cover
-        ret = self._objs.list_namespaced_custom_object(
-            GROUP, VERSION, namespace, plural
-        )
-        return ret.get("items", [])
+    def list_custom_objects(self, namespace, plural):
+        ret = self._request("GET", self._crs(namespace, plural))
+        return (ret or {}).get("items", [])
 
-    def create_custom_object(self, namespace, plural, body):  # pragma: no cover
-        from kubernetes.client.rest import ApiException
-
+    def create_custom_object(self, namespace, plural, body):
         try:
-            return self._objs.create_namespaced_custom_object(
-                GROUP, VERSION, namespace, plural, body
+            return self._request(
+                "POST", self._crs(namespace, plural), body
             )
-        except ApiException as e:
+        except ApiError as e:
             if e.status == 409:
                 raise AlreadyExists(body["metadata"]["name"]) from e
             raise
 
-    def patch_custom_object_status(
-        self, namespace, plural, name, status
-    ):  # pragma: no cover
-        self._objs.patch_namespaced_custom_object_status(
-            GROUP, VERSION, namespace, plural, name, {"status": status}
+    def patch_custom_object_status(self, namespace, plural, name, status):
+        self._request(
+            "PATCH",
+            f"{self._crs(namespace, plural)}/{name}/status",
+            {"status": status},
+            content_type="application/merge-patch+json",
         )
 
-    def delete_custom_object(self, namespace, plural, name):  # pragma: no cover
-        from kubernetes.client.rest import ApiException
-
+    def delete_custom_object(self, namespace, plural, name):
         try:
-            self._objs.delete_namespaced_custom_object(
-                GROUP, VERSION, namespace, plural, name
+            self._request(
+                "DELETE", f"{self._crs(namespace, plural)}/{name}"
             )
             return True
-        except ApiException as e:
+        except ApiError as e:
             return e.status == 404
 
 

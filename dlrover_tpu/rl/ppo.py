@@ -120,6 +120,13 @@ class RLHFEngine:
         )
         self._train_shardings = None
         self._rollout_shardings = None
+        if (train_mesh is None) != (rollout_mesh is None):
+            # silently ignoring half a placement request would leave
+            # weights in a layout the user didn't ask for (OOM or wrong
+            # sharding with no visible cause)
+            raise ValueError(
+                "hybrid placement needs BOTH train_mesh and rollout_mesh"
+            )
         if train_mesh is not None and rollout_mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -260,3 +260,244 @@ class TestDistributedMasterEndToEnd:
         ]
         assert len(running) == 2
         master.watcher.stop()
+
+
+class _ReplayApiServer:
+    """Recorded/replayed API-server responses over real HTTP — the
+    envtest analog (ref go/operator suite_test.go) that exercises
+    RealK8sApi's wire protocol without a cluster. Responses are keyed by
+    (method, path); every request (headers + body) is recorded for
+    assertions."""
+
+    def __init__(self, responses):
+        import http.server
+        import threading
+
+        self.requests = []
+        replay = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _serve(self):
+                import json as _json
+
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(length) if length else b""
+                replay.requests.append(
+                    {
+                        "method": self.command,
+                        "path": self.path,
+                        "auth": self.headers.get("Authorization", ""),
+                        "content_type": self.headers.get(
+                            "Content-Type", ""
+                        ),
+                        "body": _json.loads(body) if body else None,
+                    }
+                )
+                status, payload = responses.get(
+                    (self.command, self.path), (404, {"reason": "NotFound"})
+                )
+                data = _json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_GET = do_POST = do_DELETE = do_PATCH = _serve
+
+        self._srv = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler
+        )
+        self.port = self._srv.server_address[1]
+        threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        ).start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class TestRealK8sApi:
+    """RealK8sApi's REST protocol against recorded responses: paths,
+    verbs, auth header, content types, and the 404/409 mappings."""
+
+    def _api(self, responses):
+        srv = _ReplayApiServer(responses)
+        from dlrover_tpu.k8s.client import RealK8sApi
+
+        return srv, RealK8sApi(
+            base_url=f"http://127.0.0.1:{srv.port}", token="tok-123"
+        )
+
+    def test_pod_crud_and_auth(self):
+        pod = {"metadata": {"name": "w-0"}}
+        srv, api = self._api(
+            {
+                ("POST", "/api/v1/namespaces/ns/pods"): (201, pod),
+                ("GET", "/api/v1/namespaces/ns/pods"): (
+                    200,
+                    {"items": [pod]},
+                ),
+                ("DELETE", "/api/v1/namespaces/ns/pods/w-0"): (200, {}),
+            }
+        )
+        try:
+            created = api.create_pod("ns", pod)
+            assert created["metadata"]["name"] == "w-0"
+            assert api.list_pods("ns") == [pod]
+            assert api.delete_pod("ns", "w-0") is True
+            # absent pod: 404 maps to True (converged)
+            assert api.delete_pod("ns", "gone") is True
+            for r in srv.requests:
+                assert r["auth"] == "Bearer tok-123"
+        finally:
+            srv.close()
+
+    def test_label_selector_is_url_encoded(self):
+        srv, api = self._api(
+            {
+                (
+                    "GET",
+                    "/api/v1/namespaces/ns/pods"
+                    "?labelSelector=elastic.dlrover-tpu.org/job%3Dj1",
+                ): (200, {"items": []}),
+            }
+        )
+        try:
+            assert (
+                api.list_pods("ns", "elastic.dlrover-tpu.org/job=j1")
+                == []
+            )
+        finally:
+            srv.close()
+
+    def test_conflict_maps_to_already_exists(self):
+        from dlrover_tpu.k8s.client import AlreadyExists
+
+        srv, api = self._api(
+            {
+                ("POST", "/api/v1/namespaces/ns/pods"): (
+                    409,
+                    {"reason": "AlreadyExists"},
+                ),
+            }
+        )
+        try:
+            with pytest.raises(AlreadyExists):
+                api.create_pod("ns", {"metadata": {"name": "w-0"}})
+        finally:
+            srv.close()
+
+    def test_custom_objects_and_status_patch(self):
+        base = (
+            "/apis/elastic.dlrover-tpu.org/v1alpha1/namespaces/ns"
+        )
+        job = {"metadata": {"name": "j1"}, "spec": {}}
+        srv, api = self._api(
+            {
+                ("POST", f"{base}/elasticjobs"): (201, job),
+                ("GET", f"{base}/elasticjobs/j1"): (200, job),
+                ("GET", f"{base}/elasticjobs/gone"): (404, {}),
+                ("GET", f"{base}/elasticjobs"): (200, {"items": [job]}),
+                ("PATCH", f"{base}/elasticjobs/j1/status"): (200, {}),
+                ("DELETE", f"{base}/elasticjobs/j1"): (200, {}),
+            }
+        )
+        try:
+            api.create_custom_object("ns", "elasticjobs", job)
+            assert api.get_custom_object("ns", "elasticjobs", "j1") == job
+            assert api.get_custom_object("ns", "elasticjobs", "gone") is None
+            assert api.list_custom_objects("ns", "elasticjobs") == [job]
+            api.patch_custom_object_status(
+                "ns", "elasticjobs", "j1", {"phase": "Running"}
+            )
+            assert api.delete_custom_object("ns", "elasticjobs", "j1")
+            patch = [r for r in srv.requests if r["method"] == "PATCH"][0]
+            assert patch["content_type"] == "application/merge-patch+json"
+            assert patch["body"] == {"status": {"phase": "Running"}}
+        finally:
+            srv.close()
+
+    def test_operator_runs_on_real_api_protocol(self):
+        """The SAME operator reconcile that runs on FakeK8sApi drives
+        RealK8sApi's wire protocol: one tick creates the master service
+        + pod for a recorded ElasticJob."""
+        base = "/apis/elastic.dlrover-tpu.org/v1alpha1/namespaces/default"
+        job = {
+            "metadata": {"name": "jx"},
+            "spec": {"replicaSpecs": {"worker": {"replicas": 2}}},
+        }
+        srv, api = self._api(
+            {
+                ("GET", "/api/v1/namespaces/default/pods"): (
+                    200,
+                    {"items": []},
+                ),
+                ("GET", "/api/v1/namespaces/default/services"): (
+                    200,
+                    {"items": []},
+                ),
+                ("GET", f"{base}/elasticjobs"): (200, {"items": [job]}),
+                ("GET", f"{base}/scaleplans"): (200, {"items": []}),
+                ("POST", "/api/v1/namespaces/default/pods"): (201, {}),
+                ("POST", "/api/v1/namespaces/default/services"): (201, {}),
+                ("PATCH", f"{base}/elasticjobs/jx/status"): (200, {}),
+            }
+        )
+        try:
+            ElasticJobOperator(api)._tick()
+            posts = [
+                r["path"] for r in srv.requests if r["method"] == "POST"
+            ]
+            assert "/api/v1/namespaces/default/services" in posts
+            assert "/api/v1/namespaces/default/pods" in posts
+        finally:
+            srv.close()
+
+
+class TestDriftRepair:
+    def test_out_of_band_worker_pod_deletion_is_repaired(self):
+        """Controller-runtime drift repair, hand-rolled-loop edition: a
+        worker pod deleted OUT OF BAND (kubectl delete, preemption) must
+        come back through watcher -> job manager -> auto-scaler tick,
+        with no failure event ever reported by the pod itself."""
+        api = FakeK8sApi()
+        master = DistributedJobMaster(
+            node_num=2, job_name="drift", api=api, use_operator=False
+        )
+        master._create_initial_scale_plan()
+        assert "drift-worker-0" in api.pods
+        for name in ("drift-worker-0", "drift-worker-1"):
+            api.set_pod_phase(name, "Running")
+        master.watcher._tick()
+
+        # out-of-band drift: the pod VANISHES (no Failed phase reported)
+        api.delete_pod("default", "drift-worker-1")
+        master.watcher._tick()  # reports DELETED
+        master.auto_scaler.check_and_scale()  # periodic repair tick
+        workers = [p for p in api.pods if p.startswith("drift-worker")]
+        assert len(workers) == 2, api.pods.keys()
+        assert "drift-worker-1" not in workers  # a NEW pod, not a ghost
+
+    def test_out_of_band_master_pod_deletion_is_repaired(self):
+        """The operator's reconcile restores a vanished master pod for a
+        live ElasticJob on the next periodic tick."""
+        api = FakeK8sApi()
+        api.create_custom_object(
+            "default",
+            "elasticjobs",
+            {
+                "metadata": {"name": "mj"},
+                "spec": {"replicaSpecs": {"worker": {"replicas": 1}}},
+            },
+        )
+        op = ElasticJobOperator(api)
+        op._tick()
+        assert "mj-master" in api.pods
+        api.delete_pod("default", "mj-master")  # kubectl delete
+        op._tick()  # periodic reconcile repairs the drift
+        assert "mj-master" in api.pods

@@ -14,12 +14,16 @@ TPU at ~15 MB/s; a real v5p host moves GB/s) so restore measures
 framework overhead, not the harness link.
 
 MFU (BASELINE.md rows 9-10: ATorch Llama2-7B hits 204.7 TFLOPs/65.6% HFU
-on A100): a separate matmul-bound phase — GPT-2 124M, bf16, on-device
-data, state chained step-to-step so the tunnel cannot reorder — reporting
-model TFLOP/s and the fraction of the chip's peak.
+on A100): the headline probe trains GPT-2 XL (1.557B) end to end — bf16,
+flash attention, fused 8-bit Adam, gradient accumulation — and reports
+the fraction of chip peak (``run_mfu_big``; no remat, so MFU == HFU,
+vs the reference's HFU which counts remat recompute). A small-model
+probe (124M) rides along for round-over-round comparability, and a
+staging microbench reports GB-scale shm/disk throughput so the
+tiny-model goodput number has a measured extrapolation.
 
 Prints ONE JSON line: {"metric","value","unit","vs_baseline","mfu_pct",
-...breakdown}.
+"stage_MBps","persist_MBps",...breakdown}.
 """
 
 from __future__ import annotations
@@ -273,6 +277,181 @@ def _goodput_body(
     return True
 
 
+def run_mfu_big(jax, results: dict):
+    """Big-model MFU probe: GPT-2 XL (1.557B params) FULL training
+    update on one chip — bf16 params/activations, flash attention, the
+    repo's fused 8-bit Adam, gradient accumulation.
+
+    Design notes (measured on the v5e-lite harness chip):
+    - HBM budget: params(bf16, 3.1 GB) + 8-bit Adam state(~3.3 GB) +
+      grads(bf16, 3.1 GB) + activations cap the microbatch at 4x512
+      tokens WITHOUT remat. fwd+bwd alone runs at ~56-57% of peak at
+      that shape — the chip's ceiling for this model (D=1600 pads the
+      128-lane tiles; the 50k-vocab head is ~61% efficient).
+    - the optimizer pass is param-sized HBM traffic (~170 ms in tree
+      form); gradient accumulation (K microbatches per update — the
+      standard large-global-batch recipe; global batch here is
+      K*4*512 = 131k tokens) amortizes it to noise. Accumulation runs
+      HOST-side as three small programs because this harness's remote
+      compile helper cannot compile the 48-layer scanned/remat graph
+      (build_train_step(grad_accum=K) is the in-framework path).
+    - a scalar readback per UPDATE syncs the dispatch queue (the async
+      frees of donated buffers otherwise race the next update's
+      allocations at this HBM occupancy) and costs ~RTT/K per
+      microbatch.
+
+    vs BASELINE.md row 9 (Llama2-7B, 65.6% **HFU** with full activation
+    checkpointing on A100): HFU counts the remat recompute (~4/3x), so
+    65.6% HFU ~= 49.2% MFU. This probe runs NO remat: its MFU == HFU.
+    """
+    import functools
+
+    import jax.numpy as jnp
+    import optax
+
+    from dlrover_tpu.models import gpt2_xl, init_params
+    from dlrover_tpu.models.transformer import loss_fn
+    from dlrover_tpu.ops.quantized_optim import adamw_8bit
+
+    if jax.devices()[0].platform == "cpu":
+        results["mfu_pct"] = None
+        return
+
+    mb, seq, K = 4, 512, 64
+    cfg = replace(
+        gpt2_xl(), max_seq_len=seq, dtype="bfloat16",
+        param_dtype="bfloat16",
+    )
+    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+    n_params = sum(
+        int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params)
+    )
+    tx = adamw_8bit(3e-4)
+    opt = jax.jit(tx.init)(params)
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def grad_acc(p, g_acc, x):
+        loss, g = jax.value_and_grad(lambda q: loss_fn(q, x, x, cfg))(p)
+        return jax.tree_util.tree_map(jnp.add, g_acc, g), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def apply(p, o, g_sum):
+        g = jax.tree_util.tree_map(lambda a: a / K, g_sum)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o
+
+    zeros_g = jax.jit(
+        lambda p: jax.tree_util.tree_map(jnp.zeros_like, p)
+    )
+    x = jax.jit(
+        lambda k: jax.random.randint(
+            k, (mb, seq), 0, cfg.vocab_size, jnp.int32
+        )
+    )(jax.random.PRNGKey(1))
+    jax.block_until_ready(x)
+
+    def one_update(p, o):
+        g = zeros_g(p)
+        loss = None
+        for _ in range(K):
+            g, loss = grad_acc(p, g, x)
+        p, o = apply(p, o, g)
+        float(loss)  # per-update sync (see docstring)
+        return p, o
+
+    params, opt = one_update(params, opt)  # compile + warmup
+    steps = 3
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt = one_update(params, opt)
+    dt = (time.perf_counter() - t0) / steps
+
+    flops = K * _model_flops_per_step(cfg, mb, seq, n_params)
+    tflops = flops / dt / 1e12
+    peak = _chip_peak_tflops(jax.devices()[0])
+    results["mfu_pct"] = (
+        round(100.0 * tflops / peak, 1) if peak else None
+    )
+    results["model_tflops"] = round(tflops, 1)
+    results["mfu_model"] = (
+        f"gpt2_xl(1.557B) bf16 8bit-adam grad_accum{K} "
+        f"mb{mb} seq{seq} (global batch {K * mb * seq} tok)"
+    )
+    results["mfu_update_s"] = round(dt, 3)
+    results["mfu_note"] = (
+        "full training update incl. fused 8-bit Adam, no remat (MFU==HFU"
+        "); ref 65.6% HFU w/ full remat ~= 49.2% MFU-equivalent"
+    )
+
+
+def run_staging_bench(jax, results: dict):
+    """Flash-checkpoint staging throughput at GB scale.
+
+    The goodput scenario's model self-calibrates to the harness's slow
+    tunneled D2H link, so GB-scale staging never runs there; these two
+    numbers bound the extrapolation to real hosts:
+
+    - ``stage_MBps``: device->host->shared-memory, through the SAME
+      primitives the engine's staging thread uses (device_get + shm
+      buffer copy), sized to ~10 s on the measured link;
+    - ``persist_MBps``: shm->disk (the agent saver's leg), measured at
+      1 GB — host-local, so it runs at real scale regardless of the
+      device link.
+    """
+    from multiprocessing import shared_memory
+
+    # -- persist leg: shm -> disk at 1 GB (no device involved)
+    size = 1 << 30
+    shm = shared_memory.SharedMemory(create=True, size=size)
+    try:
+        shm.buf[:] = b"\x7f" * size
+        tmpdir = tempfile.mkdtemp(prefix="bench_persist_")
+        path = os.path.join(tmpdir, "blob")
+        t0 = time.perf_counter()
+        with open(path, "wb") as f:
+            f.write(shm.buf)
+            f.flush()
+            os.fsync(f.fileno())
+        dt = time.perf_counter() - t0
+        results["persist_MBps"] = round(size / dt / 1e6, 1)
+        results["persist_GB"] = round(size / 1e9, 2)
+        os.unlink(path)
+        os.rmdir(tmpdir)
+    finally:
+        shm.close()
+        shm.unlink()
+
+    # -- stage leg: device -> shm, sized to ~10 s on this link
+    bw = results.get("d2h_link_MBps", 0.0) * 1e6
+    if not bw or jax.devices()[0].platform == "cpu":
+        results["stage_MBps"] = None
+        return
+    import jax.numpy as jnp
+
+    stage_bytes = int(min(max(bw * 10, 64 << 20), 8 << 30))
+    n = stage_bytes // 4
+    make = jax.jit(lambda s: jnp.full((n,), s, jnp.float32))
+    jax.block_until_ready(make(1.0))
+    shm = shared_memory.SharedMemory(create=True, size=stage_bytes)
+    try:
+        x = make(2.0)
+        jax.block_until_ready(x)
+        t0 = time.perf_counter()
+        host = np.asarray(x)  # the engine's device_get leg
+        # the engine's shm leg is a zero-extra-copy view assignment
+        # (ckpt/shm_handler.py) — tobytes() would double host memory
+        # and the measured time
+        np.frombuffer(shm.buf, np.uint8, stage_bytes)[:] = host.view(
+            np.uint8
+        ).ravel()
+        dt = time.perf_counter() - t0
+        results["stage_MBps"] = round(stage_bytes / dt / 1e6, 1)
+        results["stage_GB"] = round(stage_bytes / 1e9, 3)
+    finally:
+        shm.close()
+        shm.unlink()
+
+
 def run_mfu(jax, results: dict):
     """Compute-bound probe: GPT-2 124M, bf16, on-device data, chained
     state. No checkpointing, no host transfers inside the timed region.
@@ -329,14 +508,14 @@ def run_mfu(jax, results: dict):
     flops = _model_flops_per_step(cfg, batch, seq, n_params)
     tflops = flops / dt / 1e12
     peak = _chip_peak_tflops(jax.devices()[0])
-    results["model_tflops"] = round(tflops, 1)
-    results["mfu_pct"] = (
+    results["mfu_small_tflops"] = round(tflops, 1)
+    results["mfu_small_pct"] = (
         round(100.0 * tflops / (peak * len(jax.devices())), 1)
         if peak
         else None
     )
-    results["mfu_step_s"] = round(dt, 4)
-    results["mfu_model"] = f"gpt2_small(124M) bs{batch} seq{seq} bf16"
+    results["mfu_small_step_s"] = round(dt, 4)
+    results["mfu_small_model"] = f"gpt2_small(124M) bs{batch} seq{seq} bf16"
     results["device_kind"] = getattr(
         jax.devices()[0], "device_kind", "unknown"
     )
@@ -355,10 +534,25 @@ def main() -> int:
         # replace rc=1 and can drop the buffered error line
         os._exit(1)
     try:
+        run_staging_bench(jax, results)
+    except Exception as e:
+        results["stage_MBps"] = None
+        results["staging_error"] = repr(e)
+    try:
         run_mfu(jax, results)
     except Exception as e:
-        results["mfu_pct"] = None
-        results["mfu_error"] = repr(e)
+        results["mfu_small_pct"] = None
+        results["mfu_small_error"] = repr(e)
+    # the headline MFU: 1.5B full-update probe (one retry — at ~95% HBM
+    # occupancy a transient allocation race can OOM a first attempt)
+    for attempt in (1, 2):
+        try:
+            run_mfu_big(jax, results)
+            results.pop("mfu_big_error", None)
+            break
+        except Exception as e:
+            results["mfu_pct"] = None
+            results["mfu_big_error"] = repr(e)
     print(json.dumps(results))
     sys.stdout.flush()
     sys.stderr.flush()

@@ -215,3 +215,113 @@ def measure_step(
         ),
         device_kind=getattr(dev, "device_kind", "unknown"),
     )
+
+
+@dataclass
+class ModuleLatency:
+    name: str
+    ms: float
+    gflops: float  # analytic, per invocation
+    tflops_per_s: Optional[float]  # achieved (None when flops unknown)
+
+
+def module_breakdown(
+    cfg: TransformerConfig,
+    tx,
+    batch: int,
+    seq: int,
+    iters: int = 10,
+) -> List[ModuleLatency]:
+    """MEASURED per-module latency — the "why is my step slow" view
+    (parity: AProfiler's per-module flops/latency/memory tables,
+    atorch utils/prof.py:489-650).
+
+    Each module is compiled and timed in isolation on the current
+    default device: embedding lookup, ONE transformer block fwd and
+    fwd+bwd, the LM head fwd+bwd (the vocab matmul + softmax NLL), and
+    the optimizer update over the full parameter tree. Isolation
+    overstates HBM traffic relative to a fused step (boundaries
+    materialize), so read the numbers as per-module ROOFLINES: a module
+    whose isolated time already dominates the measured whole-step time
+    is the bottleneck.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dlrover_tpu.models.transformer import (
+        _attention_block,
+        _mlp_block,
+        embed_tokens,
+        init_params,
+        lm_head,
+        token_nll,
+    )
+
+    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    prof = profile_model(cfg, batch, seq)
+    by_name = {m.name: m for m in prof.modules}
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
+    x = jnp.zeros((batch, seq, cfg.model_dim), jnp.dtype(cfg.dtype))
+    layer0 = params["layers"][0]
+
+    def _block_fwd(layer, x):
+        h = _attention_block(x, layer, cfg, None, positions)
+        h, _ = _mlp_block(h, layer, cfg, None)
+        return h
+
+    def _block_loss(layer, x):
+        return jnp.sum(_block_fwd(layer, x).astype(jnp.float32))
+
+    def _head_loss(p, x):
+        return token_nll(lm_head(p, x, cfg), tokens)
+
+    grads = jax.tree_util.tree_map(
+        lambda a: jnp.ones_like(a) * 1e-4, params
+    )
+    opt_state = jax.jit(tx.init)(params)
+
+    def _opt(p, o, g):
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o
+
+    block_fwd_flops = (
+        by_name["block0.attn"].fwd_flops + by_name["block0.mlp"].fwd_flops
+    )
+    cases = [
+        ("embed", jax.jit(lambda p, t: embed_tokens(p, t, cfg)),
+         (params, tokens), 0.0),
+        ("block_fwd", jax.jit(_block_fwd), (layer0, x), block_fwd_flops),
+        ("block_fwd_bwd", jax.jit(jax.grad(_block_loss, argnums=(0, 1))),
+         (layer0, x), 3.0 * block_fwd_flops),
+        ("lm_head_fwd_bwd", jax.jit(jax.grad(_head_loss)),
+         (params, x), 3.0 * by_name["lm_head"].fwd_flops),
+        ("optimizer_update", jax.jit(_opt),
+         (params, opt_state, grads), 0.0),
+    ]
+
+    out: List[ModuleLatency] = []
+    for name, fn, args, flops in cases:
+        r = fn(*args)  # compile + warmup
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(*args)
+        # force through a scalar readback (tunneled runtimes return from
+        # block_until_ready early)
+        leaf = jax.tree_util.tree_leaves(r)[0]
+        float(np.asarray(leaf).ravel()[0])
+        dt = (time.perf_counter() - t0) / iters
+        out.append(
+            ModuleLatency(
+                name=name,
+                ms=round(dt * 1e3, 3),
+                gflops=round(flops / 1e9, 4),
+                tflops_per_s=(
+                    round(flops / dt / 1e12, 2) if flops else None
+                ),
+            )
+        )
+    return out

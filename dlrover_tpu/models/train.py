@@ -132,19 +132,62 @@ def build_train_step(
     tx,
     rules: Optional[ShardingRules] = None,
     donate: bool = True,
+    grad_accum: int = 1,
 ) -> Callable:
-    """jitted (state, tokens, targets) → (state, metrics)."""
+    """jitted (state, tokens, targets) → (state, metrics).
+
+    ``grad_accum=K``: split the batch into K microbatches scanned
+    sequentially, average their grads, apply ONE optimizer update — the
+    large-global-batch recipe that also amortizes the optimizer's
+    param-sized HBM pass over K× the tokens (at 1B+ params that pass is
+    a visible slice of the step). Batch must divide by K; activation
+    memory is per-microbatch."""
     sh = None  # shardings come from the arrays themselves (jit infers)
 
-    def train_step(state: TrainState, tokens, targets):
+    def grads_and_loss(params, tokens, targets):
         def lf(p):
             return loss_fn(
                 p, tokens, targets, cfg, mesh, return_aux=True
             )
 
-        (loss, aux), grads = jax.value_and_grad(lf, has_aux=True)(
-            state.params
-        )
+        return jax.value_and_grad(lf, has_aux=True)(params)
+
+    def train_step(state: TrainState, tokens, targets):
+        if grad_accum > 1:
+            B = tokens.shape[0]
+            if B % grad_accum:
+                raise ValueError(
+                    f"batch {B} must divide into grad_accum={grad_accum}"
+                )
+            mb = B // grad_accum
+            xs = tokens.reshape(grad_accum, mb, *tokens.shape[1:])
+            ys = targets.reshape(grad_accum, mb, *targets.shape[1:])
+
+            def body(carry, xy):
+                g_acc, loss_acc, aux_acc = carry
+                (loss, aux), g = grads_and_loss(state.params, *xy)
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                aux_acc = jax.tree_util.tree_map(jnp.add, aux_acc, aux)
+                return (g_acc, loss_acc + loss, aux_acc), None
+
+            from dlrover_tpu.models.transformer import _zero_aux
+
+            zeros_g = jax.tree_util.tree_map(
+                jnp.zeros_like, state.params
+            )
+            (g_sum, loss_sum, aux_sum), _ = jax.lax.scan(
+                body, (zeros_g, jnp.float32(0.0), _zero_aux()), (xs, ys)
+            )
+            k = jnp.float32(grad_accum)
+            grads = jax.tree_util.tree_map(
+                lambda g: (g / k.astype(g.dtype)), g_sum
+            )
+            loss = loss_sum / k
+            aux = jax.tree_util.tree_map(lambda a: a / k, aux_sum)
+        else:
+            (loss, aux), grads = grads_and_loss(
+                state.params, tokens, targets
+            )
         updates, new_opt = tx.update(
             grads, state.opt_state, state.params
         )

@@ -18,11 +18,21 @@ matmul, the AQT recipe (public google/aqt):
   (the dominant matmuls) through this op; everything else (norms,
   attention softmax, residuals) stays in bf16/fp32.
 
-When it pays: the dynamic quantize pass re-reads both operands, so the
-int8 path only wins when the matmul is MXU-bound (large contraction
-dims, big models) — measured on v5e, a bandwidth-bound 16k x 768 x 3072
-GPT-2-small MLP shape runs FASTER in bf16 (47 vs 29 TFLOP/s). Default
-off; enable for large-model shapes after measuring.
+When it pays — measured on v5e-lite (2026-07, chained in-jit loops so
+tunnel dispatch overhead cannot pollute the timing; an earlier
+unchained measurement had wrongly concluded bf16 wins):
+
+    M=8192 tokens          bf16 TF   int8 TF   speedup
+    K=768,  N=3072  (124M)   14.7      24.6     1.67x
+    K=1600, N=6400  (1.5B)   49.2      82.3     1.67x
+    K=4096, N=11008 (7B)    115.9     182.7     1.58x
+    K=8192, N=8192          131.1     203.7     1.55x
+
+int8 wins at EVERY training-relevant MLP shape once the token batch is
+MXU-sized (M >= ~8k): the dynamic-quantize pass costs one extra read of
+each operand, repaid by the 2x int8 MXU rate. ``int8_mlp`` remains
+default-off only because quantization noise is a per-model accuracy
+decision, not a performance one.
 """
 
 from __future__ import annotations

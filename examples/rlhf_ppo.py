@@ -1,6 +1,7 @@
-"""RLHF with the pure-JAX PPO engine: KV-cache rollouts + clipped PPO
-against a programmatic reward (swap ``reward_fn`` for a learned reward
-model scoring full sequences).
+"""RLHF with the pure-JAX PPO engine: a reward model TRAINED from
+preference pairs (Bradley–Terry), KV-cache rollouts, clipped PPO — and
+the hybrid train/rollout placement split (actor trains ZeRO-3-sharded,
+rolls out replicated; the weight remap is one ``jax.device_put``).
 
     python examples/rlhf_ppo.py
 """
@@ -8,20 +9,28 @@ model scoring full sequences).
 import numpy as np
 
 from dlrover_tpu.models import tiny
-from dlrover_tpu.rl import PPOConfig, RLHFEngine
-
-
-def reward_fn(tokens, prompt_len):
-    """Reward completions that use token 7 (stand-in for a reward
-    model; shape: [batch] float)."""
-    return (tokens[:, prompt_len:] == 7).mean(axis=1) * 4.0
+from dlrover_tpu.rl import PPOConfig, RLHFEngine, RewardModel
 
 
 def main():
     cfg = tiny(vocab_size=64, num_layers=2, max_seq_len=64)
+
+    # 1) reward model from preference pairs: "chosen" completions favor
+    # token 7 (stand-in for human preference data)
+    rng = np.random.default_rng(0)
+    chosen = rng.choice([7, 9], size=(128, 16), p=[0.9, 0.1]).astype(np.int32)
+    rejected = rng.choice([3, 9], size=(128, 16), p=[0.9, 0.1]).astype(np.int32)
+    rm = RewardModel(cfg, lr=1e-3)
+    for _ in range(40):
+        m = rm.train_on_preferences(chosen, rejected)
+    print(f"reward model: acc={m['accuracy']:.2f} loss={m['loss']:.4f}")
+
+    # 2) PPO against the trained reward model. On a multi-chip mesh,
+    # pass train_mesh=/rollout_mesh= to train sharded and roll out
+    # replicated (see tests/test_rlhf.py::TestHybridPlacement).
     engine = RLHFEngine(
         cfg,
-        reward_fn,
+        rm.as_reward_fn(),
         ppo=PPOConfig(
             rollout_batch=32,
             max_new_tokens=16,

@@ -332,3 +332,34 @@ class TestOptStateShardings:
                     checked += 1
                     break
         assert checked == 2 * len(flat_p)
+
+
+def test_grad_accum_matches_full_batch():
+    """K-microbatch accumulation == one full-batch step (same data,
+    same update) to float tolerance."""
+    import optax
+    from dlrover_tpu.models import build_train_step, init_sharded_state
+
+    cfg = tiny(num_layers=2, dtype="float32")
+    mesh = build_mesh(MeshConfig(dp=8))
+    tx = optax.adamw(1e-2)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+
+    s1, _ = init_sharded_state(jax.random.PRNGKey(0), cfg, mesh, tx)
+    s2, _ = init_sharded_state(jax.random.PRNGKey(0), cfg, mesh, tx)
+    full = build_train_step(cfg, mesh, tx, donate=False)
+    accum = build_train_step(cfg, mesh, tx, donate=False, grad_accum=4)
+    s1, m1 = full(s1, x, x)
+    s2, m2 = accum(s2, x, x)
+    # fp32 reduction-order noise only (microbatch-mean vs full-batch)
+    np.testing.assert_allclose(
+        float(m1["loss"]), float(m2["loss"]), rtol=1e-5
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
+        ),
+        s1.params,
+        s2.params,
+    )

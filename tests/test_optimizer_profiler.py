@@ -189,3 +189,28 @@ class TestProfiler:
         prof = profile_model(cfg, batch=8, seq=32)
         m = measure_step(step, state, (b["x"], b["y"]), prof.step_flops, iters=3)
         assert m.step_seconds > 0 and m.achieved_tflops > 0
+
+
+def test_module_breakdown_measures_each_module():
+    """The AProfiler analog: per-module measured latency + achieved
+    TFLOP/s for embed / block fwd / block fwd+bwd / head / optimizer."""
+    import optax
+
+    from dlrover_tpu.accel.profiler import module_breakdown
+    from dlrover_tpu.models import tiny
+
+    cfg = tiny(num_layers=2, dtype="float32")
+    rows = module_breakdown(cfg, optax.adamw(1e-3), batch=4, seq=32, iters=3)
+    names = [r.name for r in rows]
+    assert names == [
+        "embed", "block_fwd", "block_fwd_bwd", "lm_head_fwd_bwd",
+        "optimizer_update",
+    ]
+    for r in rows:
+        assert r.ms > 0
+    bwd = dict((r.name, r) for r in rows)
+    # fwd+bwd must cost more than fwd alone, and carry ~3x the flops
+    assert bwd["block_fwd_bwd"].ms > bwd["block_fwd"].ms
+    assert bwd["block_fwd_bwd"].gflops == pytest.approx(
+        3 * bwd["block_fwd"].gflops, rel=0.05
+    )

@@ -46,6 +46,7 @@ def auto_accelerate(
     donate: bool = True,
     search: str = "combination",
     optimizations: Sequence[str] = (),
+    grad_accum: int = 1,
 ) -> AccelerateResult:
     """Pick (or apply) a strategy and return the compiled artifacts.
 
@@ -73,10 +74,32 @@ def auto_accelerate(
             strategy,
             opts=tuple(dict.fromkeys(tuple(strategy.opts) + opt_names)),
         )
+    if grad_accum > 1 and batch % grad_accum:
+        raise ValueError(
+            f"batch {batch} must divide into grad_accum={grad_accum}"
+        )
+    if strategy is not None and grad_accum > 1:
+        if strategy.mesh.pp > 1:
+            # the pipeline's own microbatch schedule IS the accumulation
+            # mechanism; stamping ga onto a pp strategy would publish a
+            # descriptor claiming accumulation the compiled step ignores
+            raise ValueError(
+                "grad_accum does not apply to pipeline strategies — "
+                "use num_microbatches"
+            )
+        unit = batch // grad_accum
+        shards = strategy.mesh.dp * strategy.mesh.fsdp
+        if unit % shards:
+            raise ValueError(
+                f"per-accumulation microbatch {unit} cannot shard over "
+                f"dp*fsdp={shards} (most devices would compute padding)"
+            )
+        strategy = dc_replace(strategy, grad_accum=grad_accum)
     if strategy is None:
         t0 = time.time()
         cands = candidate_strategies(
-            cfg, len(devices), batch, seq, max_candidates=max_candidates
+            cfg, len(devices), batch, seq,
+            max_candidates=max_candidates, grad_accum=grad_accum,
         )
         if not cands:
             raise ValueError(

@@ -46,9 +46,23 @@ def candidate_strategies(
     seq: int,
     max_candidates: int = 32,
     dtype: Optional[str] = None,
+    grad_accum: int = 1,
 ) -> List[Strategy]:
-    """Enumerate valid mesh factorizations, best-prior first."""
+    """Enumerate valid mesh factorizations, best-prior first.
+
+    ``grad_accum=K`` stamps K onto every non-pipeline candidate and
+    tightens the batch-divisibility rule to the per-accumulation
+    microbatch (batch/K must still shard over dp*fsdp) — accumulation
+    microbatches smaller than the data-parallel axis would make every
+    timed measurement run on padding. Pipeline candidates keep
+    ``grad_accum=1``: their own microbatch schedule IS the
+    accumulation mechanism.
+    """
     dtype = dtype or cfg.dtype
+    if batch % grad_accum:
+        raise ValueError(
+            f"batch {batch} must divide into grad_accum={grad_accum}"
+        )
     long_context = seq >= 2048
     deep = cfg.num_layers >= 8
     out: List[Strategy] = []
@@ -83,7 +97,10 @@ def candidate_strategies(
                     rem = rem_sp // ep
                     for fsdp in _divisors(rem):
                         dp = rem // fsdp
-                        if batch % (dp * fsdp) != 0:
+                        # the unit that must shard over dp*fsdp is the
+                        # per-accumulation microbatch, not the batch
+                        unit = batch if pp > 1 else batch // grad_accum
+                        if unit % (dp * fsdp) != 0:
                             continue
                         mesh = MeshConfig(
                             dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep, pp=pp
@@ -112,8 +129,26 @@ def candidate_strategies(
                                 mesh=mesh,
                                 dtype=dtype,
                                 num_microbatches=mb,
+                                grad_accum=1 if pp > 1 else grad_accum,
                             )
                         )
+                        # deep models with few microbatches: the
+                        # interleaved schedule shrinks the bubble
+                        # ~v-fold (virtual stages need L % (pp*v) == 0)
+                        if (
+                            pp > 1
+                            and mb < 4 * (pp - 1)
+                            and cfg.num_layers % (pp * 2) == 0
+                        ):
+                            out.append(
+                                Strategy(
+                                    mesh=mesh,
+                                    dtype=dtype,
+                                    num_microbatches=mb,
+                                    pp_schedule="interleaved",
+                                    pp_virtual=2,
+                                )
+                            )
 
     out.sort(key=lambda s: _prior(s, cfg, batch, seq))
     return out[:max_candidates]

@@ -131,7 +131,10 @@ def _build(
             state_shardings,
         )
 
-        step_fn = build_train_step(cfg, mesh, tx, donate=donate)
+        step_fn = build_train_step(
+            cfg, mesh, tx, donate=donate,
+            grad_accum=strategy.grad_accum,
+        )
         shardings = state_shardings(cfg, mesh, tx)
 
         def init_fn(key):

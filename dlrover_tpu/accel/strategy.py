@@ -25,6 +25,10 @@ class Strategy:
     dtype: str = "bfloat16"
     # >1 runs a pipeline schedule over the mesh's pp axis
     num_microbatches: int = 1
+    # >1 splits the batch into K sequential microbatches per optimizer
+    # update (models/train.py grad_accum — amortizes the param-sized
+    # optimizer pass and enables large global batches)
+    grad_accum: int = 1
     # "gpipe", "1f1b", or "interleaved" (parallel/pipeline.py)
     pp_schedule: str = "gpipe"
     pp_virtual: int = 2  # chunks/device when pp_schedule == "interleaved"
@@ -39,6 +43,8 @@ class Strategy:
         bits = ["x".join(f"{a}{s}" for a, s in axes.items())]
         if self.num_microbatches > 1:
             bits.append(f"mb{self.num_microbatches}")
+        if self.grad_accum > 1:
+            bits.append(f"ga{self.grad_accum}")
         # the opt registry rewrites pp_schedule when opts are APPLIED;
         # a candidate logged before apply_optimizations still carries
         # the schedule only in opts — honor either source

@@ -50,6 +50,9 @@ class TrainerConfig:
     # eval dataset every ``eval_interval`` optimizer steps
     eval_interval: int = 0
     eval_steps: int = 50
+    # >1: split each batch into K sequential microbatches per optimizer
+    # update (batch_size must divide by K)
+    grad_accum: int = 1
 
 
 def build_optimizer(
@@ -166,6 +169,7 @@ class ElasticTrainer:
             devices=devices,
             strategy=strategy,
             donate=False,
+            grad_accum=self.tcfg.grad_accum,
         )
         self.cfg = self.accel.cfg
         self.mesh = self.accel.mesh

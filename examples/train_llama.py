@@ -1,0 +1,71 @@
+"""Llama-family elastic training (rope + RMSNorm + SwiGLU + GQA).
+
+Parity: the reference's Llama-2 throughput example
+(atorch/examples/llama2/README.md:398 — FSDP + checkpointing + AMP).
+The TPU version is the same ElasticTrainer call as GPT-2: the
+architecture switches live on the config, the parallelism on the
+strategy search, gradient accumulation on TrainerConfig.
+
+    dlrover-tpu-run --nproc-per-node=1 examples/train_llama.py
+"""
+
+from dataclasses import replace
+
+import numpy as np
+
+from dlrover_tpu.models import llama2_7b
+from dlrover_tpu.trainer.elastic.trainer import (
+    ElasticTrainer,
+    TrainerConfig,
+    build_optimizer,
+)
+
+
+def llama_small():
+    """A ~110M Llama-shaped model (same switches as 7B, scaled down) —
+    swap for ``llama2_7b()`` on a pod slice."""
+    return replace(
+        llama2_7b(),
+        num_layers=12,
+        model_dim=768,
+        num_heads=12,
+        num_kv_heads=4,   # grouped-query attention
+        mlp_dim=2048,
+        max_seq_len=1024,
+    )
+
+
+class RandomTokens:
+    def __init__(self, n=4096, seq=1024, vocab=32000, seed=0):
+        rng = np.random.default_rng(seed)
+        self.data = rng.integers(0, vocab, (n, seq + 1), dtype=np.int32)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        row = self.data[i]
+        return {"x": row[:-1], "y": row[1:]}
+
+
+def main():
+    trainer = ElasticTrainer(
+        model_cfg=llama_small(),
+        tx=build_optimizer(
+            "adamw", lr=3e-4, schedule="cosine", warmup_steps=200,
+            total_steps=5000, weight_decay=0.1,
+        ),
+        dataset=RandomTokens(),
+        eval_dataset=RandomTokens(n=256, seed=1),
+        trainer_cfg=TrainerConfig(
+            batch_size=16, seq_len=1024, ckpt_dir="/tmp/llama_flash_ckpt",
+            eval_interval=500, eval_steps=8,
+            grad_accum=4,  # 4 microbatches per optimizer update
+        ),
+    )
+    trainer.train(num_steps=5000)
+    trainer.close()
+
+
+if __name__ == "__main__":
+    main()

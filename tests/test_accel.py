@@ -328,3 +328,71 @@ def test_pinned_strategy_honors_optimizations():
     )
     assert result.cfg.int8_mlp
     assert "int8_mlp" in result.strategy.opts
+
+
+def test_grad_accum_threaded_through_strategy():
+    """auto_accelerate(grad_accum=K) stamps K onto the winning strategy
+    and the produced step really accumulates (batch splits into K)."""
+    cfg = tiny(num_layers=2)
+    tx = optax.adamw(1e-3)
+    pinned = Strategy(mesh=MeshConfig(dp=8), dtype="float32")
+    result = auto_accelerate(
+        cfg, tx, batch=16, seq=32, devices=jax.devices()[:8],
+        strategy=pinned, grad_accum=2,
+    )
+    assert result.strategy.grad_accum == 2
+    assert "ga2" in result.strategy.describe()
+    rt = Strategy.from_json(result.strategy.to_json())
+    assert rt.grad_accum == 2
+    state = result.init_fn(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, cfg.vocab_size, (16, 32)).astype(np.int32)
+    state, metrics = result.step_fn(state, x, x)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_candidates_include_interleaved_for_deep_models():
+    from dlrover_tpu.accel.candidates import candidate_strategies
+
+    cfg = tiny(num_layers=8, num_experts=0)
+    cands = candidate_strategies(cfg, 8, 8, 64, max_candidates=32)
+    il = [s for s in cands if s.pp_schedule == "interleaved"]
+    assert il, "deep model should yield interleaved pp candidates"
+    for s in il:
+        assert s.mesh.pp > 1
+        assert cfg.num_layers % (s.mesh.pp * s.pp_virtual) == 0
+
+
+def test_grad_accum_rejects_pp_and_bad_batch():
+    cfg = tiny(num_layers=4)
+    tx = optax.adamw(1e-3)
+    with pytest.raises(ValueError, match="num_microbatches"):
+        auto_accelerate(
+            cfg, tx, batch=8, seq=32, devices=jax.devices()[:8],
+            strategy=Strategy(
+                mesh=MeshConfig(pp=2, dp=4), num_microbatches=4
+            ),
+            grad_accum=2,
+        )
+    with pytest.raises(ValueError, match="divide"):
+        auto_accelerate(
+            cfg, tx, batch=6, seq=32, devices=jax.devices()[:8],
+            grad_accum=4,
+        )
+
+
+def test_candidates_respect_grad_accum_microbatch_divisibility():
+    """The unit sharded over dp*fsdp is batch/K: dp=8 must be pruned
+    when batch=8 and K=4 (microbatch 2 cannot shard 8 ways), and pp
+    candidates never carry grad_accum."""
+    from dlrover_tpu.accel.candidates import candidate_strategies
+
+    cfg = tiny(num_layers=8, num_experts=0)
+    cands = candidate_strategies(cfg, 8, 8, 64, grad_accum=4)
+    for s in cands:
+        if s.mesh.pp > 1:
+            assert s.grad_accum == 1
+        else:
+            assert s.grad_accum == 4
+            assert (8 // 4) % (s.mesh.dp * s.mesh.fsdp) == 0
+    assert all(s.mesh.dp * s.mesh.fsdp <= 2 or s.mesh.pp > 1 for s in cands)

@@ -263,3 +263,23 @@ class TestTrainerSurface:
         finally:
             c.close()
             m.stop()
+
+
+def test_trainer_grad_accum(tmp_path):
+    """TrainerConfig.grad_accum threads through the strategy into the
+    train step; training still converges."""
+    t = ElasticTrainer(
+        model_cfg=tiny(),
+        tx=optax.adamw(1e-2),
+        dataset=_Tokens(),
+        trainer_cfg=TrainerConfig(
+            batch_size=16, seq_len=32, report_metrics=False,
+            log_interval=1, grad_accum=2,
+        ),
+        strategy=Strategy(mesh=MeshConfig(dp=8), dtype="float32"),
+    )
+    assert t.accel.strategy.grad_accum == 2
+    losses = []
+    t._metrics_hook = lambda s, m: losses.append(float(m["loss"]))
+    t.train(num_steps=5)
+    assert losses[-1] < losses[0]

@@ -130,6 +130,49 @@ def oom_adjust(
     )
 
 
+UNDERPERFORMANCE_RATIO = 0.6
+
+
+def underperformance_check(
+    ds: Datastore,
+    job: str,
+    samples: Optional[List[comm.JobMetricsSample]] = None,
+) -> str:
+    """Fleet-comparative diagnosis (the init/hot-adjust family's third
+    leg, ref optimize_job_ps_init_adjust_resource.go /
+    optimize_job_hot_ps_resource.go): a job whose throughput at size N
+    is far below the FLEET's best observed at that size is sick in a
+    way its own history cannot reveal — a straggling host, a bad NIC, a
+    mis-sharded input pipeline. Returns a human-actionable reason
+    string ("" when healthy or no comparable history)."""
+    samples = ds.job_metrics(job) if samples is None else samples
+    # judge only the job's CURRENT size over its recent samples: a
+    # stale warmup sample at a size the job has left must not flag it
+    # as sick forever
+    recent = [
+        s for s in samples[-20:]
+        if s.alive_nodes > 0 and s.steps_per_sec > 0
+    ]
+    if not recent:
+        return ""
+    size = recent[-1].alive_nodes
+    speed = max(
+        (s.steps_per_sec for s in recent if s.alive_nodes == size),
+        default=0.0,
+    )
+    if speed <= 0:
+        return ""
+    fleet, _, n_jobs = ds.fleet_size_curve()
+    ref = fleet.get(size)
+    if n_jobs and ref and speed < UNDERPERFORMANCE_RATIO * ref:
+        return (
+            f"underperforming vs fleet: {speed:.2f} steps/s at "
+            f"{size} nodes vs fleet best {ref:.2f} — run the "
+            "network check / inspect hosts"
+        )
+    return ""
+
+
 def bad_node_exclusion(
     ds: Datastore, now: Optional[float] = None
 ) -> Tuple[str, ...]:
@@ -190,6 +233,11 @@ def run_algorithms(
     ):
         plan.worker_memory_mb = oom.worker_memory_mb
         plan.reason = "; ".join(p for p in (plan.reason, oom.reason) if p)
+
+    sick = underperformance_check(ds, job, samples=samples)
+    if sick:
+        logger.warning(f"brain: job {job} {sick}")
+        plan.reason = "; ".join(p for p in (plan.reason, sick) if p)
 
     plan.exclude_nodes = bad_node_exclusion(ds, now=now)
     return plan

@@ -20,6 +20,7 @@ facade owns the full elastic story so a user train script collapses to
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
@@ -53,6 +54,18 @@ class TrainerConfig:
     # >1: split each batch into K sequential microbatches per optimizer
     # update (batch_size must divide by K)
     grad_accum: int = 1
+    # save-strategy / early-stop hooks (ref atorch_trainer.py save_
+    # strategy + EarlyStoppingCallback): save_best persists the best-
+    # eval checkpoint to its OWN directory (ckpt_dir/best — the
+    # periodic saves must never supersede it) with the best loss in a
+    # sidecar so restarts don't regress it; early_stopping_patience
+    # stops training after that many consecutive evals without
+    # improvement (0 = never). Both need eval_interval + eval_dataset.
+    save_best: bool = False
+    # best-saves block on the disk commit; during the steep-improvement
+    # phase evals improve every time, so persist at most this often
+    save_best_min_interval_s: float = 60.0
+    early_stopping_patience: int = 0
 
 
 def build_optimizer(
@@ -189,9 +202,20 @@ class ElasticTrainer:
         self._collate_fn = collate_fn
         self._eval_step_fn = None  # built lazily on first evaluate()
         self._ckptr: Optional[FlashCheckpointer] = None
+        self._best_ckptr: Optional[FlashCheckpointer] = None
+        # the historical best survives restarts via a sidecar; a fresh
+        # run starts at +inf
+        self._best_eval_loss = float("inf")
+        self._last_best_save = 0.0
         if self.tcfg.ckpt_dir:
             self._ckptr = FlashCheckpointer(self.tcfg.ckpt_dir)
             self._maybe_restore()
+            if self.tcfg.save_best:
+                self._best_dir = os.path.join(
+                    self.tcfg.ckpt_dir, "best"
+                )
+                self._best_ckptr = FlashCheckpointer(self._best_dir)
+                self._best_eval_loss = self._load_best_sidecar()
 
     # -- checkpoint ----------------------------------------------------
     def _ckpt_state(self):
@@ -293,6 +317,55 @@ class ElasticTrainer:
             "eval_ppl": float(np.exp(min(mean, 20.0))),
         }
 
+    def _best_sidecar_path(self) -> str:
+        return os.path.join(self._best_dir, "best_eval.json")
+
+    def _load_best_sidecar(self) -> float:
+        import json
+
+        try:
+            with open(self._best_sidecar_path()) as f:
+                return float(json.load(f)["eval_loss"])
+        except (OSError, ValueError, KeyError):
+            return float("inf")
+
+    def _after_eval(self, step: int) -> bool:
+        """save-best / early-stopping bookkeeping; True = stop now."""
+        import json
+
+        loss = self._last_eval.get("eval_loss", float("inf"))
+        if loss < self._best_eval_loss:
+            self._best_eval_loss = loss
+            self._evals_since_best = 0
+            if (
+                self._best_ckptr is not None
+                and time.time() - self._last_best_save
+                >= self.tcfg.save_best_min_interval_s
+            ):
+                logger.info(
+                    f"step {step}: new best eval_loss={loss:.4f}; "
+                    f"persisting to {self._best_dir}"
+                )
+                if self._best_ckptr.save_checkpoint(
+                    step, self._ckpt_state(), StorageType.DISK
+                ):
+                    # the sidecar records the PERSISTED best — written
+                    # only after the commit, so a crash mid-save cannot
+                    # leave it claiming a checkpoint that isn't there
+                    tmp = f"{self._best_sidecar_path()}.tmp.{os.getpid()}"
+                    with open(tmp, "w") as f:
+                        json.dump(
+                            {"eval_loss": loss, "step": step}, f
+                        )
+                    os.replace(tmp, self._best_sidecar_path())
+                    self._last_best_save = time.time()
+        else:
+            self._evals_since_best += 1
+        return (
+            self.tcfg.early_stopping_patience > 0
+            and self._evals_since_best >= self.tcfg.early_stopping_patience
+        )
+
     def current_lr(self) -> Optional[float]:
         """The live EFFECTIVE learning rate (schedule value x the
         master's retune scale) when the optimizer was built with
@@ -312,6 +385,10 @@ class ElasticTrainer:
         t0 = time.time()
         start_step = self.global_step
         self._last_eval: Dict[str, float] = {}
+        # _best_eval_loss deliberately NOT reset: the sidecar-loaded
+        # historical best must not be superseded by a restarted run's
+        # first (worse) eval
+        self._evals_since_best = 0
         while self.global_step < num_steps:
             self.dataloader.load_config()  # master-retuned batch size
             self._apply_lr_scale(self.dataloader.lr_scale)
@@ -361,6 +438,15 @@ class ElasticTrainer:
                     )
                     if self._metrics_hook is not None:
                         self._metrics_hook(step, dict(self._last_eval))
+                    if self._after_eval(step):
+                        logger.info(
+                            f"early stopping at step {step}: no eval "
+                            f"improvement in "
+                            f"{self.tcfg.early_stopping_patience} evals "
+                            f"(best {self._best_eval_loss:.4f})"
+                        )
+                        jax.block_until_ready(self.state.params)
+                        return self.state
                 if self._ckptr is not None:
                     if step % self.tcfg.save_storage_interval == 0:
                         self.save(StorageType.DISK)
@@ -415,3 +501,5 @@ class ElasticTrainer:
     def close(self):
         if self._ckptr is not None:
             self._ckptr.engine.close()
+        if self._best_ckptr is not None:
+            self._best_ckptr.engine.close()

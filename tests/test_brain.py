@@ -309,3 +309,24 @@ def test_brain_outage_keeps_standing_exclusions():
     )
     auto.run_optimization_pass()
     assert calls == [()], "authoritative empty tuple clears exclusions"
+
+
+def test_underperformance_flagged_against_fleet(brain):
+    """A running job far below the FLEET's best throughput at the same
+    size gets a diagnostic its own history cannot produce."""
+    hist = BrainClient(brain, "fast-hist")
+    sick = BrainClient(brain, "slow-job")
+    healthy = BrainClient(brain, "ok-job")
+    try:
+        hist.persist_metrics(_sample(4, 20.0, ts=1.0))
+        hist.report_job_end("completed", worker_count=4)
+        # same size, 25% of fleet best -> flagged
+        sick.persist_metrics(_sample(4, 5.0, ts=1.0))
+        plan = sick.optimize()
+        assert "underperforming vs fleet" in plan.reason, plan
+        # 80% of fleet best -> healthy, no flag
+        healthy.persist_metrics(_sample(4, 16.0, ts=1.0))
+        plan = healthy.optimize()
+        assert "underperforming" not in plan.reason, plan
+    finally:
+        hist.close(); sick.close(); healthy.close()

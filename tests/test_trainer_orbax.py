@@ -283,3 +283,63 @@ def test_trainer_grad_accum(tmp_path):
     t._metrics_hook = lambda s, m: losses.append(float(m["loss"]))
     t.train(num_steps=5)
     assert losses[-1] < losses[0]
+
+
+def test_save_best_and_early_stopping(tmp_path):
+    """save_best persists a DISK checkpoint on eval improvement; early
+    stopping halts after `patience` evals without improvement (an
+    eval set DISJOINT from training stops improving quickly at this
+    scale)."""
+    AsyncCheckpointSaver.reset()
+    AsyncCheckpointSaver.start_async_saving_ckpt(local_shard_num=1)
+    try:
+        ckpt_dir = str(tmp_path / "best")
+        t = ElasticTrainer(
+            model_cfg=tiny(),
+            tx=optax.adamw(5e-2),  # aggressive: overfits train fast
+            dataset=_Tokens(),
+            eval_dataset=_Tokens(n=32, seed=99),  # disjoint tokens
+            trainer_cfg=TrainerConfig(
+                batch_size=8, seq_len=32, report_metrics=False,
+                log_interval=50, eval_interval=2, eval_steps=2,
+                ckpt_dir=ckpt_dir, save_memory_interval=10**6,
+                save_storage_interval=10**6,
+                save_best=True, save_best_min_interval_s=0.0,
+                early_stopping_patience=2,
+            ),
+            strategy=Strategy(mesh=MeshConfig(dp=8), dtype="float32"),
+        )
+        t.train(num_steps=60)
+        stopped_at = t.global_step
+        assert stopped_at < 60, "early stopping never fired"
+        # the best checkpoint lives in its OWN directory (periodic saves
+        # must never supersede it) with the sidecar recording its loss
+        import json, os
+        best_dir = os.path.join(ckpt_dir, "best")
+        best_step = t._best_ckptr.engine.latest_step(best_dir)
+        assert best_step >= 0
+        side = json.load(open(os.path.join(best_dir, "best_eval.json")))
+        assert side["step"] == best_step
+        recorded_best = side["eval_loss"]
+        t.close()
+
+        # a restarted run must NOT regress the stored best: its first
+        # (worse) eval is not declared a new best
+        t2 = ElasticTrainer(
+            model_cfg=tiny(),
+            tx=optax.adamw(5e-2),
+            dataset=_Tokens(),
+            eval_dataset=_Tokens(n=32, seed=99),
+            trainer_cfg=TrainerConfig(
+                batch_size=8, seq_len=32, report_metrics=False,
+                log_interval=50, eval_interval=2, eval_steps=2,
+                ckpt_dir=ckpt_dir, save_memory_interval=10**6,
+                save_storage_interval=10**6,
+                save_best=True, save_best_min_interval_s=0.0,
+            ),
+            strategy=Strategy(mesh=MeshConfig(dp=8), dtype="float32"),
+        )
+        assert t2._best_eval_loss == pytest.approx(recorded_best)
+        t2.close()
+    finally:
+        AsyncCheckpointSaver.reset()

@@ -6,3 +6,7 @@ from dlrover_tpu.data.shm_feed import (  # noqa: F401
     ShmBatchWriter,
     ShmDataFeeder,
 )
+from dlrover_tpu.data.token_dataset import (  # noqa: F401
+    MemmapTokenDataset,
+    write_tokens,
+)

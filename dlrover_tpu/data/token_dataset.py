@@ -1,0 +1,108 @@
+"""Memory-mapped token corpus — the pretraining data path.
+
+Parity: the reference's trainer datasets read pre-tokenized corpora
+(dlrover/trainer elastic dataset utilities; the llama2 example feeds
+tokenized files). The TPU-host-friendly layout is one flat binary file
+of token ids opened with ``np.memmap``: zero parse cost, O(1) random
+access by window index (what the ElasticDistributedSampler shards and
+resumes over), and the OS page cache does the staging.
+
+Layout: little-endian unsigned ids, dtype inferred from a tiny JSON
+header sidecar (``<path>.meta.json``) written by ``write_tokens`` —
+uint16 for vocabularies < 65536 (GPT-2's 50257 fits), uint32 otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def write_tokens(path: str, tokens: np.ndarray) -> str:
+    """Persist a 1-D token array as ``<path>`` + ``<path>.meta.json``.
+    Returns ``path``. (The tokenizer step of a data pipeline.)"""
+    tokens = np.asarray(tokens)
+    if tokens.ndim != 1:
+        raise ValueError(f"tokens must be 1-D, got shape {tokens.shape}")
+    if tokens.size and int(tokens.min()) < 0:
+        raise ValueError("token ids must be non-negative")
+    dtype = np.uint16 if (tokens.size == 0 or int(tokens.max()) < 65536) else np.uint32
+    # meta FIRST and atomically: a reader (or crash) between the two
+    # replaces must never pair new data with a stale dtype — decoding
+    # uint16 bytes as uint32 is silent garbage. Meta-then-data means the
+    # worst interleaving is old data read with new meta, which fails
+    # loudly (size mismatch) instead of silently.
+    meta = {"dtype": np.dtype(dtype).name, "count": int(tokens.size)}
+    mtmp = f"{path}.meta.json.tmp.{os.getpid()}"
+    with open(mtmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(mtmp, f"{path}.meta.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    tokens.astype(dtype).tofile(tmp)
+    os.replace(tmp, path)
+    return path
+
+
+class MemmapTokenDataset:
+    """Fixed-length next-token windows over a memmapped token file.
+
+    Items are ``{"x": [seq_len] int32, "y": [seq_len] int32}`` with
+    ``y`` the one-step-shifted continuation — directly consumable by
+    ``ElasticTrainer`` (and shardable/resumable through its sampler).
+
+    ``stride`` defaults to ``seq_len`` (disjoint windows, one epoch =
+    one pass over the corpus); smaller strides oversample boundaries.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        seq_len: int,
+        stride: Optional[int] = None,
+        dtype: Optional[str] = None,
+    ):
+        self.seq_len = seq_len
+        self.stride = stride or seq_len
+        if self.stride <= 0 or seq_len <= 0:
+            raise ValueError("seq_len and stride must be positive")
+        count = None
+        if dtype is None:
+            try:
+                with open(f"{path}.meta.json") as f:
+                    meta = json.load(f)
+                dtype = meta["dtype"]
+                count = meta.get("count")
+            except (OSError, ValueError, KeyError):
+                dtype = "uint16"  # the GPT-2-vocab default layout
+        self._data = np.memmap(path, dtype=np.dtype(dtype), mode="r")
+        if count is not None and len(self._data) != count:
+            # meta/data skew (caught mid-rewrite): decoding with the
+            # wrong dtype would be silent garbage — fail loudly instead
+            raise ValueError(
+                f"{path}: meta says {count} tokens but the file decodes "
+                f"to {len(self._data)} as {dtype} — corpus mid-rewrite "
+                "or dtype mismatch"
+            )
+        # each item needs seq_len + 1 tokens (x and the shifted y)
+        usable = len(self._data) - (seq_len + 1)
+        self._n = 0 if usable < 0 else usable // self.stride + 1
+        if self._n == 0:
+            raise ValueError(
+                f"{path}: {len(self._data)} tokens < seq_len+1="
+                f"{seq_len + 1}"
+            )
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i: int) -> Dict[str, np.ndarray]:
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        start = i * self.stride
+        window = np.asarray(
+            self._data[start : start + self.seq_len + 1], dtype=np.int32
+        )
+        return {"x": window[:-1], "y": window[1:]}

@@ -126,13 +126,13 @@ def build_optimizer(
     else:
         raise ValueError(f"unknown lr schedule {schedule!r}")
 
-    if name not in ("adamw", "adam", "sgd"):
+    if name not in ("adamw", "adam", "sgd", "agd", "adamw_8bit"):
         raise ValueError(f"unknown optimizer {name!r}")
 
     def make(learning_rate, retune_scale):
         # weight_decay applies to EVERY optimizer: decoupled (after the
-        # adaptive direction) for adamw/adam, classic L2-into-update for
-        # sgd. add_decayed_weights(0.0) is a no-op.
+        # adaptive direction) for adamw/adam/agd/8bit, classic
+        # L2-into-update for sgd. add_decayed_weights(0.0) is a no-op.
         if name == "adamw":
             opt = optax.adamw(
                 learning_rate, weight_decay=weight_decay, **kwargs
@@ -142,6 +142,18 @@ def build_optimizer(
                 optax.scale_by_adam(**kwargs),
                 optax.add_decayed_weights(weight_decay),
                 optax.scale_by_learning_rate(learning_rate),
+            )
+        elif name == "agd":
+            from dlrover_tpu.ops.optimizers import agd
+
+            opt = agd(
+                learning_rate, weight_decay=weight_decay, **kwargs
+            )
+        elif name == "adamw_8bit":
+            from dlrover_tpu.ops.quantized_optim import adamw_8bit
+
+            opt = adamw_8bit(
+                learning_rate, weight_decay=weight_decay, **kwargs
             )
         else:
             opt = optax.chain(
@@ -330,37 +342,49 @@ class ElasticTrainer:
             return float("inf")
 
     def _after_eval(self, step: int) -> bool:
-        """save-best / early-stopping bookkeeping; True = stop now."""
+        """save-best / early-stopping bookkeeping; True = stop now.
+
+        Two distinct "best" trackers on purpose:
+
+        - ``_run_best_eval_loss`` (reset every train() call) drives the
+          patience counter — a restarted run that is still improving
+          run-locally must not be stopped just because it hasn't yet
+          beaten the historical best it restarted below;
+        - ``_best_eval_loss`` is the best PERSISTED loss (sidecar) and
+          only advances when a checkpoint actually commits — a save
+          skipped by the rate limit stays beatable, so the next
+          improvement past the window persists instead of being lost.
+        """
         import json
 
         loss = self._last_eval.get("eval_loss", float("inf"))
-        if loss < self._best_eval_loss:
-            self._best_eval_loss = loss
+        if loss < self._run_best_eval_loss:
+            self._run_best_eval_loss = loss
             self._evals_since_best = 0
-            if (
-                self._best_ckptr is not None
-                and time.time() - self._last_best_save
-                >= self.tcfg.save_best_min_interval_s
-            ):
-                logger.info(
-                    f"step {step}: new best eval_loss={loss:.4f}; "
-                    f"persisting to {self._best_dir}"
-                )
-                if self._best_ckptr.save_checkpoint(
-                    step, self._ckpt_state(), StorageType.DISK
-                ):
-                    # the sidecar records the PERSISTED best — written
-                    # only after the commit, so a crash mid-save cannot
-                    # leave it claiming a checkpoint that isn't there
-                    tmp = f"{self._best_sidecar_path()}.tmp.{os.getpid()}"
-                    with open(tmp, "w") as f:
-                        json.dump(
-                            {"eval_loss": loss, "step": step}, f
-                        )
-                    os.replace(tmp, self._best_sidecar_path())
-                    self._last_best_save = time.time()
         else:
             self._evals_since_best += 1
+        if (
+            self._best_ckptr is not None
+            and loss < self._best_eval_loss
+            and time.time() - self._last_best_save
+            >= self.tcfg.save_best_min_interval_s
+        ):
+            logger.info(
+                f"step {step}: new best eval_loss={loss:.4f}; "
+                f"persisting to {self._best_dir}"
+            )
+            if self._best_ckptr.save_checkpoint(
+                step, self._ckpt_state(), StorageType.DISK
+            ):
+                # the sidecar records the PERSISTED best — written only
+                # after the commit, so a crash mid-save cannot leave it
+                # claiming a checkpoint that isn't there
+                tmp = f"{self._best_sidecar_path()}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump({"eval_loss": loss, "step": step}, f)
+                os.replace(tmp, self._best_sidecar_path())
+                self._best_eval_loss = loss
+                self._last_best_save = time.time()
         return (
             self.tcfg.early_stopping_patience > 0
             and self._evals_since_best >= self.tcfg.early_stopping_patience
@@ -385,9 +409,10 @@ class ElasticTrainer:
         t0 = time.time()
         start_step = self.global_step
         self._last_eval: Dict[str, float] = {}
-        # _best_eval_loss deliberately NOT reset: the sidecar-loaded
-        # historical best must not be superseded by a restarted run's
-        # first (worse) eval
+        # run-local best for the patience counter; the PERSISTED best
+        # (_best_eval_loss, sidecar-loaded) deliberately survives so a
+        # restarted run's first (worse) eval can't supersede it on disk
+        self._run_best_eval_loss = float("inf")
         self._evals_since_best = 0
         while self.global_step < num_steps:
             self.dataloader.load_config()  # master-retuned batch size

@@ -330,3 +330,43 @@ def test_underperformance_flagged_against_fleet(brain):
         assert "underperforming" not in plan.reason, plan
     finally:
         hist.close(); sick.close(); healthy.close()
+
+
+def test_master_env_wiring_reports_job_end(brain, monkeypatch):
+    """DLROVER_TPU_BRAIN_ADDR on the master wires the whole loop with
+    zero explicit plumbing: metrics reporter, node events, optimizer
+    seam, and the terminal job-end summary that future cold-starts fit
+    from."""
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.master.local_master import LocalJobMaster
+
+    monkeypatch.setenv("DLROVER_TPU_BRAIN_ADDR", brain)
+    monkeypatch.setenv("DLROVER_TPU_JOB_NAME", "env-wired")
+    m = LocalJobMaster(port=0, node_num=1)
+    m.prepare()
+    c = MasterClient(m.addr, node_id=0)
+    try:
+        c.report_dataset_shard_params(
+            comm.DatasetShardParams(
+                batch_size=4, num_minibatches_per_shard=1,
+                dataset_size=8, num_epochs=1, dataset_name="ds",
+            )
+        )
+        while True:
+            task = c.get_task("ds")
+            if task.is_empty:
+                break
+            c.report_task_result("ds", task.task_id)
+        rc = m.run()
+        assert rc == "succeeded"
+    finally:
+        c.close()
+        m.stop()  # joins the job-end thread before closing the client
+
+    fresh = BrainClient(brain, "fresh-after-env")
+    try:
+        # env-wired's completed row exists -> cold start has history
+        plan = fresh.optimize()
+        assert "cold-start" in plan.reason, plan
+    finally:
+        fresh.close()

@@ -343,3 +343,21 @@ def test_save_best_and_early_stopping(tmp_path):
         t2.close()
     finally:
         AsyncCheckpointSaver.reset()
+
+
+def test_build_optimizer_repo_optimizers():
+    """The repo's own AGD and 8-bit AdamW ride the same schedule +
+    retune_scale surface as the optax bases."""
+    import jax.numpy as jnp
+    from dlrover_tpu.trainer.elastic.trainer import build_optimizer
+
+    for name in ("agd", "adamw_8bit", "sgd"):
+        tx = build_optimizer(
+            name, lr=1e-2, schedule="cosine", total_steps=10,
+            weight_decay=0.01,
+        )
+        params = {"w": jnp.ones(8192)}
+        st = tx.init(params)
+        u, st = tx.update({"w": jnp.ones(8192) * 1e-3}, st, params)
+        assert "retune_scale" in st.hyperparams
+        assert float(jnp.abs(u["w"]).sum()) > 0

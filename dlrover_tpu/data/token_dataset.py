@@ -7,42 +7,63 @@ of token ids opened with ``np.memmap``: zero parse cost, O(1) random
 access by window index (what the ElasticDistributedSampler shards and
 resumes over), and the OS page cache does the staging.
 
-Layout: little-endian unsigned ids, dtype inferred from a tiny JSON
-header sidecar (``<path>.meta.json``) written by ``write_tokens`` —
-uint16 for vocabularies < 65536 (GPT-2's 50257 fits), uint32 otherwise.
+Layout: ``<path>.meta.json`` names the generation-suffixed data file it
+belongs to (``data_file``) plus dtype/count — the meta replace is the
+atomic commit point, and every reader pairs a meta with exactly the
+data file it names, so a rewrite can never hand a reader mismatched
+dtype/bytes. Plain headerless files (nanoGPT-style ``.bin`` with no
+meta) open too, defaulting to uint16.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import secrets
 from typing import Dict, Optional
 
 import numpy as np
 
 
 def write_tokens(path: str, tokens: np.ndarray) -> str:
-    """Persist a 1-D token array as ``<path>`` + ``<path>.meta.json``.
+    """Persist a 1-D token array as ``<path>.g<nonce>`` +
+    ``<path>.meta.json`` (the atomic commit), GC'ing older generations.
     Returns ``path``. (The tokenizer step of a data pipeline.)"""
     tokens = np.asarray(tokens)
     if tokens.ndim != 1:
         raise ValueError(f"tokens must be 1-D, got shape {tokens.shape}")
     if tokens.size and int(tokens.min()) < 0:
         raise ValueError("token ids must be non-negative")
-    dtype = np.uint16 if (tokens.size == 0 or int(tokens.max()) < 65536) else np.uint32
-    # meta FIRST and atomically: a reader (or crash) between the two
-    # replaces must never pair new data with a stale dtype — decoding
-    # uint16 bytes as uint32 is silent garbage. Meta-then-data means the
-    # worst interleaving is old data read with new meta, which fails
-    # loudly (size mismatch) instead of silently.
-    meta = {"dtype": np.dtype(dtype).name, "count": int(tokens.size)}
+    dtype = (
+        np.uint16
+        if (tokens.size == 0 or int(tokens.max()) < 65536)
+        else np.uint32
+    )
+    gen = f"{os.path.basename(path)}.g{secrets.token_hex(4)}"
+    data_path = os.path.join(os.path.dirname(path) or ".", gen)
+    tmp = f"{data_path}.tmp.{os.getpid()}"
+    tokens.astype(dtype).tofile(tmp)
+    os.replace(tmp, data_path)
+    meta = {
+        "dtype": np.dtype(dtype).name,
+        "count": int(tokens.size),
+        "data_file": gen,
+    }
     mtmp = f"{path}.meta.json.tmp.{os.getpid()}"
     with open(mtmp, "w") as f:
         json.dump(meta, f)
-    os.replace(mtmp, f"{path}.meta.json")
-    tmp = f"{path}.tmp.{os.getpid()}"
-    tokens.astype(dtype).tofile(tmp)
-    os.replace(tmp, path)
+    os.replace(mtmp, f"{path}.meta.json")  # the commit point
+    # best-effort GC of superseded generations (a reader holding an old
+    # meta already has its data file memmapped — unlink is safe on posix)
+    prefix = f"{os.path.basename(path)}.g"
+    for name in os.listdir(os.path.dirname(path) or "."):
+        if name.startswith(prefix) and name != gen and not name.endswith(
+            f".tmp.{os.getpid()}"
+        ):
+            try:
+                os.unlink(os.path.join(os.path.dirname(path) or ".", name))
+            except OSError:
+                pass
     return path
 
 
@@ -68,30 +89,39 @@ class MemmapTokenDataset:
         self.stride = stride or seq_len
         if self.stride <= 0 or seq_len <= 0:
             raise ValueError("seq_len and stride must be positive")
-        count = None
+        data_path, count = path, None
         if dtype is None:
             try:
                 with open(f"{path}.meta.json") as f:
                     meta = json.load(f)
                 dtype = meta["dtype"]
                 count = meta.get("count")
-            except (OSError, ValueError, KeyError):
-                dtype = "uint16"  # the GPT-2-vocab default layout
-        self._data = np.memmap(path, dtype=np.dtype(dtype), mode="r")
+                if "data_file" in meta:
+                    data_path = os.path.join(
+                        os.path.dirname(path) or ".", meta["data_file"]
+                    )
+            except FileNotFoundError:
+                # headerless corpus (e.g. a nanoGPT .bin): GPT-2-vocab
+                # uint16 is the conventional layout
+                dtype = "uint16"
+            except (OSError, ValueError, KeyError) as e:
+                # a PRESENT but unreadable meta must fail loudly — a
+                # uint16 fallback would silently decode garbage
+                raise ValueError(
+                    f"{path}.meta.json exists but is unreadable: {e!r}"
+                ) from e
+        self._data = np.memmap(data_path, dtype=np.dtype(dtype), mode="r")
         if count is not None and len(self._data) != count:
-            # meta/data skew (caught mid-rewrite): decoding with the
-            # wrong dtype would be silent garbage — fail loudly instead
             raise ValueError(
-                f"{path}: meta says {count} tokens but the file decodes "
-                f"to {len(self._data)} as {dtype} — corpus mid-rewrite "
-                "or dtype mismatch"
+                f"{data_path}: meta says {count} tokens but the file "
+                f"decodes to {len(self._data)} as {dtype}"
             )
         # each item needs seq_len + 1 tokens (x and the shifted y)
         usable = len(self._data) - (seq_len + 1)
         self._n = 0 if usable < 0 else usable // self.stride + 1
         if self._n == 0:
             raise ValueError(
-                f"{path}: {len(self._data)} tokens < seq_len+1="
+                f"{data_path}: {len(self._data)} tokens < seq_len+1="
                 f"{seq_len + 1}"
             )
 

@@ -25,9 +25,51 @@ from dlrover_tpu.models.transformer import (
 )
 
 
+def _mask_logits(scaled, top_k: int, top_p: float):
+    """Restrict the sampling support (vLLM-style knobs, all static):
+    ``top_k`` keeps the k best logits; ``top_p`` keeps the smallest
+    prefix of the probability-sorted vocab whose mass reaches p
+    (nucleus). Masked entries go to -inf BEFORE the softmax, so the
+    returned logprobs stay the true behavior-policy logprobs.
+
+    One pass over the vocab: ``lax.top_k`` covers the k threshold
+    without a full sort, and when the nucleus is active its single
+    descending sort serves both knobs.
+    """
+    V = scaled.shape[-1]
+    top_k = min(top_k, V) if top_k > 0 else 0  # clamp: keep-all
+    if 0.0 < top_p < 1.0:
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        if top_k > 0:
+            # top-k first, nucleus over the RESTRICTED renormalized
+            # distribution (the HF/vLLM composition order)
+            kth = sorted_desc[:, top_k - 1][:, None]
+            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            sorted_desc = jnp.where(
+                jnp.arange(V)[None, :] < top_k, sorted_desc, -jnp.inf
+            )
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep every token whose PRECEDING mass is < p (the boundary
+        # token that crosses p stays in, per the nucleus definition)
+        keep = cum - probs < top_p
+        n_keep = jnp.sum(keep, axis=-1)  # >= 1 always
+        cutoff = jnp.take_along_axis(
+            sorted_desc, (n_keep - 1)[:, None], axis=-1
+        )
+        scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+    elif top_k > 0:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1][:, None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    return scaled
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "temperature", "greedy"),
+    static_argnames=(
+        "cfg", "max_new_tokens", "temperature", "greedy", "top_k",
+        "top_p",
+    ),
 )
 def generate(
     params: Params,
@@ -37,12 +79,17 @@ def generate(
     max_new_tokens: int = 32,
     temperature: float = 1.0,
     greedy: bool = False,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """prompt [B, P] int32 → (tokens [B, P+N], logprobs [B, N]).
 
     ``logprobs`` are the actor's log-probs of each sampled token — the
     rollout statistics PPO needs, captured during generation instead of
-    with a second forward pass.
+    with a second forward pass. ``top_k``/``top_p`` restrict the
+    sampling support (0 / 1.0 disable them); logprobs are computed
+    under the SAME restricted distribution, so PPO ratios stay
+    unbiased.
     """
     B, P = prompt.shape
     N = max_new_tokens
@@ -57,11 +104,12 @@ def generate(
             tok = jnp.argmax(logits, axis=-1)
             scaled = logits
         else:
-            scaled = logits / temperature
+            scaled = _mask_logits(logits / temperature, top_k, top_p)
             tok = jax.random.categorical(key, scaled, axis=-1)
         # logprobs under the ACTUAL sampling distribution (temperature-
-        # scaled): these are PPO's behavior-policy logprobs, and a
-        # mismatch here biases the importance ratio and KL estimate
+        # scaled and support-restricted): these are PPO's behavior-
+        # policy logprobs, and a mismatch here biases the importance
+        # ratio and KL estimate
         logp = jax.nn.log_softmax(scaled, axis=-1)
         tok_logp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
         return tok.astype(jnp.int32), tok_logp

@@ -202,3 +202,42 @@ class TestMemmapTokenDataset:
         t.train(num_steps=5)
         assert losses[-1] < losses[0]
         t.close()
+
+    def test_corrupt_meta_fails_loudly(self, tmp_path):
+        """A PRESENT but unreadable meta must raise, never fall back to
+        uint16 (silent garbage); only a MISSING meta means headerless."""
+        from dlrover_tpu.data.token_dataset import (
+            MemmapTokenDataset,
+            write_tokens,
+        )
+
+        path = str(tmp_path / "c.bin")
+        write_tokens(path, np.arange(64) % 256)
+        with open(f"{path}.meta.json", "w") as f:
+            f.write("{not json")
+        with pytest.raises(ValueError, match="unreadable"):
+            MemmapTokenDataset(path, seq_len=8)
+        # headerless (no meta at all): opens as uint16
+        raw = str(tmp_path / "plain.bin")
+        np.arange(64, dtype=np.uint16).tofile(raw)
+        ds = MemmapTokenDataset(raw, seq_len=8)
+        assert len(ds) > 0
+
+    def test_rewrite_is_atomic_for_readers(self, tmp_path):
+        """A reader opening during a dtype-changing rewrite always pairs
+        a meta with exactly the data file it names (generation-suffixed
+        files; the meta replace is the commit point)."""
+        from dlrover_tpu.data.token_dataset import (
+            MemmapTokenDataset,
+            write_tokens,
+        )
+
+        path = str(tmp_path / "c.bin")
+        write_tokens(path, np.full(40, 70000))  # uint32 corpus
+        ds_old = MemmapTokenDataset(path, seq_len=8)
+        assert int(ds_old[0]["x"][0]) == 70000
+        write_tokens(path, np.arange(40) % 100)  # rewritten as uint16
+        ds_new = MemmapTokenDataset(path, seq_len=8)
+        assert int(ds_new[0]["x"][1]) == 1  # decoded correctly
+        # the old handle keeps reading ITS generation coherently
+        assert int(ds_old[0]["x"][0]) == 70000

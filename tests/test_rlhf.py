@@ -254,3 +254,57 @@ class TestHybridPlacement:
         # actor weights stayed in the TRAIN layout across the cycle
         wq2 = engine.actor_params["layers"][0]["attn"]["wq"]
         assert not wq2.sharding.is_fully_replicated
+
+
+class TestSamplingControls:
+    def test_top_k_restricts_support(self, cfg, params):
+        """With top_k=1 sampling degenerates to greedy regardless of
+        key, and the returned logprob is ~0 (probability 1 on the
+        restricted support)."""
+        prompts = np.zeros((4, 4), dtype=np.int32)
+        toks_a, lp_a = generate(
+            params, jnp.asarray(prompts), jax.random.PRNGKey(0), cfg,
+            max_new_tokens=6, top_k=1,
+        )
+        toks_b, _ = generate(
+            params, jnp.asarray(prompts), jax.random.PRNGKey(123), cfg,
+            max_new_tokens=6, top_k=1,
+        )
+        np.testing.assert_array_equal(np.asarray(toks_a), np.asarray(toks_b))
+        greedy, _ = generate(
+            params, jnp.asarray(prompts), jax.random.PRNGKey(0), cfg,
+            max_new_tokens=6, greedy=True,
+        )
+        np.testing.assert_array_equal(np.asarray(toks_a), np.asarray(greedy))
+        np.testing.assert_allclose(np.asarray(lp_a), 0.0, atol=1e-5)
+
+    def test_top_p_masks_tail(self):
+        """Nucleus masking keeps the smallest prefix reaching p and
+        always at least the argmax."""
+        from dlrover_tpu.rl.generation import _mask_logits
+
+        logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+        out = np.asarray(_mask_logits(logits, 0, 0.6))
+        # 0.5 < 0.6 -> token 1 (cumulative-before 0.5) also kept;
+        # cumulative-before for token 2 is 0.8 >= 0.6 -> masked
+        assert np.isfinite(out[0, 0]) and np.isfinite(out[0, 1])
+        assert out[0, 2] == -np.inf and out[0, 3] == -np.inf
+        # extreme p keeps only the argmax
+        out = np.asarray(_mask_logits(logits, 0, 1e-9))
+        assert np.isfinite(out[0, 0]) and (out[0, 1:] == -np.inf).all()
+
+    def test_top_k_clamps_and_composes_with_top_p(self):
+        from dlrover_tpu.rl.generation import _mask_logits
+
+        logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+        # top_k beyond vocab: keep-all (no crash)
+        out = np.asarray(_mask_logits(logits, 100, 1.0))
+        assert np.isfinite(out).all()
+        # top_k=2 then nucleus over the RENORMALIZED {0.625, 0.375}:
+        # p=0.7 keeps token 0 (0 < 0.7) and token 1 (0.625 < 0.7)
+        out = np.asarray(_mask_logits(logits, 2, 0.7))
+        assert np.isfinite(out[0, :2]).all()
+        assert (out[0, 2:] == -np.inf).all()
+        # p=0.5 keeps only token 0 of the restricted support
+        out = np.asarray(_mask_logits(logits, 2, 0.5))
+        assert np.isfinite(out[0, 0]) and (out[0, 1:] == -np.inf).all()

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import secrets
 from typing import Dict, Optional
 
@@ -53,18 +54,38 @@ def write_tokens(path: str, tokens: np.ndarray) -> str:
     with open(mtmp, "w") as f:
         json.dump(meta, f)
     os.replace(mtmp, f"{path}.meta.json")  # the commit point
-    # best-effort GC of superseded generations (a reader holding an old
-    # meta already has its data file memmapped — unlink is safe on posix)
-    prefix = f"{os.path.basename(path)}.g"
-    for name in os.listdir(os.path.dirname(path) or "."):
-        if name.startswith(prefix) and name != gen and not name.endswith(
-            f".tmp.{os.getpid()}"
+    _gc_generations(path)
+    return path
+
+
+_GEN_RE = re.compile(r"\.g[0-9a-f]{8}$")
+
+
+def _gc_generations(path: str) -> None:
+    """Best-effort GC of superseded generations. Keeps whatever the
+    CURRENT meta names (re-read after our commit — if a concurrent
+    writer won the race, its generation is the one spared, never
+    deleted), matches ONLY the exact ``.g<8 hex>`` suffix (a sibling
+    ``corpus.bin.gz`` is not a generation), and never touches tmp
+    files. Concurrent writers are tolerated; one writer per corpus is
+    still the intended discipline."""
+    base = os.path.basename(path)
+    dirname = os.path.dirname(path) or "."
+    try:
+        with open(f"{path}.meta.json") as f:
+            keep = {json.load(f)["data_file"]}
+    except (OSError, ValueError, KeyError):
+        return  # cannot tell what is live: delete nothing
+    for name in os.listdir(dirname):
+        if (
+            name.startswith(f"{base}.g")
+            and _GEN_RE.search(name)
+            and name not in keep
         ):
             try:
-                os.unlink(os.path.join(os.path.dirname(path) or ".", name))
+                os.unlink(os.path.join(dirname, name))
             except OSError:
                 pass
-    return path
 
 
 class MemmapTokenDataset:
@@ -89,13 +110,21 @@ class MemmapTokenDataset:
         self.stride = stride or seq_len
         if self.stride <= 0 or seq_len <= 0:
             raise ValueError("seq_len and stride must be positive")
-        data_path, count = path, None
-        if dtype is None:
+        dtype_override = dtype
+        # one retry: a concurrent rewrite can GC the generation between
+        # our meta read and the memmap open — re-reading the meta then
+        # names the NEW generation
+        for attempt in (0, 1):
+            data_path, count, dtype = path, None, dtype_override
             try:
                 with open(f"{path}.meta.json") as f:
                     meta = json.load(f)
-                dtype = meta["dtype"]
-                count = meta.get("count")
+                # explicit dtype= overrides the meta's (and disables the
+                # count check, whose unit is meta-dtype tokens), but the
+                # generation the meta names is still the data location
+                if dtype is None:
+                    dtype = meta["dtype"]
+                    count = meta.get("count")
                 if "data_file" in meta:
                     data_path = os.path.join(
                         os.path.dirname(path) or ".", meta["data_file"]
@@ -103,14 +132,21 @@ class MemmapTokenDataset:
             except FileNotFoundError:
                 # headerless corpus (e.g. a nanoGPT .bin): GPT-2-vocab
                 # uint16 is the conventional layout
-                dtype = "uint16"
+                dtype = dtype or "uint16"
             except (OSError, ValueError, KeyError) as e:
                 # a PRESENT but unreadable meta must fail loudly — a
                 # uint16 fallback would silently decode garbage
                 raise ValueError(
                     f"{path}.meta.json exists but is unreadable: {e!r}"
                 ) from e
-        self._data = np.memmap(data_path, dtype=np.dtype(dtype), mode="r")
+            try:
+                self._data = np.memmap(
+                    data_path, dtype=np.dtype(dtype), mode="r"
+                )
+                break
+            except FileNotFoundError:
+                if attempt:
+                    raise
         if count is not None and len(self._data) != count:
             raise ValueError(
                 f"{data_path}: meta says {count} tokens but the file "

@@ -203,13 +203,15 @@ class ElasticJobOperator(PollingDaemon):
                 group_size=meta.get("groupSize", 1),
             )
             # same pod factory as the direct PodScaler path: identity
-            # labels + master-address/rank env are stamped identically
+            # labels + master-address/rank env are stamped identically,
+            # including the plan's Brain bad-node anti-affinity
             body = build_worker_pod(
                 job,
                 node,
                 template=template,
                 master_addr=master_service_addr(job, self._ns),
                 namespace=self._ns,
+                exclude_hosts=tuple(spec.get("excludeHosts", ())),
             )
             body["metadata"]["name"] = meta["name"]
             logger.info(f"operator creating pod {meta['name']}")

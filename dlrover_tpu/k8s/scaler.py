@@ -168,10 +168,17 @@ class ElasticJobScaler(Scaler):
         self._job = job_name
         self._ns = namespace
         self._serial = 0
+        self._exclude_hosts: tuple = ()
         # names must be unique across master restarts (an in-memory
         # serial alone would 409 against surviving CRs); ms timestamp +
         # serial disambiguates both restarts and same-ms bursts
         self._epoch_ms = int(time.time() * 1000)
+
+    def set_exclude_hosts(self, hosts) -> None:
+        """Brain bad-node exclusion rides the ScalePlan CR so the
+        OPERATOR renders the anti-affinity (the master has no pod
+        permissions on this path)."""
+        self._exclude_hosts = tuple(sorted(set(hosts)))
 
     @staticmethod
     def _pod_meta(job: str, node: Node) -> dict:
@@ -207,6 +214,7 @@ class ElasticJobScaler(Scaler):
                 "removePods": [
                     self._pod_meta(self._job, n) for n in plan.remove_nodes
                 ],
+                "excludeHosts": list(self._exclude_hosts),
             },
         }
         logger.info(

@@ -38,13 +38,13 @@ def _mask_logits(scaled, top_k: int, top_p: float):
     """
     V = scaled.shape[-1]
     top_k = min(top_k, V) if top_k > 0 else 0  # clamp: keep-all
-    if 0.0 < top_p < 1.0:
+    if top_p < 1.0:
         sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
         if top_k > 0:
             # top-k first, nucleus over the RESTRICTED renormalized
-            # distribution (the HF/vLLM composition order)
-            kth = sorted_desc[:, top_k - 1][:, None]
-            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            # distribution (the HF/vLLM composition order). No separate
+            # kth mask on `scaled`: the nucleus cutoff below is always
+            # >= the kth value, so its mask subsumes it.
             sorted_desc = jnp.where(
                 jnp.arange(V)[None, :] < top_k, sorted_desc, -jnp.inf
             )
@@ -91,6 +91,12 @@ def generate(
     under the SAME restricted distribution, so PPO ratios stay
     unbiased.
     """
+    if not 0.0 < top_p <= 1.0:
+        # top_p=0 silently meaning "keep all" has bitten people; the
+        # near-greedy limit is top_p -> 0+, not 0
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
     B, P = prompt.shape
     N = max_new_tokens
     cache = init_kv_cache(cfg, B, P + N)

@@ -1,6 +1,7 @@
 """Elastic sampler / dataloader / sharding-client tests."""
 
 import json
+import os
 
 import numpy as np
 import pytest
@@ -241,3 +242,20 @@ class TestMemmapTokenDataset:
         assert int(ds_new[0]["x"][1]) == 1  # decoded correctly
         # the old handle keeps reading ITS generation coherently
         assert int(ds_old[0]["x"][0]) == 70000
+
+    def test_dtype_override_and_gc_precision(self, tmp_path):
+        from dlrover_tpu.data.token_dataset import (
+            MemmapTokenDataset,
+            write_tokens,
+        )
+
+        path = str(tmp_path / "c.bin")
+        # unrelated sibling that must SURVIVE generation GC
+        bystander = str(tmp_path / "c.bin.gz")
+        open(bystander, "wb").write(b"backup")
+        write_tokens(path, np.arange(64) % 256)
+        write_tokens(path, np.arange(64) % 256)  # triggers GC
+        assert os.path.exists(bystander)
+        # explicit dtype= still resolves the generation-suffixed file
+        ds = MemmapTokenDataset(path, seq_len=8, dtype="uint16")
+        assert int(ds[0]["x"][1]) == 1

@@ -501,3 +501,32 @@ class TestDriftRepair:
         api.delete_pod("default", "mj-master")  # kubectl delete
         op._tick()  # periodic reconcile repairs the drift
         assert "mj-master" in api.pods
+
+
+def test_exclusion_rides_scaleplan_cr_through_operator():
+    """The production (operator) path: exclusions set on the
+    ElasticJobScaler land in the ScalePlan CR and the operator renders
+    them as anti-affinity on every pod it creates."""
+    api = FakeK8sApi()
+    api.create_custom_object(
+        "default",
+        "elasticjobs",
+        {
+            "metadata": {"name": "exj"},
+            "spec": {"replicaSpecs": {"worker": {"replicas": 1}}},
+        },
+    )
+    scaler = ElasticJobScaler(api, "exj")
+    scaler.set_exclude_hosts(("bad-host",))
+    scaler.scale(ScalePlan(launch_nodes=[_node(0)]))
+    op = ElasticJobOperator(api)
+    op._tick()
+    pod = api.pods["exj-worker-0"]
+    expr = pod["spec"]["affinity"]["nodeAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ]["nodeSelectorTerms"][0]["matchExpressions"][0]
+    assert expr == {
+        "key": "kubernetes.io/hostname",
+        "operator": "NotIn",
+        "values": ["bad-host"],
+    }

@@ -37,6 +37,11 @@ class TransformerConfig:
     # (keeps gate logits small; 0 disables)
     moe_top_k: int = 1
     router_z_weight: float = 1e-3
+    # sequence-parallel attention scheme when the mesh has sp > 1:
+    # "ring" (P2P pipeline, any head count) or "ulysses" (two
+    # all-to-alls; needs (heads/tp) % sp == 0) — parallel/{ring_
+    # attention,ulysses}.py
+    sp_scheme: str = "ring"
     # numerics
     dtype: str = "bfloat16"  # activation/compute dtype
     param_dtype: str = "float32"

@@ -214,7 +214,14 @@ def _attention_block(x, layer, cfg: TransformerConfig, mesh, positions):
         # 1/sqrt(d) into q, so flash and ring paths need no new plumbing
         q = q * (cfg.mup_attn_scale * cfg.head_dim**0.5)
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        o = ring_self_attention(q, k, v, mesh, causal=True)
+        if cfg.sp_scheme == "ulysses":
+            from dlrover_tpu.parallel.ulysses import (
+                ulysses_self_attention,
+            )
+
+            o = ulysses_self_attention(q, k, v, mesh, causal=True)
+        else:
+            o = ring_self_attention(q, k, v, mesh, causal=True)
     else:
         o = _causal_attention(q, k, v)
     return x + jnp.einsum(

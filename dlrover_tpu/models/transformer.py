@@ -220,8 +220,15 @@ def _attention_block(x, layer, cfg: TransformerConfig, mesh, positions):
             )
 
             o = ulysses_self_attention(q, k, v, mesh, causal=True)
-        else:
+        elif cfg.sp_scheme == "ring":
             o = ring_self_attention(q, k, v, mesh, causal=True)
+        else:
+            # a typo silently running the OTHER scheme would make every
+            # perf comparison quietly wrong
+            raise ValueError(
+                f"unknown sp_scheme {cfg.sp_scheme!r} "
+                "(expected 'ring' or 'ulysses')"
+            )
     else:
         o = _causal_attention(q, k, v)
     return x + jnp.einsum(

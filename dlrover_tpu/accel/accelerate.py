@@ -166,7 +166,8 @@ def auto_accelerate(
         logger.info(
             f"auto_accelerate: picked {strategy.describe()} from "
             f"{len(cands)} candidates in {time.time() - t0:.1f}s "
-            f"(measured {best.step_s}, est {best.est_step_s:.4f}s/step)"
+            f"(measured {best.step_s}, est {best.est_step_s:.3e}s/step "
+            f"[{best.est_source}])"
         )
 
     # production build: donate the old state's buffers each step (the dry

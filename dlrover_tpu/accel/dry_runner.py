@@ -47,6 +47,11 @@ class DryRunReport:
     # must not depend on whether combination or TPE ran the search.
     fits: Optional[bool] = True
     est_step_s: float = 0.0  # roofline estimate from the compile
+    # where est_step_s came from: "xla" (compiler cost analysis) or
+    # "analytic" (profiler formulas — CPU/virtual backends return an
+    # empty cost_analysis(), which must NOT collapse every candidate's
+    # estimate to 0 and turn the ranking into insertion order)
+    est_source: str = "xla"
     step_s: Optional[float] = None  # measured (finalists only)
 
 
@@ -92,18 +97,14 @@ def _build(
             pipeline_state_shardings,
         )
 
-        virtual = (
-            strategy.pp_virtual
-            if strategy.pp_schedule == "interleaved"
-            else 1
-        )
+        virtual = strategy.resolved_virtual()
         step_fn = build_pipeline_train_step(
             cfg,
             mesh,
             tx,
             strategy.num_microbatches,
             donate=donate,
-            schedule=strategy.pp_schedule,
+            schedule=strategy.resolved_pp_schedule(),
             # the resolved value: one source of truth with the state
             # layout below ([pp, v, lc] iff virtual > 1)
             virtual_stages=virtual,
@@ -165,6 +166,48 @@ def _build(
     return cfg, mesh, step_fn, init_fn, make_batch, abstract_state
 
 
+def _analytic_estimate(
+    report: DryRunReport, cfg: TransformerConfig, batch, seq, devices
+) -> None:
+    """Fill flops/bytes per device from the profiler's analytic model
+    (accel/profiler.py formulas) when XLA's cost analysis is empty.
+
+    Work is assumed to split uniformly over the mesh — exactly the
+    roofline fiction the XLA numbers encode too (per-device flops), so
+    candidates at different factorization sizes stay comparable. The
+    parallelism-dependent *communication* cost is invisible to both
+    sources; the timed finalists settle that."""
+    import jax
+
+    from dlrover_tpu.accel.profiler import profile_model
+
+    n_dev = len(devices) if devices is not None else jax.device_count()
+    act_bytes = 2 if cfg.dtype in ("bfloat16", "float16") else 4
+    p_bytes = 2 if cfg.param_dtype in ("bfloat16", "float16") else 4
+    prof = profile_model(cfg, batch, seq, act_bytes=act_bytes)
+    param_bytes = prof.total_params * p_bytes
+    flops = prof.step_flops / n_dev
+    s = report.strategy
+    if cfg.remat:
+        # full activation checkpointing recomputes the forward in the
+        # backward: fwd+fwd+bwd = 4/3 of the fwd+bwd ideal
+        flops *= 4.0 / 3.0
+    if s.mesh.pp > 1:
+        # pipeline bubble: (pp-1) fill/drain ticks over M microbatch
+        # ticks of useful work; interleaving shrinks it v-fold (same
+        # algebra as parallel/pipeline.py schedule_occupancy)
+        M = max(s.num_microbatches, 1)
+        v = s.resolved_virtual()
+        flops *= 1.0 + (s.mesh.pp - 1) / float(M * v)
+    report.flops_per_device = flops
+    # HBM traffic model: params are read twice + written once per update
+    # (grad + optimizer pass) and activations flow once each way
+    report.bytes_per_device = (
+        3.0 * param_bytes + 2.0 * prof.activation_bytes
+    ) / n_dev
+    report.est_source = "analytic"
+
+
 def compiled_cost(
     strategy: Strategy,
     cfg: TransformerConfig,
@@ -195,6 +238,12 @@ def compiled_cost(
                 + getattr(ma, "temp_size_in_bytes", 0)
             )
         report.fits = hbm_fits(report.mem_bytes, hbm_budget)
+        if report.flops_per_device <= 0.0:
+            # tri-state, like `fits`: an empty cost_analysis() (CPU /
+            # virtual backends) means "unknown", not "free" — fall back
+            # to the analytic per-module model so candidates still get
+            # DISTINCT estimates and the sort stays meaningful
+            _analytic_estimate(report, cfg2, batch, seq, devices)
         report.est_step_s = max(
             report.flops_per_device * _SEC_PER_FLOP,
             report.bytes_per_device * _SEC_PER_BYTE,

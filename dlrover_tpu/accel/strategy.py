@@ -36,6 +36,30 @@ class Strategy:
     # (accel/opt_lib.py re-derives the config from these on every host)
     opts: Tuple[str, ...] = ()
 
+    def resolved_pp_schedule(self) -> str:
+        """The effective pipeline schedule. The opt registry rewrites
+        ``pp_schedule`` only when opts are APPLIED; a strategy that
+        hasn't been through ``apply_optimizations`` (candidates, the
+        strategy returned by ``auto_accelerate``) carries the schedule
+        only in ``opts`` — every consumer must honor either source
+        through THIS one helper (describe, the analytic cost estimate,
+        the trainer's eval step), or the two sources drift."""
+        if "interleaved" in self.opts:
+            return "interleaved"
+        if "1f1b" in self.opts:
+            return "1f1b"
+        return self.pp_schedule
+
+    def resolved_virtual(self) -> int:
+        """Chunks per device of the TRAINING state layout: ``pp_virtual``
+        iff the resolved schedule is interleaved ([pp, v, lc] leaves),
+        else 1 ([pp, L/pp])."""
+        return (
+            self.pp_virtual
+            if self.resolved_pp_schedule() == "interleaved"
+            else 1
+        )
+
     def describe(self) -> str:
         axes = {
             a: s for a, s in self.mesh.axis_sizes().items() if s > 1
@@ -45,16 +69,7 @@ class Strategy:
             bits.append(f"mb{self.num_microbatches}")
         if self.grad_accum > 1:
             bits.append(f"ga{self.grad_accum}")
-        # the opt registry rewrites pp_schedule when opts are APPLIED;
-        # a candidate logged before apply_optimizations still carries
-        # the schedule only in opts — honor either source
-        sched = (
-            "interleaved"
-            if "interleaved" in self.opts
-            else "1f1b"
-            if "1f1b" in self.opts
-            else self.pp_schedule
-        )
+        sched = self.resolved_pp_schedule()
         if self.mesh.pp > 1 and sched != "gpipe":
             bits.append(
                 f"interleaved{self.pp_virtual}"

@@ -64,11 +64,44 @@ def _mask_logits(scaled, top_k: int, top_p: float):
     return scaled
 
 
+def _rollout_pins(params, prompt, cache, cfg, mesh):
+    """Pin the decode layouts on ``mesh``: weights per the LM rule
+    table (heads/vocab → tp; fsdp is absent from rollout meshes so
+    "embed" maps to replicated), KV cache batch → dp and kv-heads → tp,
+    token batch → dp. This is what lets an actor larger than one chip
+    roll out: the per-step attention/head matmuls run tp-sharded with
+    XLA inserting the same collectives training uses (parity: the
+    reference's multi-device inference engine, model_engine.py +
+    ds_hybrid_engine/hybrid_engine.py:378)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dlrover_tpu.models.transformer import logical_axes
+    from dlrover_tpu.parallel.sharding_rules import (
+        apply_rules,
+        default_lm_rules,
+    )
+
+    shardings = apply_rules(logical_axes(cfg), default_lm_rules(), mesh)
+    params = jax.tree_util.tree_map(
+        lax.with_sharding_constraint, params, shardings
+    )
+    dp = "dp" if "dp" in mesh.shape else None
+    tp = "tp" if "tp" in mesh.shape else None
+    prompt = lax.with_sharding_constraint(
+        prompt, NamedSharding(mesh, P(dp))
+    )
+    cache_spec = NamedSharding(mesh, P(None, dp, None, tp, None))
+    cache = jax.tree_util.tree_map(
+        lambda c: lax.with_sharding_constraint(c, cache_spec), cache
+    )
+    return params, prompt, cache
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
         "cfg", "max_new_tokens", "temperature", "greedy", "top_k",
-        "top_p",
+        "top_p", "mesh",
     ),
 )
 def generate(
@@ -81,15 +114,18 @@ def generate(
     greedy: bool = False,
     top_k: int = 0,
     top_p: float = 1.0,
+    mesh=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """prompt [B, P] int32 → (tokens [B, P+N], logprobs [B, N]).
 
-    ``logprobs`` are the actor's log-probs of each sampled token — the
-    rollout statistics PPO needs, captured during generation instead of
-    with a second forward pass. ``top_k``/``top_p`` restrict the
-    sampling support (0 / 1.0 disable them); logprobs are computed
-    under the SAME restricted distribution, so PPO ratios stay
-    unbiased.
+    ``logprobs`` are the BEHAVIOR-policy log-probs of each sampled
+    token — computed under the actual sampling distribution
+    (temperature-scaled, ``top_k``/``top_p``-restricted; 0 / 1.0
+    disable the restrictions). They are sampler diagnostics: a PPO
+    consumer must record its old-policy logprobs with the SAME scoring
+    function its update uses (``sequence_logprobs``), which the RLHF
+    engine does — mixing the two scales would off-center the clip
+    window and the KL estimate.
     """
     if not 0.0 < top_p <= 1.0:
         # top_p=0 silently meaning "keep all" has bitten people; the
@@ -100,6 +136,13 @@ def generate(
     B, P = prompt.shape
     N = max_new_tokens
     cache = init_kv_cache(cfg, B, P + N)
+    if mesh is not None:
+        # sharded rollout: weights tp-sharded, cache/batch dp-sharded.
+        # One pin at entry — XLA propagates the layouts through the
+        # whole prefill + decode scan
+        params, prompt, cache = _rollout_pins(
+            params, prompt, cache, cfg, mesh
+        )
 
     # prefill: one chunked call for the whole prompt
     logits, cache = forward_step(params, prompt, cfg, cache, 0)

@@ -38,6 +38,12 @@ class PPOConfig:
     rollout_batch: int = 8
     max_new_tokens: int = 16
     temperature: float = 1.0
+    # restricted-support sampling for rollouts; PPO's importance ratio
+    # stays centered on 1 because make_experience re-scores old
+    # logprobs with the SAME full-support sequence_logprobs the update
+    # uses (the sampler's masked logprobs are diagnostics only)
+    top_k: int = 0  # 0 = keep all
+    top_p: float = 1.0  # 1.0 = keep all
     kl_coef: float = 0.1
     gamma: float = 1.0
     lam: float = 0.95
@@ -120,6 +126,7 @@ class RLHFEngine:
         )
         self._train_shardings = None
         self._rollout_shardings = None
+        self._rollout_mesh = None
         if (train_mesh is None) != (rollout_mesh is None):
             # silently ignoring half a placement request would leave
             # weights in a layout the user didn't ask for (OOM or wrong
@@ -140,13 +147,17 @@ class RLHFEngine:
             self._train_shardings = apply_rules(
                 logical_axes(cfg), default_lm_rules(), train_mesh
             )
-            # rollout layout: weights REPLICATED on the rollout mesh —
-            # decode is latency-bound and batch-parallel, per-step
-            # weight all-gathers would dominate it
-            self._rollout_shardings = jax.tree_util.tree_map(
-                lambda _: NamedSharding(rollout_mesh, P()),
-                self._train_shardings,
+            # rollout layout: the SAME rule table on the rollout mesh —
+            # a dp×tp rollout mesh gives tp-sharded heads/vocab (an
+            # actor larger than one chip can roll out) and, with no
+            # fsdp axis, everything else replicated (no per-step weight
+            # all-gathers in the decode loop). A dp-only rollout mesh
+            # degenerates to full replication, the latency-optimal
+            # layout for small actors.
+            self._rollout_shardings = apply_rules(
+                logical_axes(cfg), default_lm_rules(), rollout_mesh
             )
+            self._rollout_mesh = rollout_mesh
             self.actor_params = jax.device_put(
                 self.actor_params, self._train_shardings
             )
@@ -191,13 +202,25 @@ class RLHFEngine:
             rollout_params = jax.device_put(
                 self.actor_params, self._rollout_shardings
             )
-        tokens, logprobs = generate(
+        tokens, _ = generate(
             rollout_params,
             jnp.asarray(prompts),
             k,
             self.cfg,
             max_new_tokens=self.ppo.max_new_tokens,
             temperature=self.ppo.temperature,
+            top_k=self.ppo.top_k,
+            top_p=self.ppo.top_p,
+            mesh=self._rollout_mesh,
+        )
+        # old-policy logprobs MUST come from the same scoring function
+        # the update uses (full-support, temperature-1 sequence_logprobs)
+        # — generate()'s returned logprobs are the temperature-scaled,
+        # support-restricted SAMPLER statistics, and using them here
+        # would center the PPO clip window off 1 and mix scales in the
+        # KL term whenever temperature/top_k/top_p reshape the sampler
+        logprobs = self._seq_logprobs(
+            rollout_params, tokens, prompt_len=P
         )
         ref_logprobs = self._seq_logprobs(
             self.ref_params, tokens, prompt_len=P

@@ -34,19 +34,46 @@ def init_reward_params(key, cfg: TransformerConfig):
     return {"trunk": trunk, "reward_head": head}
 
 
-def reward_scores(rparams, tokens: jnp.ndarray, cfg: TransformerConfig):
-    """tokens [B, T] → scalar reward per sequence [B] (head applied to
-    the final position's hidden state)."""
+def reward_scores(
+    rparams,
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    pad_token_id: int | None = None,
+):
+    """tokens [B, T] → scalar reward per sequence [B].
+
+    The head reads the hidden state at each sequence's LAST REAL token
+    (the InstructGPT recipe): with ``pad_token_id`` set, that is the
+    position before the first trailing pad (right-padding assumed —
+    lengths are counted as non-pad tokens, so a pad id appearing inside
+    the sequence is the caller's bug). Without it, inputs must be
+    unpadded fixed-length sequences and the final position is scored."""
     hidden, _ = forward(rparams["trunk"], tokens, cfg, return_hidden=True)
+    if pad_token_id is None:
+        last = hidden[:, -1]
+    else:
+        idx = jnp.maximum(
+            jnp.sum((tokens != pad_token_id).astype(jnp.int32), axis=-1) - 1,
+            0,
+        )
+        last = jnp.take_along_axis(
+            hidden, idx[:, None, None], axis=1
+        )[:, 0]
     return jnp.einsum(
-        "bd,d->b", hidden[:, -1].astype(jnp.float32), rparams["reward_head"]
+        "bd,d->b", last.astype(jnp.float32), rparams["reward_head"]
     )
 
 
-def preference_loss(rparams, chosen, rejected, cfg: TransformerConfig):
+def preference_loss(
+    rparams,
+    chosen,
+    rejected,
+    cfg: TransformerConfig,
+    pad_token_id: int | None = None,
+):
     """Bradley–Terry: -log σ(r_chosen − r_rejected), plus accuracy."""
-    r_c = reward_scores(rparams, chosen, cfg)
-    r_r = reward_scores(rparams, rejected, cfg)
+    r_c = reward_scores(rparams, chosen, cfg, pad_token_id)
+    r_r = reward_scores(rparams, rejected, cfg, pad_token_id)
     loss = -jnp.mean(jax.nn.log_sigmoid(r_c - r_r))
     acc = jnp.mean((r_c > r_r).astype(jnp.float32))
     return loss, acc
@@ -56,15 +83,28 @@ class RewardModel:
     """Preference-trained reward model + the ``reward_fn`` adapter the
     PPO engine consumes."""
 
-    def __init__(self, cfg: TransformerConfig, lr: float = 1e-4, seed: int = 0):
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        lr: float = 1e-4,
+        seed: int = 0,
+        pad_token_id: int | None = None,
+    ):
         self.cfg = cfg
         self.params = init_reward_params(jax.random.PRNGKey(seed), cfg)
         self.tx = optax.adamw(lr)
         self.opt_state = self.tx.init(self.params)
         self._step = jax.jit(
-            functools.partial(_reward_update, cfg=cfg, tx=self.tx)
+            functools.partial(
+                _reward_update, cfg=cfg, tx=self.tx,
+                pad_token_id=pad_token_id,
+            )
         )
-        self._scores = jax.jit(functools.partial(reward_scores, cfg=cfg))
+        self._scores = jax.jit(
+            functools.partial(
+                reward_scores, cfg=cfg, pad_token_id=pad_token_id
+            )
+        )
 
     def train_on_preferences(
         self, chosen: np.ndarray, rejected: np.ndarray, epochs: int = 1
@@ -90,10 +130,12 @@ class RewardModel:
         return lambda tokens, prompt_len: self.score(tokens)
 
 
-def _reward_update(params, opt_state, chosen, rejected, *, cfg, tx):
+def _reward_update(
+    params, opt_state, chosen, rejected, *, cfg, tx, pad_token_id=None
+):
     (loss, acc), grads = jax.value_and_grad(
         preference_loss, has_aux=True
-    )(params, chosen, rejected, cfg)
+    )(params, chosen, rejected, cfg, pad_token_id)
     updates, opt_state = tx.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)
     return params, opt_state, {"loss": loss, "accuracy": acc}

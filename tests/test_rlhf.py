@@ -179,6 +179,74 @@ class TestRewardModel:
         c_te, r_te = make_pairs(32)
         assert (rm.score(c_te) > rm.score(r_te)).mean() > 0.9
 
+    def test_pad_aware_scoring_reads_last_real_token(self, cfg):
+        """ADVICE r3: with pad_token_id set, the reward head must score
+        the last NON-pad position — a right-padded sequence and its
+        unpadded prefix (scored at its true final token) agree exactly,
+        and the score ignores how much padding follows."""
+        from dlrover_tpu.rl.reward import RewardModel, reward_scores
+
+        PAD = 0
+        rm = RewardModel(cfg, seed=0, pad_token_id=PAD)
+        body = np.array([[5, 7, 3, 9, 4, 6]], dtype=np.int32)
+        padded_8 = np.pad(body, ((0, 0), (0, 2)), constant_values=PAD)
+        padded_12 = np.pad(body, ((0, 0), (0, 6)), constant_values=PAD)
+        s8, s12 = rm.score(padded_8), rm.score(padded_12)
+        # causal model: positions 0..5 see identical context regardless
+        # of trailing pads, so pad-aware scores match to fp tolerance
+        np.testing.assert_allclose(s8, s12, rtol=1e-5)
+        # and differ from the (wrong) final-position read
+        naive = reward_scores(
+            rm.params, jnp.asarray(padded_12), cfg, pad_token_id=None
+        )
+        assert abs(float(naive[0]) - float(s12[0])) > 1e-6
+
+    def test_ppo_config_forwards_sampling_knobs(self, cfg):
+        """ADVICE r3: PPOConfig.top_k/top_p must reach generate() in the
+        rollout — with top_k=1 every rollout is greedy-deterministic."""
+        engine = RLHFEngine(
+            cfg,
+            lambda tokens, p: np.zeros(len(tokens), dtype=np.float32),
+            ppo=PPOConfig(
+                rollout_batch=4, max_new_tokens=6, minibatch_size=4,
+                ppo_epochs=1, top_k=1,
+            ),
+            seed=0,
+        )
+        prompts = np.tile(
+            np.array([[2, 9, 4, 1]], dtype=np.int32), (4, 1)
+        )
+        exp = engine.make_experience(prompts)
+        # identical prompts + top_k=1 => identical argmax completions
+        assert (exp.tokens == exp.tokens[0]).all(), exp.tokens
+
+    def test_restricted_sampling_keeps_ratio_centered(self, cfg):
+        """The recorded old-policy logprobs must equal what the PPO
+        update's scoring function produces for unchanged weights —
+        under top_k/top_p/temperature restriction the SAMPLER's
+        logprobs differ, and recording those would center the clip
+        window off ratio=1 (code-review r4 finding)."""
+        engine = RLHFEngine(
+            cfg,
+            lambda tokens, p: np.zeros(len(tokens), dtype=np.float32),
+            ppo=PPOConfig(
+                rollout_batch=4, max_new_tokens=6, minibatch_size=4,
+                ppo_epochs=1, top_k=2, temperature=0.7,
+            ),
+            seed=0,
+        )
+        prompts = np.tile(
+            np.array([[2, 9, 4, 1]], dtype=np.int32), (4, 1)
+        )
+        exp = engine.make_experience(prompts)
+        rescored = sequence_logprobs(
+            engine.actor_params, jnp.asarray(exp.tokens), cfg,
+            prompt_len=4,
+        )
+        np.testing.assert_allclose(
+            exp.logprobs, np.asarray(rescored), rtol=1e-5, atol=1e-6
+        )
+
     def test_trained_reward_drives_ppo(self, cfg):
         """The trained reward model plugs into the PPO engine behind the
         same reward_fn seam, and PPO moves rollouts toward the preferred
@@ -254,6 +322,67 @@ class TestHybridPlacement:
         # actor weights stayed in the TRAIN layout across the cycle
         wq2 = engine.actor_params["layers"][0]["attn"]["wq"]
         assert not wq2.sharding.is_fully_replicated
+
+
+class TestShardedRollout:
+    """VERDICT r3 missing#1: rollout generation under a mesh — the
+    multi-device inference engine analog (ref model_engine.py +
+    ds_hybrid_engine/hybrid_engine.py:378)."""
+
+    def test_sharded_generation_matches_unsharded(self, cfg, params):
+        from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+        mesh = build_mesh(MeshConfig(dp=4, tp=2))
+        prompts = jnp.asarray(
+            np.tile(np.array([[3, 11, 5, 2]], np.int32), (8, 1))
+        )
+        ref_toks, ref_lp = generate(
+            params, prompts, jax.random.PRNGKey(5), cfg,
+            max_new_tokens=8, greedy=True,
+        )
+        sh_toks, sh_lp = generate(
+            params, prompts, jax.random.PRNGKey(5), cfg,
+            max_new_tokens=8, greedy=True, mesh=mesh,
+        )
+        # tp-sharded matmuls reassociate the reductions, but greedy
+        # decode must pick identical tokens on a real logit gap
+        np.testing.assert_array_equal(
+            np.asarray(sh_toks), np.asarray(ref_toks)
+        )
+        np.testing.assert_allclose(
+            np.asarray(sh_lp), np.asarray(ref_lp), rtol=1e-4, atol=1e-5
+        )
+        # and the actual sampled path stays finite + in-vocab
+        s_toks, s_lp = generate(
+            params, prompts, jax.random.PRNGKey(6), cfg,
+            max_new_tokens=8, temperature=0.8, top_k=4, mesh=mesh,
+        )
+        assert np.isfinite(np.asarray(s_lp)).all()
+        assert (np.asarray(s_toks) < cfg.vocab_size).all()
+
+    def test_engine_rollout_runs_tp_sharded(self, cfg):
+        """With a dp×tp rollout mesh the actor's rollout copy (and the
+        frozen ref) are REALLY tp-sharded — a 7B-class actor no longer
+        needs to fit one chip — and the PPO cycle still runs."""
+        from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+        engine = RLHFEngine(
+            cfg,
+            lambda tokens, p: np.zeros(len(tokens), dtype=np.float32),
+            ppo=PPOConfig(
+                rollout_batch=8, max_new_tokens=6, minibatch_size=8,
+                ppo_epochs=1,
+            ),
+            seed=0,
+            train_mesh=build_mesh(MeshConfig(fsdp=4, dp=2)),
+            rollout_mesh=build_mesh(MeshConfig(dp=4, tp=2)),
+        )
+        ref_wq = engine.ref_params["layers"][0]["attn"]["wq"]
+        assert not ref_wq.sharding.is_fully_replicated
+        exp = engine.make_experience(np.zeros((8, 4), dtype=np.int32))
+        metrics = engine.train(prompt_len=4)
+        assert np.isfinite(metrics["loss"])
+        assert np.isfinite(exp.logprobs).all()
 
 
 class TestSamplingControls:

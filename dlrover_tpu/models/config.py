@@ -46,12 +46,28 @@ class TransformerConfig:
     dtype: str = "bfloat16"  # activation/compute dtype
     param_dtype: str = "float32"
     remat: bool = False  # checkpoint each block (HBM <-> FLOPs trade)
+    # store layer params STACKED ([L, ...] leaves) and run the blocks
+    # under ONE lax.scan: the traced graph is O(1) in depth instead of
+    # O(L), which is what lets a 48-layer model compile WITH remat
+    # (parity: the reference's activation-checkpoint optimization,
+    # optimization_library.py:39-58, is only usable at depth because
+    # torch re-executes python; XLA needs the scan). Homogeneous blocks
+    # only (no MoE interleave — same restriction as the pipeline).
+    scan_layers: bool = False
     # muP forward multipliers (models/mup.py sets these; defaults = SP)
     mup_attn_scale: Optional[float] = None  # None => 1/sqrt(head_dim)
     mup_output_mult: float = 1.0
     # int8 MXU path for the MLP projections (ops/int8_matmul.py — the
     # TPU-native analog of the reference's FP8 optimization)
     int8_mlp: bool = False
+
+    def __post_init__(self):
+        if self.scan_layers and self.num_experts:
+            raise ValueError(
+                "scan_layers needs homogeneous blocks; MoE interleave "
+                "(num_experts > 0) makes every moe_every-th block a "
+                "different pytree"
+            )
 
     @property
     def kv_heads(self) -> int:

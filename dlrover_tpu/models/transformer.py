@@ -105,7 +105,24 @@ def init_params(key, cfg: TransformerConfig) -> Params:
                 "b_down": jnp.zeros((d,), pd),
             }
         params["layers"].append(layer)
+    if cfg.scan_layers:
+        params["layers"] = stack_layer_params(params["layers"])
     return params
+
+
+def stack_layer_params(layers: list) -> Params:
+    """[L homogeneous layer dicts] → one pytree of [L, ...] leaves (the
+    ``cfg.scan_layers`` storage layout)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def unstack_layer_params(stacked: Params) -> list:
+    """Inverse of ``stack_layer_params`` (checkpoint interop with
+    list-layout models)."""
+    L = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    return [
+        jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(L)
+    ]
 
 
 def logical_axes(cfg: TransformerConfig) -> Params:
@@ -155,6 +172,14 @@ def logical_axes(cfg: TransformerConfig) -> Params:
                 "b_down": ("norm",),
             }
         axes["layers"].append(layer)
+    if cfg.scan_layers:
+        layer0 = axes["layers"][0]
+        axes["layers"] = jax.tree_util.tree_map(
+            lambda t: ("layer_stack",) + t,
+            layer0,
+            is_leaf=lambda x: isinstance(x, tuple)
+            and all(a is None or isinstance(a, str) for a in x),
+        )
     return axes
 
 
@@ -348,10 +373,16 @@ def lm_head(params: Params, x: jnp.ndarray, cfg: TransformerConfig):
 
 
 def token_nll(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
-    """Mean next-token negative log-likelihood."""
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    """Mean next-token negative log-likelihood.
+
+    Written as ``logsumexp(logits) - logits[target]`` (identical math
+    and gradient — softmax minus one-hot) instead of gathering from
+    ``log_softmax``: the log_softmax form materializes a second
+    [B, T, vocab] fp32 tensor for the backward, measured +5.6 ms/step
+    on the 124M bench (3.3 GB of avoidable HBM traffic at bs32)."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt)
 
 
 def forward(
@@ -383,9 +414,21 @@ def forward(
 
     if cfg.remat:
         block = jax.checkpoint(block)
-    for layer in params["layers"]:
-        x, aux = block(x, layer)
-        aux_total = jax.tree_util.tree_map(jnp.add, aux_total, aux)
+    if cfg.scan_layers:
+        # one scanned block: the traced/compiled graph is O(1) in depth
+        # — 48-layer remat compiles where the unrolled graph cannot
+        def sbody(carry, layer):
+            x, aux_t = carry
+            x, aux = block(x, layer)
+            return (x, jax.tree_util.tree_map(jnp.add, aux_t, aux)), None
+
+        (x, aux_total), _ = lax.scan(
+            sbody, (x, aux_total), params["layers"]
+        )
+    else:
+        for layer in params["layers"]:
+            x, aux = block(x, layer)
+            aux_total = jax.tree_util.tree_map(jnp.add, aux_total, aux)
 
     if return_hidden:
         return _norm(x, params["final_norm"], cfg), aux_total
@@ -458,8 +501,9 @@ def forward_step(
     q_pos = positions[:, :, None]  # [B, t, 1]
     mask = key_pos <= q_pos  # [B, t, S]
 
-    new_k, new_v = [], []
-    for i, layer in enumerate(params["layers"]):
+    def decode_layer(x, layer, k_cache, v_cache):
+        """One cached block: (x, this layer's K/V buffers) → (x', K',
+        V'). Shared verbatim by the unrolled loop and the scan path."""
         h = _norm(x, layer["attn_norm"], cfg)
         q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"].astype(dt))
         k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"].astype(dt))
@@ -472,13 +516,11 @@ def forward_step(
             # with the training attention math
             q = q * (cfg.mup_attn_scale * cfg.head_dim**0.5)
         k_all = lax.dynamic_update_slice(
-            cache["k"][i], k.astype(cache["k"].dtype), (0, cur_len, 0, 0)
+            k_cache, k.astype(k_cache.dtype), (0, cur_len, 0, 0)
         )
         v_all = lax.dynamic_update_slice(
-            cache["v"][i], v.astype(cache["v"].dtype), (0, cur_len, 0, 0)
+            v_cache, v.astype(v_cache.dtype), (0, cur_len, 0, 0)
         )
-        new_k.append(k_all)
-        new_v.append(v_all)
         # GQA: fold the head group next to kv heads, no KV replication.
         # fp32 accumulation throughout, matching the flash path's
         # numerics (a bf16-accumulated decode would diverge from the
@@ -499,6 +541,26 @@ def forward_step(
             "bthk,hkd->btd", o, layer["attn"]["wo"].astype(dt)
         )
         x, _ = _mlp_block(x, layer, cfg, None)
+        return x, k_all, v_all
+
+    if cfg.scan_layers:
+
+        def sbody(x, inp):
+            layer, k_cache, v_cache = inp
+            x, k_all, v_all = decode_layer(x, layer, k_cache, v_cache)
+            return x, (k_all, v_all)
+
+        x, (k_new, v_new) = lax.scan(
+            sbody, x, (params["layers"], cache["k"], cache["v"])
+        )
+        logits = lm_head(params, x, cfg)
+        return logits, {"k": k_new, "v": v_new}
+
+    new_k, new_v = [], []
+    for i, layer in enumerate(params["layers"]):
+        x, k_all, v_all = decode_layer(x, layer, cache["k"][i], cache["v"][i])
+        new_k.append(k_all)
+        new_v.append(v_all)
 
     logits = lm_head(params, x, cfg)
     return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}

@@ -57,6 +57,9 @@ def default_lm_rules() -> ShardingRules:
             "experts": "ep",
             "expert_mlp": "tp",
             "norm": None,
+            # scan_layers models: the stacked [L, ...] leaf axis stays
+            # unsharded (layers are sequential; pp shards it instead)
+            "layer_stack": None,
         }
     )
 

@@ -115,6 +115,11 @@ def _check_pipeline_cfg(
             "pipeline parallelism requires homogeneous blocks (MoE layers "
             "interleave a different tree structure); use ep without pp"
         )
+    if cfg.scan_layers:
+        raise ValueError(
+            "pipeline parallelism has its own stage-stacked layout; set "
+            "scan_layers=False (stages already scan their layer block)"
+        )
     stages = pp * virtual
     if cfg.num_layers % stages != 0:
         what = (
@@ -152,6 +157,17 @@ def stack_pipeline_params(params: Any, pp: int, virtual: int = 1) -> Any:
     return out
 
 
+def _dechunk_leaf(x, virtual: int):
+    """One stacked-stage leaf back to global layer order [L, ...]:
+    [pp, lc, ...] (``virtual=1``) or chunk-major [pp, v, lc, ...]
+    (``virtual>1``, via stage-major [v, pp, lc, ...]). The SINGLE home
+    of the interleaved-layout algebra — ``unstack_pipeline_params`` and
+    ``pipeline_forward``'s eval restack both go through here."""
+    if virtual > 1:
+        x = x.swapaxes(0, 1)
+    return x.reshape(-1, *x.shape[2 + (virtual > 1):])
+
+
 def unstack_pipeline_params(
     pparams: Any, cfg: TransformerConfig, virtual: int = 1
 ) -> Any:
@@ -159,13 +175,9 @@ def unstack_pipeline_params(
     stages = pparams["stages"]
     L = cfg.num_layers
 
-    def leaf(x):
-        if virtual > 1:
-            # [pp, v, lc, ...] -> stage-major [v, pp, lc, ...] -> [L, ...]
-            x = x.swapaxes(0, 1)
-        return x.reshape(L, *x.shape[2 + (virtual > 1):])
-
-    flat = jax.tree_util.tree_map(leaf, stages)
+    flat = jax.tree_util.tree_map(
+        lambda x: _dechunk_leaf(x, virtual), stages
+    )
     layers = [
         jax.tree_util.tree_map(lambda x: x[i], flat) for i in range(L)
     ]
@@ -217,15 +229,35 @@ def pipeline_forward(
     cfg: TransformerConfig,
     mesh,
     num_microbatches: int,
+    virtual: int = 1,
 ) -> jnp.ndarray:
     """tokens [B,T] int32 → logits [B,T,vocab] fp32, staged over pp.
 
     B must divide by ``num_microbatches`` (and the microbatch by the dp
     sharding, as usual).
+
+    ``virtual>1`` accepts params in the interleaved [pp, v, lc, ...]
+    layout (stack_pipeline_params) and restacks them in-graph to the
+    contiguous [pp, L/pp, ...] layout this forward schedule uses: the
+    grad-free eval path doesn't need the interleaved bubble win, only
+    layout compatibility with the training state. The restack is one
+    GSPMD reshard over pp per eval compile — acceptable for eval.
     """
     pp = mesh.shape["pp"]
     M = num_microbatches
-    _check_pipeline_cfg(cfg, pp)
+    _check_pipeline_cfg(cfg, pp, virtual)
+    if virtual > 1:
+        L = cfg.num_layers
+
+        def to_contiguous(x):
+            # global layer order, then contiguous stages [pp, L/pp, ...]
+            flat = _dechunk_leaf(x, virtual)
+            return flat.reshape(pp, L // pp, *flat.shape[1:])
+
+        pparams = dict(pparams)
+        pparams["stages"] = jax.tree_util.tree_map(
+            to_contiguous, pparams["stages"]
+        )
     if mesh.shape.get("sp", 1) > 1:
         raise ValueError("sp (ring attention) inside pp stages not supported")
     B, T = tokens.shape
@@ -316,9 +348,17 @@ def pipeline_forward(
 
 
 def pipeline_loss_fn(
-    pparams, tokens, targets, cfg: TransformerConfig, mesh, num_microbatches
+    pparams,
+    tokens,
+    targets,
+    cfg: TransformerConfig,
+    mesh,
+    num_microbatches,
+    virtual: int = 1,
 ) -> jnp.ndarray:
-    logits = pipeline_forward(pparams, tokens, cfg, mesh, num_microbatches)
+    logits = pipeline_forward(
+        pparams, tokens, cfg, mesh, num_microbatches, virtual=virtual
+    )
     return token_nll(logits, targets)
 
 
@@ -431,6 +471,34 @@ def pipeline_value_and_grad_1f1b(
         head_params["embed"] = pparams["embed"]
     else:
         head_params["lm_head"] = pparams["lm_head"]
+
+    emb_params = pparams["embed"]
+    if mesh.shape.get("tp", 1) > 1:
+        # PP×TP composition: the vocab-PARALLEL embedding gather /
+        # scatter-add and head projection cannot be partitioned inside
+        # the pp-manual scan — XLA's SPMD partitioner hits a subgroup
+        # CHECK (spmd_partitioner_util.cc) trying to group the gather's
+        # collective across tp while pp is manual. The persistent state
+        # keeps its vocab→tp layout (shared with gpipe, whose embed/head
+        # run OUTSIDE the shard_map region); here we pin a vocab-
+        # replicated copy for the body — one tp all-gather of the
+        # embed/head tables per step, amortized over all M microbatches.
+        devocab = dict(pipeline_rules(None).rules)
+        devocab["vocab"] = None
+        devocab_rules = ShardingRules(rules=devocab)
+        la = logical_axes(cfg)
+
+        def _pin(tree, axes):
+            return jax.tree_util.tree_map(
+                lax.with_sharding_constraint,
+                tree,
+                apply_rules(axes, devocab_rules, mesh),
+            )
+
+        emb_params = _pin(emb_params, la["embed"])
+        head_params = _pin(
+            head_params, {k: la[k] for k in head_params}
+        )
 
     mb_axes = _microbatch_axes(mesh, mb)
     tok = lax.with_sharding_constraint(
@@ -654,7 +722,16 @@ def pipeline_value_and_grad_1f1b(
         in_specs=(P("pp"), P(), P(), P(), P()),
         out_specs=(P("pp"), P(), P(), P()),
         axis_names={"pp"},
-    )(pparams["stages"], head_params, pparams["embed"], tok, tgt)
+    )(pparams["stages"], head_params, emb_params, tok, tgt)
+
+    if mesh.shape.get("tp", 1) > 1:
+        # pin the grad OUTPUTS to the same vocab-replicated layout: the
+        # optimizer downstream holds vocab→tp moments, and without this
+        # boundary XLA propagates that layout back into the scan carry —
+        # recreating exactly the unpartitionable gather/scatter inside
+        # the loop that the input pin above avoided
+        ghead = _pin(ghead, {k: la[k] for k in ghead})
+        gemb = _pin(gemb, la["embed"])
 
     grads = {
         "stages": gstage,

@@ -126,7 +126,9 @@ def build_optimizer(
     else:
         raise ValueError(f"unknown lr schedule {schedule!r}")
 
-    if name not in ("adamw", "adam", "sgd", "agd", "adamw_8bit"):
+    if name not in (
+        "adamw", "adam", "sgd", "agd", "adamw_8bit", "adamw_8bit_flat"
+    ):
         raise ValueError(f"unknown optimizer {name!r}")
 
     def make(learning_rate, retune_scale):
@@ -153,6 +155,12 @@ def build_optimizer(
             from dlrover_tpu.ops.quantized_optim import adamw_8bit
 
             opt = adamw_8bit(
+                learning_rate, weight_decay=weight_decay, **kwargs
+            )
+        elif name == "adamw_8bit_flat":
+            from dlrover_tpu.ops.quantized_optim import adamw_8bit_flat
+
+            opt = adamw_8bit_flat(
                 learning_rate, weight_decay=weight_decay, **kwargs
             )
         else:
@@ -200,6 +208,25 @@ class ElasticTrainer:
         self.mesh = self.accel.mesh
         self._step_fn = self.accel.step_fn
         self.state = self.accel.init_fn(jax.random.PRNGKey(0))
+        from dlrover_tpu.ops.quantized_optim import Adam8FlatState
+
+        m = self.accel.strategy.mesh
+        has_flat = any(
+            isinstance(x, Adam8FlatState)
+            for x in jax.tree_util.tree_leaves(
+                self.state.opt_state,
+                is_leaf=lambda x: isinstance(x, Adam8FlatState),
+            )
+        )
+        if max(m.fsdp, m.tp, m.ep, m.sp, m.pp) > 1 and has_flat:
+            # the flat optimizer concatenates every big leaf per step:
+            # on a model-sharded mesh that forces cross-shard
+            # all-gathers and replicates the packed moment buffers,
+            # silently defeating ZeRO/TP sharding
+            raise ValueError(
+                "adamw_8bit_flat is for replicated/dp-only states; use "
+                "adamw_8bit (per-leaf) with fsdp/tp/ep/sp/pp sharding"
+            )
 
         self.sampler = ElasticDistributedSampler(
             len(dataset), shuffle=True
@@ -271,9 +298,16 @@ class ElasticTrainer:
             from dlrover_tpu.parallel.pipeline import pipeline_loss_fn
 
             mb = self.accel.strategy.num_microbatches
+            # the state layout is [pp, v, lc] iff the TRAINING schedule
+            # is interleaved — eval must read the same layout. The
+            # schedule may live in pp_schedule OR (pre-apply) only in
+            # opts; resolved_virtual() honors both sources
+            virtual = self.accel.strategy.resolved_virtual()
 
             def eval_loss(params, x, y):
-                return pipeline_loss_fn(params, x, y, cfg, mesh, mb)
+                return pipeline_loss_fn(
+                    params, x, y, cfg, mesh, mb, virtual=virtual
+                )
 
         else:
             from dlrover_tpu.models.transformer import forward, token_nll

@@ -239,6 +239,53 @@ class TestTrainerSurface:
         # params trained on the same token distribution: eval improves
         assert after < before
 
+    def test_eval_runs_under_interleaved_pipeline(self):
+        """ADVICE r3 (medium): evaluate() crashed for pp_schedule=
+        'interleaved' — the eval step scanned the [pp, v, lc] chunked
+        layout as [pp, L/pp]. The eval step now threads the strategy's
+        resolved virtual stages into pipeline_forward."""
+        t = ElasticTrainer(
+            model_cfg=tiny(num_layers=4),
+            tx=optax.adamw(1e-2),
+            dataset=_Tokens(),
+            eval_dataset=_Tokens(n=32, seed=5),
+            trainer_cfg=TrainerConfig(
+                batch_size=8, seq_len=32, report_metrics=False,
+                log_interval=1, eval_steps=2,
+            ),
+            strategy=Strategy(
+                mesh=MeshConfig(pp=2, dp=4), dtype="float32",
+                num_microbatches=4, pp_schedule="interleaved",
+                pp_virtual=2,
+            ),
+        )
+        t.train(num_steps=2)
+        m = t.evaluate()
+        assert np.isfinite(m["eval_loss"]), m
+
+    def test_eval_interleaved_via_opts_route(self):
+        """The schedule may arrive as an OPT name instead of
+        pp_schedule (candidates / auto_accelerate return pre-apply
+        strategies) — eval must resolve the chunked layout from either
+        source (Strategy.resolved_virtual)."""
+        t = ElasticTrainer(
+            model_cfg=tiny(num_layers=4),
+            tx=optax.adamw(1e-2),
+            dataset=_Tokens(),
+            eval_dataset=_Tokens(n=32, seed=5),
+            trainer_cfg=TrainerConfig(
+                batch_size=8, seq_len=32, report_metrics=False,
+                log_interval=1, eval_steps=2,
+            ),
+            strategy=Strategy(
+                mesh=MeshConfig(pp=2, dp=4), dtype="float32",
+                num_microbatches=4, opts=("interleaved",),
+            ),
+        )
+        t.train(num_steps=2)
+        m = t.evaluate()
+        assert np.isfinite(m["eval_loss"]), m
+
     def test_train_metrics_reach_master_collector(self):
         """The full metric leg: trainer publishes scalars ->
         TrainingMonitor forwards -> master collector stores them."""

@@ -130,6 +130,86 @@ def oom_adjust(
     )
 
 
+INIT_ADJUST_MAX_SAMPLES = 5
+# early readings UNDERESTIMATE the peak (activation ramp, cache fill,
+# first eval not yet run) — the init phase gets double headroom where
+# steady state right-sizes at 1.5x (resource/optimizer.py)
+INIT_MEMORY_MARGIN = 1.0
+HOT_JOB_FRACTION = 0.5
+# hot events must be FRESH to drive a scale-out: with a long window a
+# single transient burst would re-fire on every optimize() cycle and
+# ratchet the worker count up long after the pressure subsided
+HOT_EVENT_WINDOW_S = 600.0
+
+
+def init_adjust(
+    ds: Datastore,
+    job: str,
+    samples: List[comm.JobMetricsSample],
+) -> Optional[ResourcePlan]:
+    """Early right-sizing (ref optimize_job_ps_init_adjust_resource.go:
+    a just-started job is adjusted from its first readings with a
+    margin, before the step-count threshold admits the standard
+    algorithm). Distinct from the local optimizer's steady-state 1.5x
+    memory rule by the LARGER init margin: first samples are taken
+    before activations/caches peak, so right-sizing to 1.5x of them
+    invites the very OOM the margin exists to prevent. None outside
+    the init phase (> ``INIT_ADJUST_MAX_SAMPLES`` live samples)."""
+    live = [s for s in samples if s.alive_nodes > 0]
+    if not live or len(live) > INIT_ADJUST_MAX_SAMPLES:
+        return None
+    peak = max(s.total_memory_mb / s.alive_nodes for s in live)
+    if peak <= 0:
+        return None
+    return ResourcePlan(
+        worker_memory_mb=int(peak * (1 + INIT_MEMORY_MARGIN)),
+        reason=(
+            f"init adjust: early phase ({len(live)} sample(s)), "
+            f"{peak:.0f} MB/worker x {1 + INIT_MEMORY_MARGIN:.1f}"
+        ),
+    )
+
+
+def hot_node_adjust(
+    ds: Datastore,
+    job: str,
+    samples: List[comm.JobMetricsSample],
+    node_unit: int = 1,
+    now: Optional[float] = None,
+) -> Optional[ResourcePlan]:
+    """Job-level hot-group scale-out (ref
+    optimize_job_hot_ps_resource.go: a PS group running at sustained
+    high CPU with many workers gets more resources before throughput
+    visibly sags). Here: when >= ``HOT_JOB_FRACTION`` of THIS job's
+    current nodes report recent sustained-hot events, grow the worker
+    group by one node-unit — spreading the (input-pipeline / host-side)
+    load is the TPU-pool response to hot hosts. Distinct from
+    ``bad_node_exclusion``: that condemns individual hosts on
+    CROSS-job evidence; this reacts to one job's aggregate pressure."""
+    now = time.time() if now is None else now
+    hot = [
+        e
+        for e in ds.node_events(
+            job=job, event="hot", since_ts=now - HOT_EVENT_WINDOW_S
+        )
+        if e.cpu_percent >= HOT_CPU_THRESHOLD
+    ]
+    if not hot:
+        return None
+    live = [s for s in samples if s.alive_nodes > 0]
+    size = live[-1].alive_nodes if live else 0
+    hosts = {e.hostname or str(e.node_id) for e in hot}
+    if size <= 0 or len(hosts) < max(1, int(HOT_JOB_FRACTION * size)):
+        return None
+    return ResourcePlan(
+        worker_count=size + node_unit,
+        reason=(
+            f"hot nodes: {len(hosts)}/{size} hosts sustained "
+            f">= {HOT_CPU_THRESHOLD:.0f}% cpu — scale out by {node_unit}"
+        ),
+    )
+
+
 UNDERPERFORMANCE_RATIO = 0.6
 
 
@@ -226,6 +306,22 @@ def run_algorithms(
 
             local = JobResourceOptimizer(node_unit=node_unit)
         plan = local.plan_from_samples(samples)
+
+    init = init_adjust(ds, job, samples)
+    if init is not None and (plan.worker_memory_mb or 0) < (
+        init.worker_memory_mb or 0
+    ):
+        plan.worker_memory_mb = init.worker_memory_mb
+        plan.reason = "; ".join(
+            p for p in (plan.reason, init.reason) if p
+        )
+
+    hot = hot_node_adjust(ds, job, samples, node_unit=node_unit, now=now)
+    if hot is not None and (plan.worker_count or 0) < (
+        hot.worker_count or 0
+    ):
+        plan.worker_count = hot.worker_count
+        plan.reason = "; ".join(p for p in (plan.reason, hot.reason) if p)
 
     oom = oom_adjust(ds, job, now=now, samples=samples)
     if oom is not None and (plan.worker_memory_mb or 0) < (

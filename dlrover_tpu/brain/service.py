@@ -28,7 +28,15 @@ CREATE TABLE IF NOT EXISTS job_end (
     job TEXT PRIMARY KEY,
     exit_reason TEXT NOT NULL,
     worker_count INTEGER,
-    worker_memory_mb INTEGER
+    worker_memory_mb INTEGER,
+    end_ts REAL DEFAULT 0
+);
+CREATE TABLE IF NOT EXISTS job_profile (
+    job TEXT NOT NULL,
+    alive_nodes INTEGER NOT NULL,
+    best_steps_per_sec REAL,
+    peak_worker_memory_mb REAL,
+    PRIMARY KEY (job, alive_nodes)
 );
 CREATE TABLE IF NOT EXISTS node_events (
     job TEXT NOT NULL,
@@ -47,6 +55,12 @@ CREATE INDEX IF NOT EXISTS node_events_ts ON node_events (ts);
 # widest algorithm window is BAD_NODE_WINDOW_S = 7 days)
 _NODE_EVENT_RETENTION_S = 30 * 24 * 3600.0
 
+# raw per-sample series of COMPLETED jobs are evicted this long after
+# the job ends (post-mortem window); their contribution to cold-start
+# fits lives on in the compact ``job_profile`` rollup (the reference's
+# MySQL retention policy analog, datastore/.../mysql.go)
+_SERIES_RETENTION_S = 7 * 24 * 3600.0
+
 # batched prune: run the per-job retention DELETE only once per this
 # many inserts — per-insert pruning held the global lock for a
 # DELETE..NOT IN subquery on every sample (quadratic-ish at the cap)
@@ -61,6 +75,24 @@ class BrainServicer:
         # one connection guarded by a lock: the RPC pool is many threads
         self._conn = sqlite3.connect(db_path, check_same_thread=False)
         self._conn.executescript(_SCHEMA)
+        # pre-rollup on-disk stores lack the end_ts column
+        try:
+            self._conn.execute(
+                "ALTER TABLE job_end ADD COLUMN end_ts REAL DEFAULT 0"
+            )
+        except sqlite3.OperationalError:
+            pass  # already present
+        # backfill profiles for jobs that ended BEFORE the rollup
+        # existed — their raw series still holds the data, and without
+        # this the cold-start fleet curve would silently forget them
+        self._conn.execute(
+            "INSERT OR IGNORE INTO job_profile "
+            "SELECT job, alive_nodes, MAX(steps_per_sec), "
+            "MAX(total_memory_mb * 1.0 / alive_nodes) "
+            "FROM job_metrics WHERE alive_nodes > 0 AND job IN "
+            "(SELECT job FROM job_end) GROUP BY job, alive_nodes"
+        )
+        self._conn.commit()
         self._lock = threading.Lock()
         self._max_rows = max_rows_per_job
         self._inserts_since_prune: dict = {}
@@ -142,13 +174,38 @@ class BrainServicer:
             self._conn.commit()
 
     def record_job_end(self, r: comm.BrainJobEndReport):
+        import time as _time
+
+        now = _time.time()
         with self._lock:
             self._conn.execute(
-                "INSERT OR REPLACE INTO job_end VALUES (?,?,?,?)",
+                "INSERT OR REPLACE INTO job_end VALUES (?,?,?,?,?)",
                 (
                     r.job_name, r.exit_reason, r.worker_count,
-                    r.worker_memory_mb,
+                    r.worker_memory_mb, now,
                 ),
+            )
+            # roll the job's raw series up into the compact per-size
+            # profile the cold-start fit reads — the series itself can
+            # then be evicted without losing the job's contribution
+            self._conn.execute(
+                "INSERT OR REPLACE INTO job_profile "
+                "SELECT job, alive_nodes, MAX(steps_per_sec), "
+                "MAX(total_memory_mb * 1.0 / alive_nodes) "
+                "FROM job_metrics WHERE job = ? AND alive_nodes > 0 "
+                "GROUP BY alive_nodes",
+                (r.job_name,),
+            )
+            # evict raw series of jobs ended past the post-mortem
+            # window — only samples FROM BEFORE that end: a job
+            # resubmitted under the same name streams fresh rows with
+            # ts > end_ts, which must survive
+            self._conn.execute(
+                "DELETE FROM job_metrics WHERE EXISTS ("
+                "SELECT 1 FROM job_end e WHERE e.job = job_metrics.job "
+                "AND e.end_ts > 0 AND e.end_ts < ? "
+                "AND job_metrics.ts <= e.end_ts)",
+                (now - _SERIES_RETENTION_S,),
             )
             self._conn.commit()
 
@@ -174,17 +231,18 @@ class BrainServicer:
 
     def fleet_size_curve(self):
         """(size -> best steps/sec, fleet per-worker memory peak MB,
-        completed-job count) over COMPLETED jobs, as one SQL aggregate —
-        cold start must not fetch every history job's full series."""
+        completed-job count) over COMPLETED jobs, as one SQL aggregate
+        over the ``job_profile`` rollup — cold start must not fetch any
+        history job's raw series (which may already be evicted)."""
         with self._lock:
             n_jobs = self._conn.execute(
                 "SELECT COUNT(*) FROM job_end WHERE exit_reason = "
                 "'completed'"
             ).fetchone()[0]
             rows = self._conn.execute(
-                "SELECT alive_nodes, MAX(steps_per_sec), "
-                "MAX(total_memory_mb * 1.0 / alive_nodes) "
-                "FROM job_metrics WHERE alive_nodes > 0 AND job IN "
+                "SELECT alive_nodes, MAX(best_steps_per_sec), "
+                "MAX(peak_worker_memory_mb) "
+                "FROM job_profile WHERE job IN "
                 "(SELECT job FROM job_end WHERE exit_reason = 'completed') "
                 "GROUP BY alive_nodes"
             ).fetchall()

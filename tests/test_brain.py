@@ -180,6 +180,81 @@ class TestClusterAlgorithms:
         finally:
             a.close(); c.close()
 
+    def test_init_adjust_right_sizes_early(self, brain):
+        """A job with only FIRST samples (too few for the windowed
+        optimizer) gets memory right-sized from its own readings + 50%
+        (ref optimize_job_ps_init_adjust_resource.go)."""
+        c = BrainClient(brain, "young")
+        try:
+            c.persist_metrics(_sample(2, 5.0, mem=2000, ts=1.0))
+            c.persist_metrics(_sample(2, 5.1, mem=2400, ts=2.0))
+            plan = c.optimize()
+            # peak 1200 MB/worker x 2.0 init headroom (the steady-state
+            # rule would give only x1.5 of an underestimating reading)
+            assert plan.worker_memory_mb == 2400, plan
+            assert "init adjust" in plan.reason
+        finally:
+            c.close()
+
+    def test_hot_job_scales_out(self, brain):
+        """A MAJORITY of one job's nodes running sustained-hot grows
+        the worker group by a node-unit (ref
+        optimize_job_hot_ps_resource.go) — while a single hot host in
+        one job does NOT (that is bad_node_exclusion territory and
+        needs cross-job evidence)."""
+        c = BrainClient(brain, "hotjob")
+        try:
+            for i in range(10):
+                c.persist_metrics(
+                    _sample(4, 9.9 + 0.01 * i, mem=1000, ts=float(i + 1))
+                )
+            c.report_node_event(0, "h0", "hot", cpu_percent=95.0)
+            plan = c.optimize()
+            assert (plan.worker_count or 0) <= 4, plan  # 1/4 hot: no
+            for nid, host in ((1, "h1"), (2, "h2")):
+                c.report_node_event(nid, host, "hot", cpu_percent=96.0)
+            plan = c.optimize()
+            assert plan.worker_count == 5, plan  # 3/4 hot: scale out
+            assert "hot nodes" in plan.reason
+        finally:
+            c.close()
+
+    def test_profile_rollup_survives_series_eviction(self):
+        """Completed jobs' raw series evict after the post-mortem
+        window; the cold-start fit still works from the job_profile
+        rollup (the MySQL retention-policy analog)."""
+        import dlrover_tpu.brain.service as svc
+
+        s = svc.BrainServicer()
+        try:
+            s.persist_metrics("old", _sample(2, 10.0, mem=800, ts=1.0))
+            s.persist_metrics("old", _sample(4, 19.0, mem=2000, ts=2.0))
+            s.record_job_end(
+                comm.BrainJobEndReport(
+                    job_name="old", exit_reason="completed",
+                    worker_count=4, worker_memory_mb=0,
+                )
+            )
+            # age the job-end stamp past the retention window, then
+            # trigger eviction via another job's end
+            s._conn.execute(
+                "UPDATE job_end SET end_ts = end_ts - ? WHERE job = 'old'",
+                (svc._SERIES_RETENTION_S + 10,),
+            )
+            s.record_job_end(
+                comm.BrainJobEndReport(
+                    job_name="other", exit_reason="failed",
+                    worker_count=0, worker_memory_mb=0,
+                )
+            )
+            assert s.job_metrics("old") == []  # raw series gone
+            speed, peak, n_jobs = s.fleet_size_curve()
+            assert n_jobs == 1
+            assert speed == {2: 10.0, 4: 19.0}  # rollup intact
+            assert peak == 500.0
+        finally:
+            s.close()
+
     def test_prune_is_batched_but_bounded(self):
         from dlrover_tpu.brain.service import BrainServicer, _PRUNE_EVERY
 

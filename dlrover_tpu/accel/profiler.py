@@ -265,7 +265,11 @@ def module_breakdown(
     tokens = jnp.zeros((batch, seq), jnp.int32)
     positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
     x = jnp.zeros((batch, seq, cfg.model_dim), jnp.dtype(cfg.dtype))
-    layer0 = params["layers"][0]
+    layer0 = (
+        jax.tree_util.tree_map(lambda x: x[0], params["layers"])
+        if cfg.scan_layers
+        else params["layers"][0]
+    )
 
     def _block_fwd(layer, x):
         h = _attention_block(x, layer, cfg, None, positions)
@@ -310,9 +314,11 @@ def module_breakdown(
         for _ in range(iters):
             r = fn(*args)
         # force through a scalar readback (tunneled runtimes return from
-        # block_until_ready early)
+        # block_until_ready early). The slice happens DEVICE-side: a
+        # np.asarray(leaf) here would drag the whole leaf over the
+        # (slow) d2h link and bill it to the module being timed
         leaf = jax.tree_util.tree_leaves(r)[0]
-        float(np.asarray(leaf).ravel()[0])
+        float(jnp.ravel(leaf)[0].astype(jnp.float32))
         dt = (time.perf_counter() - t0) / iters
         out.append(
             ModuleLatency(

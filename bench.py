@@ -110,6 +110,38 @@ def _model_flops_per_step(cfg, batch: int, seq: int, n_params: int) -> float:
     return dense + attn
 
 
+def _make_restore_template(jax, cfg, mesh, tx):
+    """Precompiled sharded-zeros TrainState builder — what a restarted
+    worker compiles during bring-up, before it loads. Shared by both
+    goodput probes so template-sharding fixes cannot diverge."""
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models import TrainState, init_params
+    from dlrover_tpu.models.train import state_shardings
+
+    sh = state_shardings(cfg, mesh, tx)
+    params_shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg)
+    )
+
+    def _zeros():
+        p = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), params_shapes
+        )
+        return TrainState(
+            step=jnp.zeros((), jnp.int32), params=p, opt_state=tx.init(p)
+        )
+
+    make_template = jax.jit(
+        _zeros,
+        out_shardings=TrainState(
+            step=sh.step, params=sh.params, opt_state=sh.opt_state
+        ),
+    )
+    jax.block_until_ready(make_template())
+    return make_template
+
+
 def run_goodput(jax, results: dict) -> bool:
     import optax
 
@@ -169,33 +201,7 @@ def _goodput_body(
     jax, results, engine, ckpt_dir, cfg, model_name, mesh, tx,
     state, step_fn, data, batch, seq, bw, on_accel, n_dev,
 ) -> bool:
-    import jax.numpy as jnp
-
-    from dlrover_tpu.models import TrainState, init_params
-    from dlrover_tpu.models.train import state_shardings
-
-    # restore template: sharded zeros, precompiled (a restarted worker
-    # compiles this during normal bring-up, before it loads)
-    sh = state_shardings(cfg, mesh, tx)
-    params_shapes = jax.eval_shape(
-        lambda: init_params(jax.random.PRNGKey(0), cfg)
-    )
-
-    def _zeros():
-        p = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), params_shapes
-        )
-        return TrainState(
-            step=jnp.zeros((), jnp.int32), params=p, opt_state=tx.init(p)
-        )
-
-    make_template = jax.jit(
-        _zeros,
-        out_shardings=TrainState(
-            step=sh.step, params=sh.params, opt_state=sh.opt_state
-        ),
-    )
-    jax.block_until_ready(make_template())
+    make_template = _make_restore_template(jax, cfg, mesh, tx)
 
     # warmup/compile + step-time calibration
     state, _ = step_fn(state, data["x"], data["y"])
@@ -275,6 +281,226 @@ def _goodput_body(
         }
     )
     return True
+
+
+def run_goodput_124m(jax, results: dict):
+    """Goodput components at REAL scale: gpt2_small 124M with its full
+    ~1.5 GB fp32 train state through stage + commit + restore, one
+    injected preemption (VERDICT r3 #7).
+
+    The headline goodput scenario picks a model the harness's ~24 MB/s
+    tunneled d2h link can stage inside its save cadence; this probe
+    measures what that link does at 124M honestly — stage-to-commit
+    latency, restore seconds, measured goodput over the probe window —
+    and reports the LINK-BUDGET extrapolation: per-preemption overhead
+    at a realistic one-preemption-per-hour density (the reference's
+    GLM-65B scenario is sparser still). On a real TPU-VM (no tunnel,
+    ~10+ GB/s d2h) the stage term shrinks ~400x and the measured-window
+    number converges to the extrapolated one.
+    """
+    import optax
+
+    from dlrover_tpu.ckpt.engine import CheckpointEngine
+    from dlrover_tpu.ckpt.saver import AsyncCheckpointSaver
+    from dlrover_tpu.models import (
+        build_train_step,
+        gpt2_small,
+        init_sharded_state,
+        shard_batch,
+    )
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    if jax.devices()[0].platform == "cpu":
+        return
+
+    batch, seq = 32, 512
+    cfg = replace(gpt2_small(), max_seq_len=seq)
+    mesh = build_mesh(MeshConfig(dp=len(jax.devices())))
+    tx = optax.adamw(3e-4, weight_decay=0.01)
+    state, _ = init_sharded_state(jax.random.PRNGKey(0), cfg, mesh, tx)
+    step_fn = build_train_step(cfg, mesh, tx, donate=False)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    data = shard_batch({"x": tokens, "y": tokens}, mesh)
+    state_bytes = sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree_util.tree_leaves(state)
+    )
+
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt124_")
+    AsyncCheckpointSaver.reset()
+    AsyncCheckpointSaver.start_async_saving_ckpt(local_shard_num=1)
+    engine = CheckpointEngine()
+    try:
+        make_template = _make_restore_template(jax, cfg, mesh, tx)
+        state, _ = step_fn(state, data["x"], data["y"])  # compile
+        jax.block_until_ready(state.params)
+
+        t_bench0 = time.perf_counter()
+        step_time = 0.0
+        done = 0
+
+        def _train(n):
+            nonlocal state, step_time, done
+            for _ in range(n):
+                t0 = time.perf_counter()
+                state, _ = step_fn(state, data["x"], data["y"])
+                jax.block_until_ready(state.params)
+                step_time += time.perf_counter() - t0
+                done += 1
+
+        _train(20)
+        t0 = time.perf_counter()
+        if not engine.save_to_memory(done, state, ckpt_dir, block=False):
+            # skipped (shard lock busy) — bail immediately instead of
+            # polling 124M-scale train steps against a commit that can
+            # never arrive
+            results["goodput_124m_error"] = "stage skipped (lock busy)"
+            return
+        save_block_s = time.perf_counter() - t0
+        # train THROUGH the async stage; poll for the commit
+        t_stage0 = time.perf_counter()
+        while engine.latest_step(ckpt_dir) < 0:
+            _train(1)
+            if time.perf_counter() - t_stage0 > 900:
+                results["goodput_124m_error"] = "stage never committed"
+                return
+        stage_commit_s = time.perf_counter() - t_stage0
+        committed = engine.latest_step(ckpt_dir)
+
+        # preempt: lose the live state, restore the committed one
+        del state
+        t0 = time.perf_counter()
+        step0, state = engine.load(make_template(), ckpt_dir)
+        jax.block_until_ready(state.params)
+        restore_s = time.perf_counter() - t0
+        lost_steps = done - step0
+        done = step0
+        _train(10)
+
+        wall = time.perf_counter() - t_bench0
+        goodput_window = 100.0 * step_time / wall
+        step_s = step_time / max(done + lost_steps, 1)
+        # link-budget extrapolation: one preemption per hour costs
+        # restore + the steps staged-but-uncommitted work lost
+        overhead_s = restore_s + lost_steps * step_s
+        results.update(
+            {
+                "goodput_124m_window_pct": round(goodput_window, 2),
+                "goodput_124m_per_hr_pct": round(
+                    100.0 * (1.0 - overhead_s / 3600.0), 2
+                ),
+                "goodput_124m_state_GB": round(state_bytes / 1e9, 3),
+                "goodput_124m_save_block_ms": round(
+                    save_block_s * 1e3, 1
+                ),
+                "goodput_124m_stage_commit_s": round(stage_commit_s, 1),
+                "goodput_124m_restore_s": round(restore_s, 1),
+                "goodput_124m_lost_steps": int(lost_steps),
+                "goodput_124m_note": (
+                    "full 124M fp32 train state through stage+commit+"
+                    "restore on the ~24 MB/s tunneled d2h link; "
+                    "per-hour number is the link-budget extrapolation "
+                    f"(overhead {overhead_s:.0f}s/preemption), window "
+                    "number is the probe window itself"
+                ),
+            }
+        )
+        assert committed >= 0
+    finally:
+        engine.close()
+        AsyncCheckpointSaver.reset()
+
+
+def run_sp_compare(jax, results: dict):
+    """Ring vs Ulysses sequence parallelism: the per-device COMPUTE
+    each scheme runs at long context, timed with the Pallas flash
+    kernel on the real chip (VERDICT r3 #9 — make cfg.sp_scheme
+    selection data-driven).
+
+    One harness chip cannot run the sp=4 collectives, so this times
+    exactly the part that differs per device and is measurable here:
+    ring = sp sequential kernel calls over [T/sp]-key chunks (its
+    ppermute overlaps compute; per-hop kernel-launch + small-shape
+    overhead is ring's real cost), ulysses = ONE full-sequence kernel
+    on heads/sp heads (its cost is the two all-to-alls, which ride
+    ICI and move act_bytes/sp per device — noted analytically). The
+    dryrun proves both schemes' collectives compile+run on the 8-way
+    virtual mesh; this records which one's compute wins at seq 4096.
+    """
+    import functools
+
+    import jax.numpy as jnp
+
+    from dlrover_tpu.ops.flash_attention import flash_attention_fwd
+
+    if jax.devices()[0].platform == "cpu":
+        return
+    B, T, H, D = 2, 4096, 16, 128
+    sp = 4
+    rng = np.random.default_rng(3)
+
+    def mk(h, t):
+        return (
+            jnp.asarray(rng.normal(size=(B, t, h, D)), jnp.bfloat16),
+            jnp.asarray(rng.normal(size=(B, t, h, D)), jnp.bfloat16),
+            jnp.asarray(rng.normal(size=(B, t, h, D)), jnp.bfloat16),
+        )
+
+    @functools.partial(jax.jit, static_argnums=(3,))
+    def ring_device(q, k, v, iters):
+        # one device's work per step: sp kernel calls, q [T/sp] local,
+        # each hop's k/v chunk [T/sp] (causal offsets as in
+        # parallel/ring_attention.py), chained via the accumulator
+        def one(acc, _):
+            o = acc
+            for hop in range(sp):
+                # the LAST rank's hops (the causal bottleneck with
+                # plain chunk order): every earlier chunk fully
+                # visible, the diagonal hop causal
+                o_h, _ = flash_attention_fwd(
+                    q, k, v, causal=True,
+                    q_offset=(sp - 1) * (T // sp),
+                    k_offset=hop * (T // sp),
+                )
+                o = o + o_h.astype(jnp.float32)
+            return o, None
+        acc0 = jnp.zeros((B, T // sp, H, D), jnp.float32)
+        out, _ = jax.lax.scan(one, acc0, jnp.arange(iters))
+        return out[0, 0, 0, 0]
+
+    @functools.partial(jax.jit, static_argnums=(3,))
+    def ulysses_device(q, k, v, iters):
+        # one device's work per step: full sequence, H/sp heads
+        def one(acc, _):
+            o, _ = flash_attention_fwd(q, k, v, causal=True)
+            return acc + o.astype(jnp.float32), None
+        acc0 = jnp.zeros((B, T, H // sp, D), jnp.float32)
+        out, _ = jax.lax.scan(one, acc0, jnp.arange(iters))
+        return out[0, 0, 0, 0]
+
+    iters = 20
+    qr, kr, vr = mk(H, T // sp)
+    qu, ku, vu = mk(H // sp, T)
+    for name, fn, args in (
+        ("ring", ring_device, (qr, kr, vr)),
+        ("ulysses", ulysses_device, (qu, ku, vu)),
+    ):
+        # warm up the SAME static-iters executable the timer runs —
+        # iters is a static argnum, a different value would compile a
+        # fresh program inside the timed region
+        float(fn(*args, iters))
+        t0 = time.perf_counter()
+        float(fn(*args, iters))
+        results[f"sp_{name}_attn_ms"] = round(
+            (time.perf_counter() - t0) / iters * 1e3, 2
+        )
+    results["sp_compare_note"] = (
+        f"per-device flash-attention compute at seq {T}, sp={sp}, "
+        f"H={H}, D={D}, bf16: ring = {sp} chunked kernel calls "
+        "(comm overlaps), ulysses = 1 full-seq call on H/sp heads "
+        "(+2 all-to-alls moving act_bytes/sp per device over ICI)"
+    )
 
 
 def run_mfu_big(jax, results: dict):
@@ -398,6 +624,10 @@ def run_mfu_big(jax, results: dict):
     g = zeros_g(params)
     opt_iters = 10
     p3, o3 = apply_probe(params, opt, g)
+    # force the warmup's device execution BEFORE the timer (pitfall 1)
+    float(
+        jax.tree_util.tree_leaves(p3)[0].reshape(-1)[0].astype("float32")
+    )
     t0 = time.perf_counter()
     for _ in range(opt_iters):
         p3, o3 = apply_probe(p3, o3, g)
@@ -579,6 +809,16 @@ def main() -> int:
     except Exception as e:
         results["stage_MBps"] = None
         results["staging_error"] = repr(e)
+    try:
+        run_goodput_124m(jax, results)
+    except Exception as e:
+        results["goodput_124m_window_pct"] = None
+        results["goodput_124m_error"] = repr(e)
+    try:
+        run_sp_compare(jax, results)
+    except Exception as e:
+        results["sp_ring_attn_ms"] = None
+        results["sp_compare_error"] = repr(e)
     try:
         run_mfu(jax, results)
     except Exception as e:

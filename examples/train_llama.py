@@ -23,7 +23,12 @@ from dlrover_tpu.trainer.elastic.trainer import (
 
 def llama_small():
     """A ~110M Llama-shaped model (same switches as 7B, scaled down) —
-    swap for ``llama2_7b()`` on a pod slice."""
+    swap for ``llama2_7b()`` on a pod slice. ``scan_layers`` stores the
+    blocks stacked under one ``lax.scan``: the compiled graph is O(1)
+    in depth, which is what lets DEEP configs (32-48+ layers) compile
+    WITH activation checkpointing (``remat=True``) — the reference's
+    headline Llama-2 numbers are exactly this FSDP+checkpointing
+    combination (atorch/examples/llama2/README.md:398)."""
     return replace(
         llama2_7b(),
         num_layers=12,
@@ -32,6 +37,7 @@ def llama_small():
         num_kv_heads=4,   # grouped-query attention
         mlp_dim=2048,
         max_seq_len=1024,
+        scan_layers=True,
     )
 
 

@@ -198,67 +198,73 @@ def _norm(x, p, cfg: TransformerConfig):
     return y.astype(x.dtype)
 
 
-def _rope(x, positions, theta: float):
-    """x: [B,T,H,D]; rotate pairs (d, d+D/2)."""
-    B, T, H, D = x.shape
-    half = D // 2
+def _rope(x, positions, theta: float, layout: str = "bthd"):
+    """Rotate pairs (d, d+D/2). x: [B,T,H,D] or [B,H,T,D] per layout."""
+    half = x.shape[-1] // 2
     freqs = 1.0 / (
         theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
     )
     ang = positions[:, :, None].astype(jnp.float32) * freqs  # [B,T,half]
-    cos = jnp.cos(ang)[:, :, None, :]
-    sin = jnp.sin(ang)[:, :, None, :]
+    if layout == "bhtd":
+        cos = jnp.cos(ang)[:, None, :, :]
+        sin = jnp.sin(ang)[:, None, :, :]
+    else:
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
     ).astype(x.dtype)
 
 
-def _causal_attention(q, k, v):
-    """Single-shard causal attention. [B,T,H,D].
+def _causal_attention(q, k, v, layout: str = "bthd"):
+    """Single-shard causal attention, [B,T,H,D] or [B,H,T,D].
 
-    Dispatches to the Pallas flash-attention kernel on TPU (block-tiled,
-    O(T) HBM traffic) and the materialized-score jnp path elsewhere —
+    Dispatches to the Pallas flash-attention kernel on TPU (fused
+    single-program kernels at short seq, block-tiled streaming beyond)
+    and the materialized-score jnp path elsewhere —
     ops/flash_attention.py owns both and their shared numerics.
     """
     from dlrover_tpu.ops.flash_attention import flash_attention
 
-    return flash_attention(q, k, v, causal=True)
+    return flash_attention(q, k, v, causal=True, layout=layout)
 
 
 def _attention_block(x, layer, cfg: TransformerConfig, mesh, positions):
     h = _norm(x, layer["attn_norm"], cfg)
-    q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"].astype(h.dtype))
-    k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"].astype(h.dtype))
-    v = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wv"].astype(h.dtype))
+    sp = mesh is not None and mesh.shape.get("sp", 1) > 1
+    # single-shard path: kernel-native [B,H,T,D] straight from the
+    # projection einsums — no relayout transposes around the attention
+    # kernel. SP schemes shard/permute the seq dim and keep [B,T,H,D].
+    layout = "bthd" if sp else "bhtd"
+    proj = "btd,dhk->bthk" if sp else "btd,dhk->bhtk"
+    q = jnp.einsum(proj, h, layer["attn"]["wq"].astype(h.dtype))
+    k = jnp.einsum(proj, h, layer["attn"]["wk"].astype(h.dtype))
+    v = jnp.einsum(proj, h, layer["attn"]["wv"].astype(h.dtype))
     if cfg.rope:
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q = _rope(q, positions, cfg.rope_theta, layout)
+        k = _rope(k, positions, cfg.rope_theta, layout)
     if cfg.mup_attn_scale is not None:
         # muP 1/d attention: fold the deviation from the kernels' builtin
         # 1/sqrt(d) into q, so flash and ring paths need no new plumbing
         q = q * (cfg.mup_attn_scale * cfg.head_dim**0.5)
-    if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        if cfg.sp_scheme == "ulysses":
-            from dlrover_tpu.parallel.ulysses import (
-                ulysses_self_attention,
-            )
+    if not sp:
+        o = _causal_attention(q, k, v, layout="bhtd")
+    elif cfg.sp_scheme == "ulysses":
+        from dlrover_tpu.parallel.ulysses import ulysses_self_attention
 
-            o = ulysses_self_attention(q, k, v, mesh, causal=True)
-        elif cfg.sp_scheme == "ring":
-            o = ring_self_attention(q, k, v, mesh, causal=True)
-        else:
-            # a typo silently running the OTHER scheme would make every
-            # perf comparison quietly wrong
-            raise ValueError(
-                f"unknown sp_scheme {cfg.sp_scheme!r} "
-                "(expected 'ring' or 'ulysses')"
-            )
+        o = ulysses_self_attention(q, k, v, mesh, causal=True)
+    elif cfg.sp_scheme == "ring":
+        o = ring_self_attention(q, k, v, mesh, causal=True)
     else:
-        o = _causal_attention(q, k, v)
-    return x + jnp.einsum(
-        "bthk,hkd->btd", o, layer["attn"]["wo"].astype(o.dtype)
-    )
+        # a typo silently running the OTHER scheme would make every
+        # perf comparison quietly wrong
+        raise ValueError(
+            f"unknown sp_scheme {cfg.sp_scheme!r} "
+            "(expected 'ring' or 'ulysses')"
+        )
+    out = "bthk,hkd->btd" if sp else "bhtk,hkd->btd"
+    return x + jnp.einsum(out, o, layer["attn"]["wo"].astype(o.dtype))
 
 
 def _zero_aux():

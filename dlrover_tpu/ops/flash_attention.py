@@ -22,6 +22,19 @@ Design:
 - On non-TPU backends it dispatches to ``flash_attention_reference`` —
   identical math, pure jnp — so CPU tests are fast; the kernels
   themselves are tested under ``interpret=True``.
+- **Short-sequence fused kernels**: when the [T, T] score tile fits
+  VMEM (T <= 1024, measured crossover), the streaming form is pure
+  overhead — at seq 512 / head_dim 64 the MXU work per program is tiny,
+  so grid count, online-softmax rescaling passes, and the backward's
+  double (s, p, dp) recompute dominate. The fused path runs one program
+  per (batch element, head chunk) with a python-unrolled head loop,
+  single-pass softmax, and ONE backward kernel computing s/p/dp once
+  and emitting dq/dk/dv together (5 matmuls vs the streaming split's
+  7). Programs cover head CHUNKS sized so the unrolled per-head [T,T]
+  f32 temporaries stay within scoped VMEM (``_head_chunk``).
+- ``layout="bhtd"`` lets callers hand over kernel-native [B, H, T, D]
+  tensors (the model emits them straight from its QKV einsums), skipping
+  the 25 MB-per-tensor relayout transposes on every call.
 
 Mask contract: ``mask_fn(q_pos, k_pos)`` receives broadcastable int32
 position arrays (shapes ``[bq, 1]`` and ``[1, bk]``) and must return an
@@ -149,16 +162,34 @@ def _fwd_pallas(
     block_q,
     block_k,
     interpret,
+    layout="bthd",
+    allow_fused=True,
 ):
     # Kernel layout is [B, H, T, D]: TPU tiling needs the last two block
     # dims to be (seq_block, head_dim) — (8,128)-aligned or full-size.
-    B, Tq, H, D = q.shape
-    Tk, Hkv = k.shape[1], k.shape[2]
+    # ``layout="bhtd"`` callers hand kernel-native tensors (no relayout).
+    if layout == "bhtd":
+        B, H, Tq, D = q.shape
+        Hkv, Tk = k.shape[1], k.shape[2]
+        qt, kt, vt = q, k, v
+    else:
+        B, Tq, H, D = q.shape
+        Tk, Hkv = k.shape[1], k.shape[2]
+        qt = q.transpose(0, 2, 1, 3)
+        kt = k.transpose(0, 2, 1, 3)
+        vt = v.transpose(0, 2, 1, 3)
     nq, nk = Tq // block_q, Tk // block_k
     group = H // Hkv
-    qt = q.transpose(0, 2, 1, 3)
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
+
+    if allow_fused and _fused_eligible(qt.shape, kt.shape, "bhtd"):
+        ot, lse4 = _fused_fwd_call(
+            qt, kt, vt, offsets,
+            causal=causal, mask_fn=mask_fn, sm_scale=sm_scale,
+            interpret=interpret,
+        )
+        if layout == "bhtd":
+            return ot, lse4[..., 0]
+        return ot.transpose(0, 2, 1, 3), lse4[..., 0]
 
     kernel = functools.partial(
         _fwd_kernel,
@@ -207,7 +238,248 @@ def _fwd_pallas(
         ),
         interpret=interpret,
     )(offsets, qt, kt, vt)
+    if layout == "bhtd":
+        return ot, lse4[..., 0]
     return ot.transpose(0, 2, 1, 3), lse4[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# fused short-sequence kernels (one program per batch element)
+# ---------------------------------------------------------------------------
+# Eligibility: the [T, T] f32 score tile must fit scoped VMEM (see
+# _head_chunk, which sizes head chunks against a 24 MB live-set budget
+# under the raised _FUSED_VMEM_LIMIT). At T=2048 a single head's
+# backward live set (~3.5 x 16 MB) no longer fits; the streaming
+# kernels take over there.
+_FUSED_MAX_T = 1024
+
+
+def _fused_eligible(q_shape, k_shape, layout: str) -> bool:
+    if layout == "bhtd":
+        B, H, Tq, D = q_shape
+        Hkv, Tk = k_shape[1], k_shape[2]
+    else:
+        B, Tq, H, D = q_shape
+        Tk, Hkv = k_shape[1], k_shape[2]
+    return Tq == Tk and Tq <= _FUSED_MAX_T and H == Hkv
+
+
+def _fused_fwd_kernel(
+    off_ref,  # SMEM [2]
+    q_ref,  # VMEM [1, Hc, T, D]
+    k_ref,
+    v_ref,
+    o_ref,  # VMEM [1, Hc, T, D]
+    lse_ref,  # VMEM [1, Hc, T, 1]
+    *,
+    causal: bool,
+    mask_fn: Optional[MaskFn],
+    sm_scale: float,
+    n_heads: int,
+):
+    T = q_ref.shape[2]
+
+    def _compute():
+        q_pos = off_ref[0] + lax.broadcasted_iota(jnp.int32, (T, 1), 0)
+        k_pos = off_ref[1] + lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        mask = _mask_for_block(q_pos, k_pos, causal, mask_fn)
+        # static unroll: one [T,T] live set at a time
+        for h in range(n_heads):
+            q = q_ref[0, h]
+            k = k_ref[0, h]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            s = s * sm_scale
+            if mask is not None:
+                s = jnp.where(mask, s, NEG_INF)
+            m = jnp.max(s, axis=-1, keepdims=True)  # [T, 1]
+            m_safe = jnp.where(m > NEG_INF * 0.5, m, 0.0)
+            p = jnp.exp(s - m_safe)
+            l = jnp.sum(p, axis=-1, keepdims=True)
+            acc = jax.lax.dot_general(
+                p, v_ref[0, h], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            safe_l = jnp.where(l > 0.0, l, 1.0)
+            o_ref[0, h] = (acc / safe_l).astype(o_ref.dtype)
+            lse_ref[0, h] = jnp.where(
+                l > 0.0, m_safe + jnp.log(safe_l), NEG_INF
+            )
+
+    if causal and mask_fn is None:
+        # whole-program causal skip: ring attention's fully-future KV
+        # hops (k_offset past every query) stay near-free, as in the
+        # streaming kernel's per-block pl.when gate
+        visible = off_ref[0] + T - 1 >= off_ref[1]
+
+        @pl.when(jnp.logical_not(visible))
+        def _skip():
+            o_ref[0] = jnp.zeros_like(o_ref[0])
+            lse_ref[0] = jnp.full_like(lse_ref[0], NEG_INF)
+
+        pl.when(visible)(_compute)
+    else:
+        _compute()
+
+
+def _fused_bwd_kernel(
+    off_ref,  # SMEM [2]
+    q_ref,  # VMEM [1, H, T, D]
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,  # VMEM [1, H, T, 1]
+    delta_ref,
+    dq_ref,  # out [1, H, T, D]
+    dk_ref,
+    dv_ref,
+    *,
+    causal: bool,
+    mask_fn: Optional[MaskFn],
+    sm_scale: float,
+    n_heads: int,
+):
+    """One pass per head: s and p computed ONCE, then the three grad
+    matmuls — the streaming FA2 split recomputes (s, p, dp) in both its
+    dq and dk/dv kernels (7 matmuls/head vs 5 here)."""
+    T = q_ref.shape[2]
+
+    def _compute():
+        q_pos = off_ref[0] + lax.broadcasted_iota(jnp.int32, (T, 1), 0)
+        k_pos = off_ref[1] + lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        mask = _mask_for_block(q_pos, k_pos, causal, mask_fn)
+        for h in range(n_heads):
+            q = q_ref[0, h]
+            k = k_ref[0, h]
+            s = (
+                jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * sm_scale
+            )
+            if mask is not None:
+                s = jnp.where(mask, s, NEG_INF)
+            lse = lse_ref[0, h]  # [T, 1]
+            row_valid = lse > NEG_INF * 0.5
+            p = jnp.where(row_valid, jnp.exp(s - lse), 0.0)  # [T, T]
+            do = do_ref[0, h].astype(jnp.float32)
+            # dv = p^T @ do
+            dv_ref[0, h] = jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(dv_ref.dtype)
+            dp = jax.lax.dot_general(
+                do, v_ref[0, h], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_ref[0, h]) * sm_scale  # [T, T]
+            dq_ref[0, h] = jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(dq_ref.dtype)
+            # dk = ds^T @ q
+            dk_ref[0, h] = jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(dk_ref.dtype)
+
+
+    if causal and mask_fn is None:
+        # mirror of the forward's whole-program causal skip
+        visible = off_ref[0] + T - 1 >= off_ref[1]
+
+        @pl.when(jnp.logical_not(visible))
+        def _skip():
+            dq_ref[0] = jnp.zeros_like(dq_ref[0])
+            dk_ref[0] = jnp.zeros_like(dk_ref[0])
+            dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+        pl.when(visible)(_compute)
+    else:
+        _compute()
+
+def _head_chunk(H: int, T: int, live_f32_per_head: float) -> int:
+    """Heads per program: the unrolled head loop's [T, T] f32 temporaries
+    occupy scoped VMEM stack; chunk so ``Hc * live set`` stays under a
+    conservative budget (the raised ``vmem_limit_bytes`` leaves slack for
+    the compiler's own scheduling)."""
+    budget = 24 * 1024 * 1024
+    per_head = live_f32_per_head * T * T * 4
+    best = 1
+    for d in range(1, H + 1):
+        if H % d == 0 and d * per_head <= budget:
+            best = d
+    return best
+
+
+_FUSED_VMEM_LIMIT = 100 * 1024 * 1024
+
+
+def _fused_fwd_call(qt, kt, vt, offsets, *, causal, mask_fn, sm_scale,
+                    interpret):
+    """[B,H,T,D] in -> (o [B,H,T,D], lse4 [B,H,T,1])."""
+    B, H, T, D = qt.shape
+    Hc = _head_chunk(H, T, live_f32_per_head=2.5)
+    spec = pl.BlockSpec((1, Hc, T, D), lambda b, hc: (b, hc, 0, 0))
+    row_spec = pl.BlockSpec((1, Hc, T, 1), lambda b, hc: (b, hc, 0, 0))
+    return pl.pallas_call(
+        functools.partial(
+            _fused_fwd_kernel,
+            causal=causal,
+            mask_fn=mask_fn,
+            sm_scale=sm_scale,
+            n_heads=Hc,
+        ),
+        grid=(B, H // Hc),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), spec, spec, spec],
+        out_specs=[spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, D), qt.dtype),
+            jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+            vmem_limit_bytes=_FUSED_VMEM_LIMIT,
+        ),
+        interpret=interpret,
+    )(offsets, qt, kt, vt)
+
+
+def _fused_bwd_call(qt, kt, vt, dot, lse4, delta4, offsets, *, causal,
+                    mask_fn, sm_scale, interpret):
+    """[B,H,T,D] in -> (dq, dk, dv) each [B,H,T,D] (q dtype)."""
+    B, H, T, D = qt.shape
+    Hc = _head_chunk(H, T, live_f32_per_head=3.5)
+    spec = pl.BlockSpec((1, Hc, T, D), lambda b, hc: (b, hc, 0, 0))
+    row_spec = pl.BlockSpec((1, Hc, T, 1), lambda b, hc: (b, hc, 0, 0))
+    return pl.pallas_call(
+        functools.partial(
+            _fused_bwd_kernel,
+            causal=causal,
+            mask_fn=mask_fn,
+            sm_scale=sm_scale,
+            n_heads=Hc,
+        ),
+        grid=(B, H // Hc),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            spec, spec, spec, spec, row_spec, row_spec,
+        ],
+        out_specs=[spec, spec, spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, D), qt.dtype),
+            jax.ShapeDtypeStruct((B, H, T, D), qt.dtype),
+            jax.ShapeDtypeStruct((B, H, T, D), qt.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+            vmem_limit_bytes=_FUSED_VMEM_LIMIT,
+        ),
+        interpret=interpret,
+    )(offsets, qt, kt, vt, dot, lse4, delta4)
 
 
 # ---------------------------------------------------------------------------
@@ -388,24 +660,49 @@ def _bwd_pallas(
     block_q,
     block_k,
     interpret,
+    layout="bthd",
+    allow_fused=True,
 ):
-    B, Tq, H, D = q.shape
-    Tk, Hkv = k.shape[1], k.shape[2]
+    if layout == "bhtd":
+        B, H, Tq, D = q.shape
+        Hkv, Tk = k.shape[1], k.shape[2]
+        qt, kt, vt, dot = q, k, v, do
+        delta = jnp.einsum(
+            "bhqd,bhqd->bhq",
+            do.astype(jnp.float32),
+            o.astype(jnp.float32),
+        )
+    else:
+        B, Tq, H, D = q.shape
+        Tk, Hkv = k.shape[1], k.shape[2]
+        qt = q.transpose(0, 2, 1, 3)  # [B,H,T,D] kernel layout
+        kt = k.transpose(0, 2, 1, 3)
+        vt = v.transpose(0, 2, 1, 3)
+        dot = do.transpose(0, 2, 1, 3)
+        # delta_i = rowsum(do_i * o_i) — bandwidth-bound, XLA fuses it
+        delta = jnp.einsum(
+            "bqhd,bqhd->bhq",
+            do.astype(jnp.float32),
+            o.astype(jnp.float32),
+        )
     nq, nk = Tq // block_q, Tk // block_k
     group = H // Hkv
-    qt = q.transpose(0, 2, 1, 3)  # [B,H,T,D] kernel layout
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
-    dot = do.transpose(0, 2, 1, 3)
-
-    # delta_i = rowsum(do_i * o_i) — bandwidth-bound, XLA fuses it
-    delta = jnp.einsum(
-        "bqhd,bqhd->bhq",
-        do.astype(jnp.float32),
-        o.astype(jnp.float32),
-    )
     delta4 = delta[..., None]  # [B,H,Tq,1]
     lse4 = lse[..., None]
+
+    if allow_fused and _fused_eligible(qt.shape, kt.shape, "bhtd"):
+        dqt, dkt, dvt = _fused_bwd_call(
+            qt, kt, vt, dot, lse4, delta4, offsets,
+            causal=causal, mask_fn=mask_fn, sm_scale=sm_scale,
+            interpret=interpret,
+        )
+        if layout == "bhtd":
+            return dqt, dkt.astype(k.dtype), dvt.astype(v.dtype)
+        return (
+            dqt.transpose(0, 2, 1, 3),
+            dkt.transpose(0, 2, 1, 3).astype(k.dtype),
+            dvt.transpose(0, 2, 1, 3).astype(v.dtype),
+        )
 
     common = dict(
         causal=causal,
@@ -494,6 +791,13 @@ def _bwd_pallas(
         interpret=interpret,
     )(offsets, qt, kt, vt, dot, lse4, delta4)
 
+    if layout == "bhtd":
+        if group > 1:
+            dk = dk_full.reshape(B, Hkv, group, Tk, D).sum(2)
+            dv = dv_full.reshape(B, Hkv, group, Tk, D).sum(2)
+        else:
+            dk, dv = dk_full, dv_full
+        return dqt, dk.astype(k.dtype), dv.astype(v.dtype)
     dq = dqt.transpose(0, 2, 1, 3)
     dk_t = dk_full.transpose(0, 2, 1, 3)  # [B,Tk,H,D]
     dv_t = dv_full.transpose(0, 2, 1, 3)
@@ -512,9 +816,12 @@ def _bwd_pallas(
 # *traced* offsets (ring attention's per-hop global positions) use the raw
 # ``flash_attention_fwd``/``flash_attention_bwd`` pair and define their own
 # VJP at the ring level, where the lse residual's gradient is handled.
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
+)
 def _flash_pallas(
-    q, k, v, offsets, causal, mask_fn, sm_scale, block_q, block_k
+    q, k, v, offsets, causal, mask_fn, sm_scale, block_q, block_k, layout,
+    allow_fused,
 ):
     o, _ = _fwd_pallas(
         q,
@@ -527,12 +834,15 @@ def _flash_pallas(
         block_q=block_q,
         block_k=block_k,
         interpret=_interpret_default(),
+        layout=layout,
+        allow_fused=allow_fused,
     )
     return o
 
 
 def _flash_fwd_rule(
-    q, k, v, offsets, causal, mask_fn, sm_scale, block_q, block_k
+    q, k, v, offsets, causal, mask_fn, sm_scale, block_q, block_k, layout,
+    allow_fused,
 ):
     o, lse = _fwd_pallas(
         q,
@@ -545,12 +855,15 @@ def _flash_fwd_rule(
         block_q=block_q,
         block_k=block_k,
         interpret=_interpret_default(),
+        layout=layout,
+        allow_fused=allow_fused,
     )
     return o, (q, k, v, o, lse)
 
 
 def _flash_bwd_rule(
-    offsets, causal, mask_fn, sm_scale, block_q, block_k, res, do
+    offsets, causal, mask_fn, sm_scale, block_q, block_k, layout,
+    allow_fused, res, do,
 ):
     q, k, v, o, lse = res
     dq, dk, dv = _bwd_pallas(
@@ -567,6 +880,8 @@ def _flash_bwd_rule(
         block_q=block_q,
         block_k=block_k,
         interpret=_interpret_default(),
+        layout=layout,
+        allow_fused=allow_fused,
     )
     return dq, dk, dv
 
@@ -594,10 +909,16 @@ def flash_attention_fwd(
     block_q=512,
     block_k=512,
     interpret=None,
+    layout="bthd",
+    allow_fused=True,
 ):
-    """Forward kernel; returns ``(o, lse)`` with lse ``[B,H,Tq]`` f32."""
+    """Forward kernel; returns ``(o, lse)`` with lse ``[B,H,Tq]`` f32.
+
+    ``allow_fused=False`` pins the streaming (block-tiled) kernels even
+    when the fused short-seq form is eligible — for tests and A/B
+    timing."""
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
-    bq, bk = _validate_blocks(q, k, block_q, block_k)
+    bq, bk = _validate_blocks(q, k, block_q, block_k, layout)
     return _fwd_pallas(
         q,
         k,
@@ -609,6 +930,8 @@ def flash_attention_fwd(
         block_q=bq,
         block_k=bk,
         interpret=_interpret_default() if interpret is None else interpret,
+        layout=layout,
+        allow_fused=allow_fused,
     )
 
 
@@ -628,10 +951,12 @@ def flash_attention_bwd(
     block_q=512,
     block_k=512,
     interpret=None,
+    layout="bthd",
+    allow_fused=True,
 ):
     """Backward kernels; returns ``(dq, dk, dv)`` given saved residuals."""
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
-    bq, bk = _validate_blocks(q, k, block_q, block_k)
+    bq, bk = _validate_blocks(q, k, block_q, block_k, layout)
     return _bwd_pallas(
         q,
         k,
@@ -646,11 +971,14 @@ def flash_attention_bwd(
         block_q=bq,
         block_k=bk,
         interpret=_interpret_default() if interpret is None else interpret,
+        layout=layout,
+        allow_fused=allow_fused,
     )
 
 
-def _validate_blocks(q, k, block_q, block_k):
-    Tq, Tk = q.shape[1], k.shape[1]
+def _validate_blocks(q, k, block_q, block_k, layout="bthd"):
+    seq_axis = 2 if layout == "bhtd" else 1
+    Tq, Tk = q.shape[seq_axis], k.shape[seq_axis]
     bq, bk = min(block_q, Tq), min(block_k, Tk)
     if Tq % bq or Tk % bk or bq % 8 or bk % 8:
         # TPU sublane tiling wants 8-aligned seq blocks; the public entry
@@ -730,8 +1058,12 @@ def flash_attention(
     block_k: int = 512,
     return_residuals: bool = False,
     force: Optional[str] = None,
+    layout: str = "bthd",
+    allow_fused: bool = True,
 ):
-    """Flash attention over ``q:[B,Tq,H,D] k,v:[B,Tk,Hkv,D]``.
+    """Flash attention over ``q:[B,Tq,H,D] k,v:[B,Tk,Hkv,D]`` (or the
+    kernel-native ``[B,H,T,D]`` with ``layout="bhtd"`` — no relayout
+    transposes; the model's QKV einsums emit this directly).
 
     ``q_offset``/``k_offset`` are global position offsets (scalars, may be
     traced) so a caller holding one ring hop's KV block can evaluate the
@@ -751,7 +1083,10 @@ def flash_attention(
     if mode is None:
         mode = "pallas" if jax.default_backend() == "tpu" else "reference"
     if mode == "reference":
-        return flash_attention_reference(
+        # one reference call site: bhtd just transposes around it
+        if layout == "bhtd":
+            q, k, v = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        r = flash_attention_reference(
             q,
             k,
             v,
@@ -762,15 +1097,20 @@ def flash_attention(
             k_offset=k_offset,
             return_residuals=return_residuals,
         )
+        if layout != "bhtd":
+            return r
+        if return_residuals:
+            return r[0].transpose(0, 2, 1, 3), r[1]
+        return r.transpose(0, 2, 1, 3)
 
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     try:
-        bq, bk = _validate_blocks(q, k, block_q, block_k)
+        bq, bk = _validate_blocks(q, k, block_q, block_k, layout)
     except ValueError:
         if force is not None:
             raise
         # odd sequence length: the jnp path has no tiling constraint
-        return flash_attention_reference(
+        return flash_attention(
             q,
             k,
             v,
@@ -780,6 +1120,8 @@ def flash_attention(
             q_offset=q_offset,
             k_offset=k_offset,
             return_residuals=return_residuals,
+            force="reference",
+            layout=layout,
         )
     if return_residuals:
         # raw forward — callers own the VJP (e.g. the ring merge)
@@ -794,6 +1136,8 @@ def flash_attention(
             k_offset=k_offset,
             block_q=bq,
             block_k=bk,
+            layout=layout,
+            allow_fused=allow_fused,
         )
     if not isinstance(q_offset, int) or not isinstance(k_offset, int):
         raise ValueError(
@@ -801,5 +1145,6 @@ def flash_attention(
             "use flash_attention_fwd/_bwd for traced offsets"
         )
     return _flash_pallas(
-        q, k, v, (q_offset, k_offset), causal, mask_fn, scale, bq, bk
+        q, k, v, (q_offset, k_offset), causal, mask_fn, scale, bq, bk,
+        layout, allow_fused
     )

@@ -37,12 +37,17 @@ def _qkv(B=2, T=128, H=4, Hkv=4, D=32, seed=0, dtype=jnp.float32):
 
 
 class TestFlashAttention:
+    # fused=True exercises the short-seq fused kernels (these shapes are
+    # eligible); fused=False pins the streaming block-tiled kernels so
+    # they keep coverage at non-GQA shapes too
+    @pytest.mark.parametrize("fused", [True, False])
     @pytest.mark.parametrize("causal", [True, False])
-    def test_forward_matches_reference(self, causal):
+    def test_forward_matches_reference(self, causal, fused):
         q, k, v = _qkv()
         ref = flash_attention_reference(q, k, v, causal=causal)
         out = flash_attention(
-            q, k, v, causal=causal, force="pallas", block_q=64, block_k=64
+            q, k, v, causal=causal, force="pallas", block_q=64,
+            block_k=64, allow_fused=fused,
         )
         np.testing.assert_allclose(out, ref, atol=2e-5)
 
@@ -52,13 +57,15 @@ class TestFlashAttention:
         out = flash_attention(q, k, v, force="pallas", block_q=64)
         np.testing.assert_allclose(out, ref, atol=2e-5)
 
-    def test_custom_mask(self):
+    @pytest.mark.parametrize("fused", [True, False])
+    def test_custom_mask(self, fused):
         # sliding-window mask (positions within 32 of the query)
         win = lambda qp, kp: (qp >= kp) & (qp - kp < 32)  # noqa: E731
         q, k, v = _qkv()
         ref = flash_attention_reference(q, k, v, causal=True, mask_fn=win)
         out = flash_attention(
-            q, k, v, causal=True, mask_fn=win, force="pallas", block_q=64
+            q, k, v, causal=True, mask_fn=win, force="pallas",
+            block_q=64, allow_fused=fused,
         )
         np.testing.assert_allclose(out, ref, atol=2e-5)
 
@@ -78,11 +85,13 @@ class TestFlashAttention:
         for a, b in zip(gp, gr):
             np.testing.assert_allclose(a, b, atol=5e-4)
 
-    def test_offsets_shift_causal_mask(self):
+    @pytest.mark.parametrize("fused", [True, False])
+    def test_offsets_shift_causal_mask(self, fused):
         # kernel with k_offset sees keys as "earlier" -> full visibility
         q, k, v = _qkv(T=64)
         o1, lse1 = flash_attention_fwd(
-            q, k, v, causal=True, q_offset=64, k_offset=0, block_q=64
+            q, k, v, causal=True, q_offset=64, k_offset=0, block_q=64,
+            allow_fused=fused,
         )
         ref = flash_attention_reference(
             q, k, v, causal=True, q_offset=64, k_offset=0
@@ -91,7 +100,8 @@ class TestFlashAttention:
         # and bwd runs with the same offsets
         do = jnp.ones_like(o1)
         dq, dk, dv = flash_attention_bwd(
-            q, k, v, o1, lse1, do, causal=True, q_offset=64, k_offset=0
+            q, k, v, o1, lse1, do, causal=True, q_offset=64, k_offset=0,
+            allow_fused=fused,
         )
         assert dq.shape == q.shape and dk.shape == k.shape
 
@@ -130,6 +140,193 @@ class TestFlashAttention:
         out = flash_attention(q, k, v)  # auto mode: should not raise
         ref = flash_attention_reference(q, k, v)
         np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+class TestFusedShortSeq:
+    """The fused single-program kernels (T <= 1024, H == Hkv) vs the
+    streaming block-tiled kernels and the jnp reference."""
+
+    def test_dispatch_criteria(self):
+        from dlrover_tpu.ops.flash_attention import _fused_eligible
+
+        assert _fused_eligible((2, 128, 4, 32), (2, 128, 4, 32), "bthd")
+        assert _fused_eligible((2, 4, 128, 32), (2, 4, 128, 32), "bhtd")
+        # GQA -> streaming
+        assert not _fused_eligible((2, 128, 8, 32), (2, 128, 2, 32), "bthd")
+        # cross-attention shapes -> streaming
+        assert not _fused_eligible((2, 64, 4, 32), (2, 128, 4, 32), "bthd")
+        # long seq -> streaming
+        assert not _fused_eligible(
+            (2, 2048, 4, 32), (2, 2048, 4, 32), "bthd"
+        )
+
+    def test_fwd_matches_streaming(self):
+        q, k, v = _qkv()
+        of, lf = flash_attention_fwd(q, k, v, causal=True, block_q=64)
+        os_, ls = flash_attention_fwd(
+            q, k, v, causal=True, block_q=64, allow_fused=False
+        )
+        np.testing.assert_allclose(of, os_, atol=2e-5)
+        np.testing.assert_allclose(lf, ls, atol=2e-5)
+
+    def test_bwd_matches_streaming(self):
+        q, k, v = _qkv()
+        o, lse = flash_attention_fwd(q, k, v, causal=True, block_q=64)
+        rng = np.random.default_rng(7)
+        do = jnp.asarray(rng.normal(size=o.shape), o.dtype)
+        gf = flash_attention_bwd(q, k, v, o, lse, do, causal=True)
+        gs = flash_attention_bwd(
+            q, k, v, o, lse, do, causal=True, allow_fused=False
+        )
+        for a, b in zip(gf, gs):
+            np.testing.assert_allclose(a, b, atol=5e-4)
+
+    def test_grads_match_reference(self):
+        # H == Hkv: the custom-vjp path dispatches to the fused kernels
+        q, k, v = _qkv(T=128, H=4, Hkv=4)
+
+        def lp(q, k, v):
+            return (flash_attention(q, k, v, force="pallas") ** 2).sum()
+
+        def lr(q, k, v):
+            return (flash_attention_reference(q, k, v) ** 2).sum()
+
+        gp = jax.grad(lp, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(a, b, atol=5e-4)
+
+    def test_bhtd_layout_matches_bthd(self):
+        q, k, v = _qkv()
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+
+        def lt(qt, kt, vt):
+            o = flash_attention(
+                qt, kt, vt, force="pallas", layout="bhtd"
+            )
+            return (o**2).sum()
+
+        def lb(q, k, v):
+            return (flash_attention(q, k, v, force="pallas") ** 2).sum()
+
+        o_t = flash_attention(qt, kt, vt, force="pallas", layout="bhtd")
+        o_b = flash_attention(q, k, v, force="pallas")
+        np.testing.assert_allclose(
+            o_t.transpose(0, 2, 1, 3), o_b, atol=2e-5
+        )
+        gt = jax.grad(lt, argnums=(0, 1, 2))(qt, kt, vt)
+        gb = jax.grad(lb, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gt, gb):
+            np.testing.assert_allclose(
+                a.transpose(0, 2, 1, 3), b, atol=5e-4
+            )
+
+    def test_custom_mask_and_masked_rows(self):
+        # sliding window AND fully-blind early rows through the fused
+        # backward (regression guard mirroring the streaming-path test)
+        blind = lambda qp, kp: (qp >= kp) & (qp >= 64)  # noqa: E731
+        q, k, v = _qkv(T=128)
+
+        def lp(q, k, v):
+            return (
+                flash_attention(q, k, v, mask_fn=blind, force="pallas")
+                ** 2
+            ).sum()
+
+        def lr(q, k, v):
+            return (
+                flash_attention_reference(q, k, v, mask_fn=blind) ** 2
+            ).sum()
+
+        out = flash_attention(q, k, v, mask_fn=blind, force="pallas")
+        assert float(jnp.abs(out[:, :64]).max()) == 0.0
+        gp = jax.grad(lp, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+        assert float(jnp.abs(gp[0][:, :64]).max()) == 0.0
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(a, b, atol=5e-4)
+
+    def test_offsets(self):
+        q, k, v = _qkv(T=64)
+        o, lse = flash_attention_fwd(
+            q, k, v, causal=True, q_offset=64, k_offset=0
+        )
+        ref = flash_attention_reference(
+            q, k, v, causal=True, q_offset=64, k_offset=0
+        )
+        np.testing.assert_allclose(o, ref, atol=2e-5)
+
+    def test_causal_skip_fully_future_kv(self):
+        # a ring hop whose KV block is entirely in the future: output 0,
+        # lse NEG_INF, zero grads — via the fused whole-program skip
+        from dlrover_tpu.ops.flash_attention import NEG_INF
+
+        q, k, v = _qkv(T=64)
+        o, lse = flash_attention_fwd(
+            q, k, v, causal=True, q_offset=0, k_offset=64
+        )
+        assert float(jnp.abs(o).max()) == 0.0
+        assert float(lse.max()) == float(np.float32(NEG_INF))
+        do = jnp.ones_like(o)
+        dq, dk, dv = flash_attention_bwd(
+            q, k, v, o, lse, do, causal=True, q_offset=0, k_offset=64
+        )
+        assert float(jnp.abs(dq).max()) == 0.0
+        assert float(jnp.abs(dk).max()) == 0.0
+
+    def test_streaming_masked_rows_via_public_entry(self):
+        # allow_fused=False pins the STREAMING kernels on fused-eligible
+        # shapes, keeping the original masked-row regression guard alive
+        # through the public differentiable entry
+        blind = lambda qp, kp: (qp >= kp) & (qp >= 64)  # noqa: E731
+        q, k, v = _qkv(T=128)
+
+        def lp(q, k, v):
+            return (
+                flash_attention(
+                    q, k, v, mask_fn=blind, force="pallas",
+                    block_q=64, allow_fused=False,
+                )
+                ** 2
+            ).sum()
+
+        def lr(q, k, v):
+            return (
+                flash_attention_reference(q, k, v, mask_fn=blind) ** 2
+            ).sum()
+
+        out = flash_attention(
+            q, k, v, mask_fn=blind, force="pallas", block_q=64,
+            allow_fused=False,
+        )
+        assert float(jnp.abs(out[:, :64]).max()) == 0.0
+        gp = jax.grad(lp, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+        assert float(jnp.abs(gp[0][:, :64]).max()) == 0.0
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(a, b, atol=5e-4)
+
+    def test_streaming_bhtd_gqa_grads(self):
+        # GQA + layout="bhtd" exercises the streaming backward's bhtd
+        # head-group reduction (reshape(B, Hkv, group, Tk, D).sum(2))
+        q, k, v = _qkv(T=128, H=8, Hkv=2)
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+
+        def lt(qt, kt, vt):
+            o = flash_attention(
+                qt, kt, vt, force="pallas", layout="bhtd"
+            )
+            return (o**2).sum()
+
+        def lr(q, k, v):
+            return (flash_attention_reference(q, k, v) ** 2).sum()
+
+        gt = jax.grad(lt, argnums=(0, 1, 2))(qt, kt, vt)
+        gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gt, gr):
+            np.testing.assert_allclose(
+                a.transpose(0, 2, 1, 3), b, atol=5e-4
+            )
 
 
 class TestKernelRing:

@@ -53,7 +53,7 @@ def test_forward_matches_unrolled():
     # same math, but the scanned body compiles as ONE specialization
     # where the unrolled path fuses per layer — last-ulp reassociation
     np.testing.assert_allclose(
-        np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-6
+        np.asarray(got), np.asarray(ref), rtol=1e-5, atol=5e-6
     )
 
 

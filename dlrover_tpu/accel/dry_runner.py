@@ -91,6 +91,13 @@ def _build(
     cfg = dc_replace(cfg, dtype=strategy.dtype, remat=strategy.remat)
     mesh = build_mesh(strategy.mesh, devices=devices)
     if strategy.mesh.pp > 1:
+        if strategy.offload_opt:
+            # a silently-ignored offload would let a run OOM while its
+            # strategy claims the state left HBM
+            raise ValueError(
+                "offload_opt is not supported on the pipeline (pp>1) "
+                "path: pipeline state keeps its own on-device layout"
+            )
         from dlrover_tpu.parallel.pipeline import (
             build_pipeline_train_step,
             init_pipeline_state,
@@ -132,14 +139,23 @@ def _build(
             state_shardings,
         )
 
+        shardings = state_shardings(
+            cfg, mesh, tx, offload_opt_state=strategy.offload_opt
+        )
         step_fn = build_train_step(
             cfg, mesh, tx, donate=donate,
             grad_accum=strategy.grad_accum,
+            offload_opt_state=strategy.offload_opt,
+            opt_shardings=(
+                shardings.opt_state if strategy.offload_opt else None
+            ),
         )
-        shardings = state_shardings(cfg, mesh, tx)
 
         def init_fn(key):
-            state, _ = init_sharded_state(key, cfg, mesh, tx)
+            state, _ = init_sharded_state(
+                key, cfg, mesh, tx,
+                offload_opt_state=strategy.offload_opt,
+            )
             return state
 
         def make_batch(batch, seq):

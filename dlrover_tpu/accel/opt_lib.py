@@ -12,6 +12,8 @@ transform of ``(TransformerConfig, Strategy)``:
 - ``remat``      — activation checkpointing (HBM <-> FLOPs trade)
 - ``bf16``/``fp32`` — compute dtype policy (AMP analog)
 - ``int8_mlp``   — int8 MXU matmuls in the MLP (FP8 analog)
+- ``offload_opt``— optimizer state in pinned-host memory (CPU-offload
+  Adam analog; ops/host_offload.py)
 - ``1f1b``       — 1F1B pipeline schedule instead of GPipe
 - ``interleaved``— interleaved 1F1B (virtual pipeline stages)
 
@@ -100,6 +102,10 @@ register_optimization(
 )
 register_optimization(
     "int8_mlp", lambda cfg, s: (dc_replace(cfg, int8_mlp=True), s)
+)
+register_optimization(
+    "offload_opt",
+    lambda cfg, s: (cfg, dc_replace(s, offload_opt=True)),
 )
 register_optimization(
     "1f1b", lambda cfg, s: (cfg, dc_replace(s, pp_schedule="1f1b"))

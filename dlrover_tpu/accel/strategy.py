@@ -32,6 +32,10 @@ class Strategy:
     # "gpipe", "1f1b", or "interleaved" (parallel/pipeline.py)
     pp_schedule: str = "gpipe"
     pp_virtual: int = 2  # chunks/device when pp_schedule == "interleaved"
+    # optimizer state lives in pinned-host memory between steps (the
+    # CPU-offload Adam analog — ops/host_offload.py); single-mesh path
+    # only (pp>1 keeps its own state layout on device)
+    offload_opt: bool = False
     # named optimization-library entries applied to this strategy
     # (accel/opt_lib.py re-derives the config from these on every host)
     opts: Tuple[str, ...] = ()
@@ -78,6 +82,8 @@ class Strategy:
             )
         if self.remat or "remat" in self.opts:
             bits.append("remat")
+        if self.offload_opt and "offload_opt" not in self.opts:
+            bits.append("offload_opt")
         bits.append(self.dtype)
         bits.extend(
             o

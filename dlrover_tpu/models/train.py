@@ -87,9 +87,12 @@ def opt_state_shardings(params_shape, p_sh, tx, mesh):
 
 
 def state_shardings(
-    cfg: TransformerConfig, mesh, tx, rules=None
+    cfg: TransformerConfig, mesh, tx, rules=None,
+    offload_opt_state: bool = False,
 ) -> TrainState:
-    """Shardings for the whole TrainState."""
+    """Shardings for the whole TrainState. ``offload_opt_state`` swaps
+    the optimizer-state leaves to pinned-host memory (same partitioning,
+    host bytes — ops/host_offload.py, the CPU-offload Adam analog)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     p_sh = param_shardings(cfg, mesh, rules)
@@ -98,6 +101,13 @@ def state_shardings(
         lambda: init_params(jax.random.PRNGKey(0), cfg)
     )
     opt_sh = opt_state_shardings(params_shape, p_sh, tx, mesh)
+    if offload_opt_state:
+        from dlrover_tpu.ops.host_offload import offload_shardings
+
+        opt_shape = jax.eval_shape(
+            lambda: tx.init(_zeros_like_tree(params_shape))
+        )
+        opt_sh = offload_shardings(opt_sh, opt_shape)
     return TrainState(step=replicated, params=p_sh, opt_state=opt_sh)
 
 
@@ -108,11 +118,17 @@ def _zeros_like_tree(shape_tree):
 
 
 def init_sharded_state(
-    key, cfg: TransformerConfig, mesh, tx, rules=None
+    key, cfg: TransformerConfig, mesh, tx, rules=None,
+    offload_opt_state: bool = False,
 ) -> Tuple[TrainState, TrainState]:
     """Initialize params/opt state directly into their shardings (no
-    host-size materialization of the full model)."""
-    sh = state_shardings(cfg, mesh, tx, rules)
+    host-size materialization of the full model). With
+    ``offload_opt_state`` the optimizer state is initialized DIRECTLY
+    into pinned-host memory — it never occupies HBM, so states larger
+    than the chip (fp32 Adam at 1.5B+) initialize fine."""
+    sh = state_shardings(
+        cfg, mesh, tx, rules, offload_opt_state=offload_opt_state
+    )
 
     init_p = jax.jit(
         functools.partial(init_params, cfg=cfg), out_shardings=sh.params
@@ -133,6 +149,8 @@ def build_train_step(
     rules: Optional[ShardingRules] = None,
     donate: bool = True,
     grad_accum: int = 1,
+    offload_opt_state: bool = False,
+    opt_shardings=None,
 ) -> Callable:
     """jitted (state, tokens, targets) → (state, metrics).
 
@@ -141,8 +159,27 @@ def build_train_step(
     large-global-batch recipe that also amortizes the optimizer's
     param-sized HBM pass over K× the tokens (at 1B+ params that pass is
     a visible slice of the step). Batch must divide by K; activation
-    memory is per-microbatch."""
-    sh = None  # shardings come from the arrays themselves (jit infers)
+    memory is per-microbatch.
+
+    ``offload_opt_state``: the optimizer state lives in pinned-host
+    memory between steps (ops/host_offload.py — the CPU-offload Adam
+    analog); the step streams it in before ``tx.update`` and back out
+    after, a cost ``grad_accum`` amortizes like the reference amortizes
+    PCIe."""
+    opt_sh = None
+    if offload_opt_state:
+        # the MIXED tree from offload_shardings: host-kind tensors,
+        # device-kind scalars (identical to the device tree off TPU,
+        # where placement is a numeric no-op — host_offload.py).
+        # Callers that already computed state_shardings pass its
+        # opt_state through ``opt_shardings`` to skip the re-trace.
+        opt_sh = (
+            opt_shardings
+            if opt_shardings is not None
+            else state_shardings(
+                cfg, mesh, tx, rules, offload_opt_state=True
+            ).opt_state
+        )
 
     def grads_and_loss(params, tokens, targets):
         def lf(p):
@@ -188,9 +225,16 @@ def build_train_step(
             (loss, aux), grads = grads_and_loss(
                 state.params, tokens, targets
             )
-        updates, new_opt = tx.update(
-            grads, state.opt_state, state.params
-        )
+        opt_state = state.opt_state
+        if offload_opt_state:
+            from dlrover_tpu.ops.host_offload import fetch_tree
+
+            opt_state = fetch_tree(opt_state, opt_sh)
+        updates, new_opt = tx.update(grads, opt_state, state.params)
+        if offload_opt_state:
+            from dlrover_tpu.ops.host_offload import offload_tree
+
+            new_opt = offload_tree(new_opt, opt_sh)
         new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
         metrics = {"loss": loss, "grad_norm": gnorm}

@@ -1,0 +1,108 @@
+"""Host-offloaded optimizer state (the CPU-offload Adam analog).
+
+Parity: the reference ships a CPU-offload Adam that keeps Adam moments
+in host DRAM and streams them through the GPU per update
+(atorch/atorch/optimizers/, SURVEY.md §2.3 optimizers row). The
+TPU-native equivalent needs no custom optimizer at all: XLA's memory
+spaces ("pinned_host") make host residency a SHARDING property. Any
+optax transformation's state can live in host DRAM — ``jax.device_put``
+with ``sharding.with_memory_kind("pinned_host")`` inside the jitted
+step becomes a device↔host stream that XLA schedules/overlaps, and the
+optimizer math itself is unchanged.
+
+What it buys: HBM for the optimizer state (fp32 Adam = 8 bytes/param;
+even the 8-bit moments are ~2.1 bytes/param) is freed for
+params/activations — e.g. GPT-2 XL (1.557B) with plain fp32 Adam needs
+~12.5 GB of moments that do not fit a 16 GB v5e chip next to params and
+activations; offloaded, the config runs. The cost is one
+state-sized h2d + d2h stream per optimizer update, amortized exactly
+like the reference amortizes PCIe: gradient accumulation (strategy
+``grad_accum``) makes it a per-K-microbatch cost.
+
+Support matrix (measured on this stack, jax 0.9): on TPU the
+streaming is real — in-jit ``device_put`` to a pinned-host sharding
+verified to place and round-trip on the chip. The CPU backend cannot
+execute placement annotations at all ("No registered implementation
+for ... annotate_device_placement"), and its SPMD partitioner rejects
+them multi-partition, so off-TPU the feature degrades to an explicit
+NUMERIC NO-OP (:func:`placement_active` is False: shardings keep their
+default memory kind, fetch/offload return their inputs). Tests and the
+virtual-mesh dryrun exercise the full strategy plumbing; placement
+assertions are TPU-only.
+
+Composition: ``Strategy(offload_opt=True)`` (or the opt-lib entry
+``"offload_opt"``) threads this through ``init_sharded_state`` (state
+is *initialized directly into* host memory — it never materializes in
+HBM) and ``build_train_step`` (fetch before ``tx.update``, offload the
+new state after). Multi-device states keep their NamedShardings — only
+the memory kind changes, so ZeRO-sharded moments offload shard-wise.
+"""
+
+from __future__ import annotations
+
+import jax
+
+HOST_KIND = "pinned_host"
+DEVICE_KIND = "device"
+
+
+_warned = False
+
+
+def placement_active() -> bool:
+    """True where memory-kind placement actually executes (TPU). Off
+    TPU the offload API is a numeric no-op — warn once so a CPU run
+    never silently believes its optimizer state left device memory."""
+    if jax.default_backend() == "tpu":
+        return True
+    global _warned
+    if not _warned:
+        from dlrover_tpu.common.log import default_logger
+
+        default_logger.info(
+            "host_offload: %s backend cannot execute memory-kind "
+            "placement; offload_opt_state is a numeric no-op here "
+            "(real on TPU)", jax.default_backend(),
+        )
+        _warned = True
+    return False
+
+
+def offload_shardings(sharding_tree, shape_tree):
+    """Sharding tree with tensor leaves moved to pinned-host memory.
+
+    Scalars (optimizer step counts) STAY device-resident: the SPMD
+    partitioner rejects host-placement annotations on replicated
+    scalars ("Side-effect HLO must have sharding"), and a scalar holds
+    no memory worth offloading. The partitioning itself is unchanged —
+    ZeRO-sharded moments offload shard-wise."""
+    if not placement_active():
+        return sharding_tree
+    return jax.tree_util.tree_map(
+        lambda s, sh: s.with_memory_kind(HOST_KIND) if sh.ndim else s,
+        sharding_tree,
+        shape_tree,
+    )
+
+
+def offload_tree(tree, mixed_sharding_tree):
+    """``device_put`` every leaf to its (possibly host-kind) sharding
+    from :func:`offload_shardings`. Traceable: inside ``jit`` this
+    lowers to an annotated d2h stream. No-op off TPU."""
+    if not placement_active():
+        return tree
+    return jax.tree_util.tree_map(
+        jax.device_put, tree, mixed_sharding_tree
+    )
+
+
+def fetch_tree(tree, sharding_tree):
+    """Inverse of :func:`offload_tree`: stream host-resident leaves back
+    into device (HBM) memory for compute. No-op off TPU."""
+    if not placement_active():
+        return tree
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s.with_memory_kind(DEVICE_KIND)),
+        tree,
+        sharding_tree,
+    )

@@ -1,0 +1,193 @@
+"""Host-offloaded optimizer state (ops/host_offload.py — the
+CPU-offload Adam analog): sharding metadata, numeric parity with the
+on-device path, strategy plumbing, and the support gate.
+
+Off TPU the feature is an explicit numeric no-op (the CPU backend
+cannot execute placement annotations — module docstring), so on the
+test backend these verify the full plumbing + parity; placement-kind
+assertions are TPU-only.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.accel.opt_lib import apply_optimizations
+from dlrover_tpu.accel.strategy import Strategy
+from dlrover_tpu.models import build_train_step, init_sharded_state, tiny
+from dlrover_tpu.models.train import state_shardings
+from dlrover_tpu.ops.host_offload import (
+    HOST_KIND,
+    offload_tree,
+    placement_active,
+)
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+ON_TPU = jax.default_backend() == "tpu"
+
+
+@pytest.fixture(scope="module")
+def big_mesh():
+    return build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny(vocab_size=64, num_layers=2, max_seq_len=32)
+
+
+def _tensor_kinds(tree):
+    """Memory kinds of the tensor (ndim >= 1) leaves — scalars like the
+    Adam step count deliberately stay device-resident."""
+    return {
+        x.sharding.memory_kind
+        for x in jax.tree_util.tree_leaves(tree)
+        if x.ndim
+    }
+
+
+class TestShardingMetadata:
+    @pytest.mark.skipif(not ON_TPU, reason="placement is TPU-only")
+    def test_opt_shardings_get_host_kind(self, cfg, big_mesh):
+        from dlrover_tpu.models.transformer import init_params
+
+        tx = optax.adamw(1e-3)
+        sh = state_shardings(cfg, big_mesh, tx, offload_opt_state=True)
+        params_shape = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), cfg)
+        )
+        opt_shape = jax.eval_shape(
+            lambda: tx.init(
+                jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), params_shape
+                )
+            )
+        )
+        kinds = {
+            s.memory_kind
+            for s, shape in zip(
+                jax.tree_util.tree_leaves(sh.opt_state),
+                jax.tree_util.tree_leaves(opt_shape),
+            )
+            if shape.ndim
+        }
+        assert kinds == {HOST_KIND}
+        # params untouched
+        assert HOST_KIND not in {
+            s.memory_kind
+            for s in jax.tree_util.tree_leaves(sh.params)
+        }
+
+    def test_offload_keeps_partitioning(self, cfg, big_mesh):
+        tx = optax.adamw(1e-3)
+        plain = state_shardings(cfg, big_mesh, tx)
+        off = state_shardings(cfg, big_mesh, tx, offload_opt_state=True)
+        specs = jax.tree_util.tree_map(
+            lambda a, b: (a.spec == b.spec), plain.opt_state, off.opt_state
+        )
+        assert all(jax.tree_util.tree_leaves(specs))
+
+    def test_offload_tree_roundtrip(self, cfg, big_mesh):
+        # off TPU these are numeric no-ops; on TPU they place for real
+        tx = optax.adamw(1e-3)
+        state, _ = init_sharded_state(
+            jax.random.PRNGKey(0), cfg, big_mesh, tx
+        )
+        sh = state_shardings(cfg, big_mesh, tx, offload_opt_state=True)
+        off = offload_tree(state.opt_state, sh.opt_state)
+        if placement_active():
+            assert _tensor_kinds(off) == {HOST_KIND}
+        for a, b in zip(
+            jax.tree_util.tree_leaves(state.opt_state),
+            jax.tree_util.tree_leaves(off),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestSupportGate:
+    def test_placement_active_matches_backend(self):
+        assert placement_active() == ON_TPU
+
+
+class TestParity:
+    def test_step_matches_on_device_path(self, cfg, big_mesh):
+        tx = optax.adamw(1e-3)
+        mesh = big_mesh
+        x = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, (8, 32)), jnp.int32
+        )
+        state_a, _ = init_sharded_state(
+            jax.random.PRNGKey(0), cfg, mesh, tx
+        )
+        state_b, _ = init_sharded_state(
+            jax.random.PRNGKey(0), cfg, mesh, tx, offload_opt_state=True
+        )
+        step_a = build_train_step(cfg, mesh, tx, donate=False)
+        step_b = build_train_step(
+            cfg, mesh, tx, donate=False, offload_opt_state=True
+        )
+        sa, ma = step_a(state_a, x, x)
+        sb, mb = step_b(state_b, x, x)
+        np.testing.assert_allclose(
+            float(ma["loss"]), float(mb["loss"]), rtol=1e-6
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(sa.params),
+            jax.tree_util.tree_leaves(sb.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-7
+            )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(sa.opt_state),
+            jax.tree_util.tree_leaves(sb.opt_state),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-7
+            )
+        if ON_TPU:  # in-jit placement only sticks on TPU
+            assert _tensor_kinds(sb.opt_state) == {HOST_KIND}
+
+    def test_composes_with_grad_accum(self, cfg, big_mesh):
+        tx = optax.adamw(1e-3)
+        x = jnp.asarray(
+            np.random.default_rng(1).integers(0, 64, (8, 32)), jnp.int32
+        )
+        state, _ = init_sharded_state(
+            jax.random.PRNGKey(1), cfg, big_mesh, tx,
+            offload_opt_state=True,
+        )
+        step = build_train_step(
+            cfg, big_mesh, tx, donate=True, grad_accum=4,
+            offload_opt_state=True,
+        )
+        state, m = step(state, x, x)
+        assert np.isfinite(float(m["loss"]))
+
+
+class TestStrategyPlumbing:
+    def test_opt_lib_entry(self):
+        cfg = tiny()
+        cfg2, s = apply_optimizations(cfg, Strategy(), ["offload_opt"])
+        assert s.offload_opt
+        assert "offload_opt" in s.opts
+        assert "offload_opt" in s.describe()
+
+    def test_strategy_json_roundtrip(self):
+        s = Strategy(offload_opt=True)
+        assert Strategy.from_json(s.to_json()).offload_opt
+
+    def test_dry_runner_builds_offloaded_step(self, cfg):
+        from dlrover_tpu.accel.dry_runner import _build
+
+        s = Strategy(mesh=MeshConfig(dp=4, fsdp=2), offload_opt=True)
+        tx = optax.adamw(1e-3)
+        cfg2, mesh, step_fn, init_fn, make_batch, _ = _build(
+            s, cfg, tx, jax.devices()
+        )
+        state = init_fn(jax.random.PRNGKey(0))
+        x, y = make_batch(8, 32)
+        state, m = step_fn(state, x, y)
+        assert np.isfinite(float(m["loss"]))

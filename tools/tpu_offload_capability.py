@@ -1,0 +1,60 @@
+"""Capability demo (real TPU): GPT-2 XL (1.557B) with plain fp32 Adam.
+
+The fp32 moments are 12.5 GB — they cannot fit a 16 GB v5e next to
+params and grads — so this config is IMPOSSIBLE without
+``offload_opt_state=True`` (ops/host_offload.py). Placement/parity unit
+coverage lives in tests/test_host_offload.py (TPU-gated asserts); this
+script is the end-to-end proof recorded in docs/performance.md:
+init 44 s, steady step ~2.1 s at mb2/seq512, loss decreasing.
+
+    python tools/tpu_offload_capability.py
+"""
+import time
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.models import build_train_step, init_sharded_state
+from dlrover_tpu.models.config import gpt2_xl
+from dlrover_tpu.ops.host_offload import HOST_KIND
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+def main():
+    assert jax.default_backend() == "tpu", "this demo needs the chip"
+    mesh = build_mesh(MeshConfig(dp=1))
+    cfg = replace(gpt2_xl(), max_seq_len=512)
+    tx = optax.adam(1e-4)  # plain fp32 Adam — the state that can't fit HBM
+    t0 = time.perf_counter()
+    state, _ = init_sharded_state(
+        jax.random.PRNGKey(0), cfg, mesh, tx, offload_opt_state=True
+    )
+    jax.block_until_ready(state.params)
+    print(f"1.557B fp32-Adam offloaded init: {time.perf_counter()-t0:.1f}s")
+    step = build_train_step(cfg, mesh, tx, donate=True, offload_opt_state=True)
+    x = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 512)),
+        jnp.int32,
+    )
+    state, m = step(state, x, x)
+    loss0 = float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(3):
+        state, m = step(state, x, x)
+    dt = (time.perf_counter() - t0) / 3
+    print(f"steady step {dt*1e3:.0f} ms, loss {loss0:.3f}->"
+          f"{float(m['loss']):.3f}")
+    kinds = {
+        t.sharding.memory_kind
+        for t in jax.tree_util.tree_leaves(state.opt_state)
+        if t.ndim
+    }
+    assert kinds == {HOST_KIND}, kinds
+    print("OFFLOAD CAPABILITY OK")
+
+
+if __name__ == "__main__":
+    main()

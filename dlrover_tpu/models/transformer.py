@@ -570,3 +570,92 @@ def forward_step(
 
     logits = lm_head(params, x, cfg)
     return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
+def forward_step_ragged(
+    params: Params,
+    tokens: jnp.ndarray,  # [S] int32 — ONE token per slot
+    cfg: TransformerConfig,
+    cache,
+    cur_lens: jnp.ndarray,  # [S] int32 — per-slot cache fill
+) -> Tuple[jnp.ndarray, Any]:
+    """Per-slot-position decode step: slot ``s``'s token occupies
+    position ``cur_lens[s]`` of ITS sequence. The continuous-batching
+    engine (rl/continuous_batching.py) needs this because its slots sit
+    at different depths — some mid-prefill, some decoding. Same math as
+    ``forward_step`` (which this generalizes: scalar ``cur_len`` is the
+    all-equal special case), with the cache write becoming a per-slot
+    scatter and the causal mask reading per-slot positions. Stale cache
+    entries from a slot's PREVIOUS occupant need no clearing: position
+    ``i`` is rewritten before any later query can attend to it.
+    """
+    dt = _dtype(cfg)
+    S_slots = tokens.shape[0]
+    T = cache["k"].shape[2]
+    g = cfg.num_heads // cfg.kv_heads
+    slot_ix = jnp.arange(S_slots)
+
+    x = params["embed"]["tokens"].astype(dt)[tokens][:, None]  # [S,1,D]
+    positions = cur_lens[:, None]  # [S, 1]
+    if not cfg.rope:
+        x = x + params["embed"]["positions"].astype(dt)[cur_lens][:, None]
+
+    key_pos = jnp.arange(T)[None, None, :]  # [1, 1, T]
+    mask = key_pos <= positions[:, :, None]  # [S, 1, T]
+
+    def decode_layer(x, layer, k_cache, v_cache):
+        h = _norm(x, layer["attn_norm"], cfg)
+        q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"].astype(dt))
+        k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"].astype(dt))
+        v = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wv"].astype(dt))
+        if cfg.rope:
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+        if cfg.mup_attn_scale is not None:
+            q = q * (cfg.mup_attn_scale * cfg.head_dim**0.5)
+        # per-slot scatter: cache[s, cur_lens[s]] = k[s, 0]
+        k_all = k_cache.at[slot_ix, cur_lens].set(
+            k[:, 0].astype(k_cache.dtype)
+        )
+        v_all = v_cache.at[slot_ix, cur_lens].set(
+            v[:, 0].astype(v_cache.dtype)
+        )
+        qg = q.reshape(S_slots, 1, cfg.kv_heads, g, cfg.head_dim)
+        scores = jnp.einsum(
+            "btkgh,bskh->bkgts", qg, k_all,
+            preferred_element_type=jnp.float32,
+        ) * (cfg.head_dim**-0.5)
+        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum(
+            "bkgts,bskh->btkgh", probs, v_all,
+            preferred_element_type=jnp.float32,
+        ).astype(dt)
+        o = o.reshape(S_slots, 1, cfg.num_heads, cfg.head_dim)
+        x = x + jnp.einsum(
+            "bthk,hkd->btd", o, layer["attn"]["wo"].astype(dt)
+        )
+        x, _ = _mlp_block(x, layer, cfg, None)
+        return x, k_all, v_all
+
+    if cfg.scan_layers:
+
+        def sbody(x, inp):
+            layer, k_cache, v_cache = inp
+            x, k_all, v_all = decode_layer(x, layer, k_cache, v_cache)
+            return x, (k_all, v_all)
+
+        x, (k_new, v_new) = lax.scan(
+            sbody, x, (params["layers"], cache["k"], cache["v"])
+        )
+        return lm_head(params, x, cfg)[:, 0], {"k": k_new, "v": v_new}
+
+    new_k, new_v = [], []
+    for i, layer in enumerate(params["layers"]):
+        x, k_all, v_all = decode_layer(
+            x, layer, cache["k"][i], cache["v"][i]
+        )
+        new_k.append(k_all)
+        new_v.append(v_all)
+    logits = lm_head(params, x, cfg)[:, 0]  # [S, V]
+    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}

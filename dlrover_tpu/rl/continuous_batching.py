@@ -1,0 +1,216 @@
+"""Continuous-batching generation engine (the vLLM-backend analog).
+
+Parity: the reference's RLHF engine generates rollouts through a
+vLLM-style inference backend (atorch/rl/model_engine/model_engine.py +
+its inference-backend seam). vLLM's throughput comes from *continuous
+batching*: finished sequences leave the batch immediately and new
+prompts take their slots, so short completions never leave the device
+idle waiting for the batch's longest sequence.
+
+The TPU-native redesign keeps everything static-shaped inside ONE
+compiled program — no dynamic batch, no host scheduler in the loop:
+
+- ``slots`` fixed sequence slots, each with its own region of the
+  preallocated KV cache ``[L, slots, T, H, D]``.
+- **Unified chunked-prefill/decode step**: every iteration feeds
+  exactly one token per slot through ``forward_step_ragged``
+  (per-slot positions). A slot mid-prompt consumes its next PROMPT
+  token (prefill rides along with decode, vLLM's chunked-prefill); a
+  slot past its prompt consumes the token it just sampled.
+- **In-graph refill**: a slot finishing (EOS / token budget) scatters
+  its completed sequence to the output buffers and loads the next
+  queued prompt in the same compiled step — stale cache needs no
+  clearing because position ``i`` is rewritten before anything can
+  attend to it.
+- One ``lax.while_loop`` runs until every prompt is emitted; the whole
+  engine is a single ``jit`` with static knobs.
+
+Sampling uses the same temperature/top-k/top-p support restriction as
+``rl.generation`` (shared ``_mask_logits``), and the recorded logprobs
+are behavior-policy logprobs under the actual sampling distribution.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlrover_tpu.models.config import TransformerConfig
+from dlrover_tpu.models.transformer import (
+    Params,
+    forward_step_ragged,
+    init_kv_cache,
+)
+from dlrover_tpu.rl.generation import _mask_logits, _rollout_pins
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "max_new_tokens", "eos_id", "slots", "temperature",
+        "greedy", "top_k", "top_p", "mesh",
+    ),
+)
+def continuous_generate(
+    params: Params,
+    prompts: jnp.ndarray,  # [N, P_max] int32, right-padded
+    prompt_lens: jnp.ndarray,  # [N] int32
+    key,
+    cfg: TransformerConfig,
+    max_new_tokens: int = 32,
+    eos_id: int = -1,  # -1: no EOS — every sequence runs its budget
+    slots: int = 8,
+    temperature: float = 1.0,
+    greedy: bool = False,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    mesh=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Generate completions for ``N`` prompts through ``slots`` device
+    slots with continuous refill.
+
+    Returns ``(tokens [N, P_max+max_new], logps [N, max_new],
+    out_lens [N])``: per prompt, its tokens (prompt + completion,
+    zero-padded past ``out_lens``), the behavior logprobs of the
+    generated part (zero-padded), and the total sequence length. A
+    sequence stops at ``eos_id`` (the EOS token is kept, budget
+    permitting) or after ``max_new_tokens``.
+    """
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    N, P_max = prompts.shape
+    S = min(slots, N)
+    T = P_max + max_new_tokens
+    cache = init_kv_cache(cfg, S, T)
+    if mesh is not None:
+        params, prompts, cache = _rollout_pins(
+            params, prompts, cache, cfg, mesh
+        )
+
+    pad_to_T = jnp.zeros((N, T - P_max), jnp.int32)
+    prompts_T = jnp.concatenate([prompts, pad_to_T], axis=1)  # [N, T]
+
+    # slot state. idle slots carry prompt_idx == N (the scatter dump row)
+    slot_ix = jnp.arange(S)
+    init_idx = slot_ix  # first S prompts occupy the slots (S <= N)
+    state = dict(
+        cache=cache,
+        tokens=prompts_T[init_idx],  # [S, T] token buffer per slot
+        logps=jnp.zeros((S, T), jnp.float32),
+        cur=jnp.zeros((S,), jnp.int32),  # tokens already in cache
+        plen=prompt_lens[init_idx].astype(jnp.int32),
+        pidx=init_idx.astype(jnp.int32),
+        next_p=jnp.int32(S),
+        emitted=jnp.int32(0),
+        # output buffers; row N is the dump row for idle-slot scatters
+        out_tokens=jnp.zeros((N + 1, T), jnp.int32),
+        out_logps=jnp.zeros((N + 1, T), jnp.float32),
+        out_lens=jnp.zeros((N + 1,), jnp.int32),
+        step=jnp.int32(0),
+    )
+
+    def sample(logits, k):
+        if greedy:
+            tok = jnp.argmax(logits, axis=-1)
+            scaled = logits
+        else:
+            scaled = _mask_logits(logits / temperature, top_k, top_p)
+            tok = jax.random.categorical(k, scaled, axis=-1)
+        logp = jax.nn.log_softmax(scaled, axis=-1)
+        tok_logp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
+        return tok.astype(jnp.int32), tok_logp
+
+    def cond(st):
+        return st["emitted"] < N
+
+    def body(st):
+        active = st["pidx"] < N  # idle slots (prompt queue drained)
+        # feed one token per slot: the next unprocessed buffer entry
+        feed = st["tokens"][slot_ix, st["cur"]]
+        logits, cache = forward_step_ragged(
+            params, feed, cfg, st["cache"], st["cur"]
+        )
+        new_cur = st["cur"] + jnp.where(active, 1, 0)
+
+        # slots whose fed token completed the prompt (or continued the
+        # completion) sample their next token from these logits
+        in_decode = active & (new_cur >= st["plen"])
+        tok, tok_logp = sample(
+            logits, jax.random.fold_in(key, st["step"])
+        )
+        tokens = st["tokens"].at[slot_ix, new_cur].set(
+            jnp.where(in_decode, tok, st["tokens"][slot_ix, new_cur])
+        )
+        logps = st["logps"].at[slot_ix, new_cur].set(
+            jnp.where(in_decode, tok_logp, 0.0)
+        )
+
+        n_new = new_cur + 1 - st["plen"]  # completion tokens incl. this
+        hit_eos = in_decode & (eos_id >= 0) & (tok == eos_id)
+        out_of_budget = in_decode & (n_new >= max_new_tokens)
+        done = hit_eos | out_of_budget
+
+        # emit: sequence length counts the sampled token
+        seq_len = new_cur + 1
+        dump = jnp.where(done, st["pidx"], N)
+        out_tokens = st["out_tokens"].at[dump].set(tokens)
+        out_logps = st["out_logps"].at[dump].set(logps)
+        out_lens = st["out_lens"].at[dump].set(seq_len)
+
+        # refill: k-th finishing slot (slot order) takes prompt
+        # next_p + k; slots beyond the queue go idle (pidx = N)
+        order = jnp.cumsum(done.astype(jnp.int32)) - 1
+        new_idx = st["next_p"] + order  # valid where done
+        refillable = done & (new_idx < N)
+        safe_idx = jnp.where(refillable, new_idx, 0)
+        tokens = jnp.where(
+            refillable[:, None], prompts_T[safe_idx], tokens
+        )
+        logps = jnp.where(refillable[:, None], 0.0, logps)
+        cur = jnp.where(done, 0, new_cur)
+        plen = jnp.where(
+            refillable, prompt_lens[safe_idx].astype(jnp.int32),
+            st["plen"],
+        )
+        pidx = jnp.where(
+            done,
+            jnp.where(refillable, new_idx, N).astype(jnp.int32),
+            st["pidx"],
+        )
+        return dict(
+            cache=cache,
+            tokens=tokens,
+            logps=logps,
+            cur=cur,
+            plen=plen,
+            pidx=pidx,
+            next_p=st["next_p"] + jnp.sum(done.astype(jnp.int32)),
+            emitted=st["emitted"] + jnp.sum(done.astype(jnp.int32)),
+            out_tokens=out_tokens,
+            out_logps=out_logps,
+            out_lens=out_lens,
+            step=st["step"] + 1,
+        )
+
+    st = lax.while_loop(cond, body, state)
+    out_tokens = st["out_tokens"][:N]
+    out_lens = st["out_lens"][:N]
+    # logps buffer is indexed by absolute position (completion starts
+    # at each prompt's length); shift rows so it starts at column 0
+    # (PPO consumes [N, max_new])
+    cols = jnp.arange(max_new_tokens)[None, :]
+    gather_ix = jnp.clip(
+        prompt_lens.astype(jnp.int32)[:, None] + cols, 0, T - 1
+    )
+    logps_aligned = jnp.take_along_axis(
+        st["out_logps"][:N], gather_ix, axis=1
+    )
+    n_new = out_lens - prompt_lens.astype(jnp.int32)
+    logps_aligned = jnp.where(cols < n_new[:, None], logps_aligned, 0.0)
+    return out_tokens, logps_aligned, out_lens

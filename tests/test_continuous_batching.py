@@ -1,0 +1,147 @@
+"""Continuous-batching engine vs the batch-synchronous generator: the
+slot machinery (chunked prefill, in-graph refill, EOS stop) must be
+invisible in the outputs — greedy decode of each prompt must match
+``generate`` run on that prompt alone."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models import tiny
+from dlrover_tpu.models.transformer import init_params
+from dlrover_tpu.rl.continuous_batching import continuous_generate
+from dlrover_tpu.rl.generation import generate
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny(vocab_size=61, num_layers=2, max_seq_len=64)
+    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(3))
+    return cfg, params
+
+
+def _prompt_queue(n, p_max, vocab, seed=0):
+    """n prompts of varied lengths 2..p_max, right-padded."""
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(2, p_max + 1, size=n)
+    toks = np.zeros((n, p_max), np.int32)
+    for i, ln in enumerate(lens):
+        toks[i, :ln] = rng.integers(1, vocab, size=ln)
+    return jnp.asarray(toks), jnp.asarray(lens.astype(np.int32))
+
+
+class TestGreedyEquivalence:
+    def test_matches_single_prompt_generate(self, model):
+        cfg, params = model
+        N, P_max, new = 5, 10, 6
+        prompts, lens = _prompt_queue(N, P_max, cfg.vocab_size)
+        out_tokens, out_logps, out_lens = continuous_generate(
+            params, prompts, lens, jax.random.PRNGKey(0), cfg,
+            max_new_tokens=new, slots=2, greedy=True,
+        )
+        for i in range(N):
+            ln = int(lens[i])
+            ref_tokens, ref_logps = generate(
+                params, prompts[i : i + 1, :ln], jax.random.PRNGKey(0),
+                cfg, max_new_tokens=new, greedy=True,
+            )
+            assert int(out_lens[i]) == ln + new
+            np.testing.assert_array_equal(
+                np.asarray(out_tokens[i, : ln + new]),
+                np.asarray(ref_tokens[0]),
+            )
+            np.testing.assert_allclose(
+                np.asarray(out_logps[i]),
+                np.asarray(ref_logps[0]),
+                rtol=2e-4, atol=2e-5,
+            )
+
+    def test_more_prompts_than_slots_refills(self, model):
+        # N >> slots forces multiple refill waves through one slot
+        cfg, params = model
+        N, P_max, new = 9, 6, 4
+        prompts, lens = _prompt_queue(N, P_max, cfg.vocab_size, seed=7)
+        out_tokens, _, out_lens = continuous_generate(
+            params, prompts, lens, jax.random.PRNGKey(0), cfg,
+            max_new_tokens=new, slots=2, greedy=True,
+        )
+        for i in range(N):
+            ln = int(lens[i])
+            ref_tokens, _ = generate(
+                params, prompts[i : i + 1, :ln], jax.random.PRNGKey(0),
+                cfg, max_new_tokens=new, greedy=True,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(out_tokens[i, : ln + new]),
+                np.asarray(ref_tokens[0]),
+            )
+
+
+class TestEos:
+    def test_stops_at_eos_and_keeps_it(self, model):
+        cfg, params = model
+        N, P_max, new = 3, 8, 6
+        prompts, lens = _prompt_queue(N, P_max, cfg.vocab_size, seed=1)
+        # find what greedy decode produces for prompt 0, pick its 3rd
+        # generated token as "EOS"
+        ln0 = int(lens[0])
+        ref_tokens, _ = generate(
+            params, prompts[0:1, :ln0], jax.random.PRNGKey(0), cfg,
+            max_new_tokens=new, greedy=True,
+        )
+        eos = int(ref_tokens[0, ln0 + 2])
+        out_tokens, out_logps, out_lens = continuous_generate(
+            params, prompts, lens, jax.random.PRNGKey(0), cfg,
+            max_new_tokens=new, slots=3, greedy=True, eos_id=eos,
+        )
+        # prompt 0 must stop right after emitting the EOS token
+        assert int(out_lens[0]) == ln0 + 3
+        assert int(out_tokens[0, ln0 + 2]) == eos
+        # logps past the stop are zero-padded
+        np.testing.assert_array_equal(
+            np.asarray(out_logps[0, 3:]), np.zeros(new - 3, np.float32)
+        )
+        # other prompts keep their full budget unless they also hit eos
+        for i in range(1, N):
+            assert int(out_lens[i]) <= int(lens[i]) + new
+
+    def test_no_eos_runs_full_budget(self, model):
+        cfg, params = model
+        N, P_max, new = 4, 6, 5
+        prompts, lens = _prompt_queue(N, P_max, cfg.vocab_size, seed=2)
+        _, _, out_lens = continuous_generate(
+            params, prompts, lens, jax.random.PRNGKey(0), cfg,
+            max_new_tokens=new, slots=4, greedy=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_lens), np.asarray(lens) + new
+        )
+
+
+class TestSampled:
+    def test_sampling_respects_support_restriction(self, model):
+        # top_k=1 sampling == greedy decode, regardless of temperature
+        cfg, params = model
+        N, P_max, new = 4, 6, 4
+        prompts, lens = _prompt_queue(N, P_max, cfg.vocab_size, seed=5)
+        out_g, _, _ = continuous_generate(
+            params, prompts, lens, jax.random.PRNGKey(0), cfg,
+            max_new_tokens=new, slots=2, greedy=True,
+        )
+        out_k1, _, _ = continuous_generate(
+            params, prompts, lens, jax.random.PRNGKey(0), cfg,
+            max_new_tokens=new, slots=2, temperature=0.7, top_k=1,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_g), np.asarray(out_k1)
+        )
+
+    def test_rejects_bad_knobs(self, model):
+        cfg, params = model
+        prompts, lens = _prompt_queue(2, 4, cfg.vocab_size)
+        with pytest.raises(ValueError, match="top_p"):
+            continuous_generate(
+                params, prompts, lens, jax.random.PRNGKey(0), cfg,
+                top_p=0.0,
+            )

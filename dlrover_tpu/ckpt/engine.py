@@ -276,13 +276,19 @@ class CheckpointEngine:
                 got_lock = False
             if got_lock:
                 try:
-                    shm_step, records, _ = self._shm.load_records()
+                    # zero-copy views: consumed (packed into transfer
+                    # buffers) inside restore_state below, all before the
+                    # lock is released in the finally
+                    shm_step, records, _ = self._shm.load_records(
+                        copy=False
+                    )
                     if shm_step >= committed and self._shm_covers(
                         records, target
                     ):
                         candidate = shm_step
                 except (LookupError, ValueError):
                     candidate = -1
+        by_path: Dict[str, list] = {}
         try:
             # every process reaches this collective exactly once per load,
             # whatever its agent/lock state — a host that failed to read
@@ -290,7 +296,6 @@ class CheckpointEngine:
             # would deadlock the others)
             agreed = self._all_processes_agree(candidate)
             if agreed and candidate >= 0:
-                by_path: Dict[str, list] = {}
                 for r in records:
                     by_path.setdefault(r.path, []).append(r)
                 try:
@@ -310,6 +315,12 @@ class CheckpointEngine:
                     f"falling back to committed step {committed}"
                 )
         finally:
+            # records may hold zero-copy views into the shm segment
+            # (load_records(copy=False)) — drop every reference BEFORE
+            # releasing the lock, or a concurrent save that outgrows the
+            # segment hits BufferError on shm.close() with live views
+            records = []
+            by_path.clear()
             if got_lock:
                 self._lock.force_release()
         if committed < 0:

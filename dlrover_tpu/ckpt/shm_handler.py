@@ -111,9 +111,17 @@ class ShmHandler:
     def metadata(self) -> Dict:
         return self._meta.as_dict()
 
-    def load_records(self) -> Tuple[int, List[ShardRecord], Dict]:
+    def load_records(
+        self, copy: bool = True
+    ) -> Tuple[int, List[ShardRecord], Dict]:
         """Read back (step, records, extra); records hold *copies* of the
-        bytes so the segment can be overwritten immediately after."""
+        bytes so the segment can be overwritten immediately after.
+
+        ``copy=False`` returns zero-copy views into the segment — the
+        caller must hold the shard lock until it has consumed them and
+        must drop every record before the handler closes (a live view
+        pins the mapping). The restore path uses this: its packed
+        transfer makes exactly one host copy, shm → flat buffer."""
         meta = self.metadata()
         if not meta.get("valid"):
             raise LookupError("no valid checkpoint in shared memory")
@@ -140,9 +148,9 @@ class ShmHandler:
                 offset=m["offset"],
             )
             shape = tuple(hi - lo for lo, hi in m["index"])
-            data = (
-                raw.copy().view(np.dtype(m["dtype"])).reshape(shape)
-            )
+            data = (raw.copy() if copy else raw).view(
+                np.dtype(m["dtype"])
+            ).reshape(shape)
             records.append(
                 ShardRecord(
                     path=m["path"],

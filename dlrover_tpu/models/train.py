@@ -47,7 +47,7 @@ def param_shardings(cfg: TransformerConfig, mesh, rules=None):
     return apply_rules(logical_axes(cfg), rules, mesh)
 
 
-def opt_state_shardings(params_shape, p_sh, tx, mesh):
+def opt_state_shardings(params_shape, p_sh, tx, mesh, opt_shape=None):
     """Shardings for ``tx.init``'s state: each leaf inherits its param's
     sharding (ZeRO: m/v shard with the param), scalars are replicated.
 
@@ -60,7 +60,10 @@ def opt_state_shardings(params_shape, p_sh, tx, mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     replicated = NamedSharding(mesh, P())
-    opt_shape = jax.eval_shape(lambda: tx.init(_zeros_like_tree(params_shape)))
+    if opt_shape is None:
+        opt_shape = jax.eval_shape(
+            lambda: tx.init(_zeros_like_tree(params_shape))
+        )
 
     def _path_key(path):
         return tuple(str(k) for k in path)
@@ -109,6 +112,47 @@ def state_shardings(
         )
         opt_sh = offload_shardings(opt_sh, opt_shape)
     return TrainState(step=replicated, params=p_sh, opt_state=opt_sh)
+
+
+def state_spec(
+    cfg: TransformerConfig, mesh, tx, rules=None,
+    offload_opt_state: bool = False,
+) -> TrainState:
+    """Abstract TrainState of ``ShapeDtypeStruct``-with-sharding leaves —
+    the restore *target* a restarted worker hands to
+    ``CheckpointEngine.load`` (ckpt/sharding.py ``target_shards``).
+    Unlike a zeros template it allocates nothing on device, so restore
+    peak HBM is the incoming state, not 2x it."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # trace init_params/tx.init once each (state_shardings would re-trace)
+    p_sh = param_shardings(cfg, mesh, rules)
+    params_shape = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg)
+    )
+    opt_shape = jax.eval_shape(
+        lambda: tx.init(_zeros_like_tree(params_shape))
+    )
+    opt_sh = opt_state_shardings(
+        params_shape, p_sh, tx, mesh, opt_shape=opt_shape
+    )
+    if offload_opt_state:
+        from dlrover_tpu.ops.host_offload import offload_shardings
+
+        opt_sh = offload_shardings(opt_sh, opt_shape)
+
+    def _spec(shape_leaf, sh_leaf):
+        return jax.ShapeDtypeStruct(
+            shape_leaf.shape, shape_leaf.dtype, sharding=sh_leaf
+        )
+
+    return TrainState(
+        step=jax.ShapeDtypeStruct(
+            (), jnp.int32, sharding=NamedSharding(mesh, P())
+        ),
+        params=jax.tree_util.tree_map(_spec, params_shape, p_sh),
+        opt_state=jax.tree_util.tree_map(_spec, opt_shape, opt_sh),
+    )
 
 
 def _zeros_like_tree(shape_tree):

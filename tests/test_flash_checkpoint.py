@@ -98,6 +98,63 @@ class TestShardRecords:
         assert restored["w"].sharding == state["w"].sharding
         assert restored["step"] == 7
 
+    def test_restore_state_from_abstract_spec(self):
+        # a restarted worker passes ShapeDtypeStructs + shardings — no
+        # zeros template on device (ckpt/sharding.py target_shards)
+        state = _sharded_state()
+        recs = host_shard_records(state)
+        by_path = {}
+        for r in recs:
+            by_path.setdefault(r.path, []).append(r)
+        spec = {
+            "w": jax.ShapeDtypeStruct(
+                state["w"].shape, state["w"].dtype,
+                sharding=state["w"].sharding,
+            ),
+            "b": jax.ShapeDtypeStruct(
+                state["b"].shape, state["b"].dtype,
+                sharding=state["b"].sharding,
+            ),
+            "step": np.asarray(0),
+        }
+        restored = restore_state(spec, lambda p: by_path.get(p, []))
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.asarray(state["w"])
+        )
+        assert restored["w"].sharding.is_equivalent_to(
+            state["w"].sharding, state["w"].ndim
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored["b"]), np.asarray(state["b"])
+        )
+        assert restored["step"] == 7
+
+    def test_restore_spec_reshards_across_axes(self):
+        # saved row-sharded on 8 devices, restored column-sharded on a
+        # 2x4 mesh via an abstract spec: packed transfer must reshuffle
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = jax.devices()
+        mesh1 = Mesh(np.array(devs).reshape(len(devs)), ("x",))
+        w = jax.device_put(
+            jnp.arange(64.0).reshape(8, 8), NamedSharding(mesh1, P("x"))
+        )
+        recs = host_shard_records({"w": w})
+        by_path = {}
+        for r in recs:
+            by_path.setdefault(r.path, []).append(r)
+        mesh2 = Mesh(np.array(devs).reshape(2, len(devs) // 2), ("a", "b"))
+        spec = {
+            "w": jax.ShapeDtypeStruct(
+                (8, 8), jnp.float32,
+                sharding=NamedSharding(mesh2, P("b", "a")),
+            )
+        }
+        restored = restore_state(spec, lambda p: by_path.get(p, []))
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.arange(64.0).reshape(8, 8)
+        )
+
 
 class TestShmHandler:
     def test_write_read_roundtrip(self, saver):

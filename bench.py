@@ -34,6 +34,7 @@ import sys
 import tempfile
 import time
 from dataclasses import replace
+from typing import Optional
 
 import numpy as np
 
@@ -202,6 +203,7 @@ def _goodput_body(
     state, step_fn, data, batch, seq, bw, on_accel, n_dev,
 ) -> bool:
     make_template = _make_restore_template(jax, cfg, mesh, tx)
+    sync_state = _make_hard_sync(jax, make_template())
 
     # warmup/compile + step-time calibration
     state, _ = step_fn(state, data["x"], data["y"])
@@ -231,8 +233,8 @@ def _goodput_body(
     while done < total_steps or (not preempted and done < hard_cap):
         t0 = time.perf_counter()
         state, metrics = step_fn(state, data["x"], data["y"])
-        jax.block_until_ready(state.params)
-        step_time += time.perf_counter() - t0
+        float(metrics["loss"])  # honest sync: block_until_ready can
+        step_time += time.perf_counter() - t0  # return early here
         done += 1
 
         if done % save_every == 0 and done < total_steps:
@@ -254,7 +256,7 @@ def _goodput_body(
             step0, state = engine.load(template, ckpt_dir)
             if state is None or step0 < 0:
                 return False  # cleanup runs in run_goodput's finally
-            jax.block_until_ready(state.params)
+            sync_state(state)
             restore_s = time.perf_counter() - t0
             done = step0
 
@@ -283,160 +285,537 @@ def _goodput_body(
     return True
 
 
-def run_goodput_124m(jax, results: dict):
-    """Goodput components at REAL scale: gpt2_small 124M with its full
-    ~1.5 GB fp32 train state through stage + commit + restore, one
-    injected preemption (VERDICT r3 #7).
+def _goodput_child_env(cache_dir: str) -> dict:
+    env = dict(os.environ)
+    env["DLROVER_TPU_BENCH_CACHE"] = cache_dir
+    return env
 
-    The headline goodput scenario picks a model the harness's ~24 MB/s
-    tunneled d2h link can stage inside its save cadence; this probe
-    measures what that link does at 124M honestly — stage-to-commit
-    latency, restore seconds, measured goodput over the probe window —
-    and reports the LINK-BUDGET extrapolation: per-preemption overhead
-    at a realistic one-preemption-per-hour density (the reference's
-    GLM-65B scenario is sparser still). On a real TPU-VM (no tunnel,
-    ~10+ GB/s d2h) the stage term shrinks ~400x and the measured-window
-    number converges to the extrapolated one.
+
+def _child_jax(cache_dir: str):
+    """Child-process jax bring-up with the persistent compile cache (the
+    standard restarted-worker configuration — trainer/elastic/
+    distributed.py:81 sets the same thing for real elastic restarts)."""
+    import jax
+
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return jax
+
+
+def _goodput124_cfg():
+    from dlrover_tpu.models import gpt2_small
+
+    return replace(gpt2_small(), max_seq_len=512), 32, 512
+
+
+def _make_hard_sync(jax, spec):
+    """Build a PRE-COMPILED every-buffer reduction for ``spec``-shaped
+    trees: calling it forces every buffer to exist and be fully written
+    via a 4-byte data-dependent readback. On this tunneled runtime
+    ``block_until_ready`` returns before transfers and executions
+    actually finish — every timing that matters must close with such a
+    readback. Compiling here (not inside the timed region) keeps the
+    measuring instrument out of the measurement."""
+    import jax.numpy as jnp
+
+    def _total(t):
+        acc = jnp.float32(0)
+        for leaf in jax.tree_util.tree_leaves(t):
+            acc = acc + jnp.sum(leaf.astype(jnp.float32))
+        return acc
+
+    compiled = jax.jit(_total).lower(spec).compile()
+    return lambda tree: float(compiled(tree))
+
+
+def _hard_sync(jax, tree) -> float:
+    """One-off variant of ``_make_hard_sync`` (compile cost included —
+    only for use OUTSIDE timed regions)."""
+    return _make_hard_sync(jax, tree)(tree)
+
+
+def _probe_h2d_link(jax) -> float:
+    """Measured host->device bandwidth (MB/s), hard-synced."""
+    import jax.numpy as jnp
+
+    d = jax.devices()[0]
+    x = np.random.default_rng(7).standard_normal(
+        16 * 1024 * 1024
+    ).astype(np.float32)
+    t0 = time.perf_counter()
+    y = jax.device_put(x, d)
+    float(jax.jit(jnp.sum)(y))
+    return 64.0 / max(time.perf_counter() - t0, 1e-3)
+
+
+def goodput_child_main(argv) -> int:
+    """Entry for the 124M goodput scenario's trainer processes.
+
+    Phases (each a REAL os process, matching the elastic-agent
+    architecture where the saver/shm live in the agent and trainers come
+    and go):
+      A  — train, async-stage the full fp32 state, train THROUGH the
+           commit, then exit (the injected preemption).
+      B  — fresh trainer: restore from the agent's shm (the
+           agent-survives path), train on.
+      B2 — fresh trainer on a "replacement node": full-loss restore from
+           storage (prefer_memory=False).
     """
     import optax
 
+    phase, out_path = argv[0], argv[1]
+    ckpt_dir = os.environ["DLROVER_TPU_BENCH_CKPT"]
+    cache_dir = os.environ.get("DLROVER_TPU_BENCH_CACHE", "")
+    t_proc0 = time.time()
+    jax = _child_jax(cache_dir)
+    if phase == "R15":
+        return _r15_child(jax, ckpt_dir, out_path, t_proc0)
+
     from dlrover_tpu.ckpt.engine import CheckpointEngine
-    from dlrover_tpu.ckpt.saver import AsyncCheckpointSaver
     from dlrover_tpu.models import (
         build_train_step,
-        gpt2_small,
         init_sharded_state,
         shard_batch,
     )
+    from dlrover_tpu.models.train import state_spec
     from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
 
-    if jax.devices()[0].platform == "cpu":
-        return
-
-    batch, seq = 32, 512
-    cfg = replace(gpt2_small(), max_seq_len=seq)
+    cfg, batch, seq = _goodput124_cfg()
     mesh = build_mesh(MeshConfig(dp=len(jax.devices())))
     tx = optax.adamw(3e-4, weight_decay=0.01)
-    state, _ = init_sharded_state(jax.random.PRNGKey(0), cfg, mesh, tx)
-    step_fn = build_train_step(cfg, mesh, tx, donate=False)
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
-    data = shard_batch({"x": tokens, "y": tokens}, mesh)
+    out: dict = {"t_proc0": t_proc0}
+
+    engine = CheckpointEngine()
+    assert engine._agent_mode, "goodput child requires the parent saver"
+    try:
+        if phase == "A":
+            state, _ = init_sharded_state(
+                jax.random.PRNGKey(0), cfg, mesh, tx
+            )
+            out["state_GB"] = round(
+                sum(
+                    x.size * x.dtype.itemsize
+                    for x in jax.tree_util.tree_leaves(state)
+                )
+                / 1e9,
+                3,
+            )
+            step_fn = build_train_step(cfg, mesh, tx, donate=False)
+            data = shard_batch({"x": tokens, "y": tokens}, mesh)
+            state, m = step_fn(state, data["x"], data["y"])  # compile
+            float(m["loss"])  # hard sync (see _hard_sync)
+            out["t_start"] = time.time()
+            step_time, done = 0.0, 0
+
+            def _train(n):
+                nonlocal state, step_time, done
+                for _ in range(n):
+                    t0 = time.perf_counter()
+                    state, m = step_fn(state, data["x"], data["y"])
+                    float(m["loss"])  # honest per-step sync
+                    step_time += time.perf_counter() - t0
+                    done += 1
+
+            _train(20)
+            t0 = time.perf_counter()
+            if not engine.save_to_memory(
+                done, state, ckpt_dir, block=False
+            ):
+                out["error"] = "stage skipped (lock busy)"
+                return _write_json(out_path, out, 1)
+            out["save_block_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 1
+            )
+            t_stage0 = time.perf_counter()
+            while engine.latest_step(ckpt_dir) < 0:
+                _train(1)
+                if time.perf_counter() - t_stage0 > 900:
+                    out["error"] = "stage never committed"
+                    return _write_json(out_path, out, 1)
+            out["stage_commit_s"] = round(
+                time.perf_counter() - t_stage0, 1
+            )
+            out["staged_step"] = 20
+            out["steps"] = done
+            out["step_time"] = round(step_time, 2)
+            out["t_end"] = time.time()
+            return _write_json(out_path, out, 0)
+
+        # B / B2: the restarted trainer
+        t0 = time.perf_counter()
+        spec = state_spec(cfg, mesh, tx)
+        out["spec_s"] = round(time.perf_counter() - t0, 2)
+        out["import_s"] = round(time.time() - t_proc0, 2)
+        sync = _make_hard_sync(jax, spec)  # compiled OUTSIDE the timer
+        out["t_load0"] = time.time()
+        if phase == "B":
+            # real bring-up overlaps the weight transfer with the
+            # train-step compile (persistent cache load): the executable
+            # needs only SPECS, not data — start it on a thread while
+            # the restore rides the link
+            import threading
+
+            step_fn = build_train_step(cfg, mesh, tx, donate=False)
+            data = shard_batch({"x": tokens, "y": tokens}, mesh)
+            box: dict = {}
+
+            def _compile():
+                t1 = time.perf_counter()
+                try:
+                    box["exe"] = step_fn.lower(
+                        spec, data["x"], data["y"]
+                    ).compile()
+                except BaseException as e:  # re-raised on the main thread
+                    box["err"] = e
+                box["compile_s"] = round(time.perf_counter() - t1, 2)
+
+            th = threading.Thread(target=_compile, daemon=True)
+            th.start()
+        t0 = time.perf_counter()
+        step0, state = engine.load(
+            spec, ckpt_dir, prefer_memory=(phase == "B")
+        )
+        sync(state)  # data-dependent readback, not block_until_ready
+        out["restore_s"] = round(time.perf_counter() - t0, 2)
+        out["restored_step"] = int(step0)
+        if phase == "B2":
+            out["t_end"] = time.time()
+            # post-window: link reference point for the decomposition
+            out["h2d_MBps"] = round(_probe_h2d_link(jax), 1)
+            return _write_json(out_path, out, 0 if step0 >= 0 else 1)
+
+        th.join(timeout=600)
+        out["compile_s"] = box.get("compile_s")
+        if "err" in box:
+            raise box["err"]
+        if "exe" not in box:
+            raise RuntimeError(
+                "train-step compile did not finish within 600s"
+            )
+        exe = box["exe"]
+        t0 = time.perf_counter()
+        state, m = exe(state, data["x"], data["y"])
+        float(m["loss"])
+        out["first_step_s"] = round(time.perf_counter() - t0, 2)
+        out["t_first_step_done"] = time.time()
+        step_time, done = out["first_step_s"], 1
+        budget = float(os.environ.get("DLROVER_TPU_BENCH_B_TAIL", 120))
+        t_tail0 = time.perf_counter()
+        while time.perf_counter() - t_tail0 < budget and done < 2000:
+            t0 = time.perf_counter()
+            state, m = exe(state, data["x"], data["y"])
+            float(m["loss"])  # honest per-step sync
+            step_time += time.perf_counter() - t0
+            done += 1
+        out["steps"] = done
+        out["step_time"] = round(step_time, 2)
+        out["t_end"] = time.time()
+        # post-window: measured link for the restore decomposition
+        out["h2d_MBps"] = round(_probe_h2d_link(jax), 1)
+        return _write_json(out_path, out, 0)
+    finally:
+        engine.close()
+
+
+def _r15_child(jax, ckpt_dir: str, out_path: str, t_proc0: float) -> int:
+    """Fresh-trainer restore of the 1.5B (bf16 + 8-bit Adam) state the
+    parent staged: shm path first (agent survives), then the full-loss
+    storage path. A fresh process is the honest restore client — it IS
+    the restarted trainer, and it pays (only) real restart costs."""
+    import gc
+
+    from jax.sharding import SingleDeviceSharding
+
+    from dlrover_tpu.ckpt.engine import CheckpointEngine
+    from dlrover_tpu.models import gpt2_xl, init_params
+    from dlrover_tpu.ops.quantized_optim import adamw_8bit_flat
+
+    cfg = replace(
+        gpt2_xl(), max_seq_len=512, dtype="bfloat16",
+        param_dtype="bfloat16",
+    )
+    tx = adamw_8bit_flat(3e-4)
+    params_shape = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg)
+    )
+    opt_shape = jax.eval_shape(tx.init, params_shape)
+    sh = SingleDeviceSharding(jax.devices()[0])
+    spec = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        {"params": params_shape, "opt_state": opt_shape},
+    )
+    out: dict = {"t_proc0": t_proc0}
+    out["h2d_MBps"] = round(_probe_h2d_link(jax), 1)
+    sync = _make_hard_sync(jax, spec)  # compiled OUTSIDE the timers
+    engine = CheckpointEngine()
+    try:
+        t0 = time.perf_counter()
+        step0, state = engine.load(spec, ckpt_dir)
+        sync(state)
+        out["restore_shm_s"] = round(time.perf_counter() - t0, 2)
+        out["restored_step"] = int(step0)
+        del state
+        gc.collect()
+        t0 = time.perf_counter()
+        step1, state = engine.load(spec, ckpt_dir, prefer_memory=False)
+        sync(state)
+        out["restore_storage_s"] = round(time.perf_counter() - t0, 2)
+        out["restored_step_storage"] = int(step1)
+        out["t_end"] = time.time()
+        return _write_json(out_path, out, 0 if step0 >= 0 else 1)
+    finally:
+        engine.close()
+
+
+def run_flashckpt_1p5b(jax, results: dict, carry: dict):
+    """Flash-checkpoint lifecycle at 1.5B (VERDICT r4 #1b): the live
+    GPT-2 XL bf16 params + 8-bit Adam state from the MFU probe goes
+    through async stage -> commit -> fresh-process restore (shm and
+    full-loss storage paths). The bar: the reference's 1.5B blog
+    scenario (flash_checkpoint.md:292-332 — 0.5 s save block, in-memory
+    restore) and BASELINE.md's restore < 10 s north star."""
+    import gc
+
+    from dlrover_tpu.ckpt.engine import CheckpointEngine
+    from dlrover_tpu.ckpt.saver import AsyncCheckpointSaver
+
+    state = carry.pop("state", None)
+    if state is None or jax.devices()[0].platform == "cpu":
+        return
     state_bytes = sum(
         x.size * x.dtype.itemsize
         for x in jax.tree_util.tree_leaves(state)
     )
+    results["flash_1p5b_state_GB"] = round(state_bytes / 1e9, 2)
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt15b_")
+    cache_dir = os.path.join(
+        tempfile.gettempdir(), "dlrover_tpu_bench_jaxcache"
+    )
+    env = _goodput_child_env(cache_dir)
+    env["DLROVER_TPU_BENCH_CKPT"] = ckpt_dir
+    tmp = tempfile.mkdtemp(prefix="bench_15b_")
 
-    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt124_")
     AsyncCheckpointSaver.reset()
     AsyncCheckpointSaver.start_async_saving_ckpt(local_shard_num=1)
     engine = CheckpointEngine()
     try:
-        make_template = _make_restore_template(jax, cfg, mesh, tx)
-        state, _ = step_fn(state, data["x"], data["y"])  # compile
-        jax.block_until_ready(state.params)
-
-        t_bench0 = time.perf_counter()
-        step_time = 0.0
-        done = 0
-
-        def _train(n):
-            nonlocal state, step_time, done
-            for _ in range(n):
-                t0 = time.perf_counter()
-                state, _ = step_fn(state, data["x"], data["y"])
-                jax.block_until_ready(state.params)
-                step_time += time.perf_counter() - t0
-                done += 1
-
-        _train(20)
         t0 = time.perf_counter()
-        if not engine.save_to_memory(done, state, ckpt_dir, block=False):
-            # skipped (shard lock busy) — bail immediately instead of
-            # polling 124M-scale train steps against a commit that can
-            # never arrive
-            results["goodput_124m_error"] = "stage skipped (lock busy)"
+        if not engine.save_to_memory(7, state, ckpt_dir, block=False):
+            results["flash_1p5b_error"] = "stage skipped (lock busy)"
             return
-        save_block_s = time.perf_counter() - t0
-        # train THROUGH the async stage; poll for the commit
-        t_stage0 = time.perf_counter()
-        while engine.latest_step(ckpt_dir) < 0:
-            _train(1)
-            if time.perf_counter() - t_stage0 > 900:
-                results["goodput_124m_error"] = "stage never committed"
-                return
-        stage_commit_s = time.perf_counter() - t_stage0
-        committed = engine.latest_step(ckpt_dir)
-
-        # preempt: lose the live state, restore the committed one
-        del state
-        t0 = time.perf_counter()
-        step0, state = engine.load(make_template(), ckpt_dir)
-        jax.block_until_ready(state.params)
-        restore_s = time.perf_counter() - t0
-        lost_steps = done - step0
-        done = step0
-        _train(10)
-
-        wall = time.perf_counter() - t_bench0
-        goodput_window = 100.0 * step_time / wall
-        step_s = step_time / max(done + lost_steps, 1)
-        # link-budget extrapolation: one preemption per hour costs
-        # restore + the steps staged-but-uncommitted work lost
-        overhead_s = restore_s + lost_steps * step_s
-        results.update(
-            {
-                "goodput_124m_window_pct": round(goodput_window, 2),
-                "goodput_124m_per_hr_pct": round(
-                    100.0 * (1.0 - overhead_s / 3600.0), 2
-                ),
-                "goodput_124m_state_GB": round(state_bytes / 1e9, 3),
-                "goodput_124m_save_block_ms": round(
-                    save_block_s * 1e3, 1
-                ),
-                "goodput_124m_stage_commit_s": round(stage_commit_s, 1),
-                "goodput_124m_restore_s": round(restore_s, 1),
-                "goodput_124m_lost_steps": int(lost_steps),
-                "goodput_124m_note": (
-                    "full 124M fp32 train state through stage+commit+"
-                    "restore on the ~24 MB/s tunneled d2h link; "
-                    "per-hour number is the link-budget extrapolation "
-                    f"(overhead {overhead_s:.0f}s/preemption), window "
-                    "number is the probe window itself"
-                ),
-            }
+        results["flash_1p5b_save_block_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1
         )
-        assert committed >= 0
+        t0 = time.perf_counter()
+        while engine.latest_step(ckpt_dir) < 0:
+            time.sleep(0.5)
+            if time.perf_counter() - t0 > 900:
+                results["flash_1p5b_error"] = "stage never committed"
+                return
+        results["flash_1p5b_stage_commit_s"] = round(
+            time.perf_counter() - t0, 1
+        )
+        # the preempted trainer's buffers die with it: free the parent's
+        # copy so the restoring child has the chip's HBM
+        del state
+        carry.clear()
+        gc.collect()
+        r = _spawn_goodput_child(
+            "R15", os.path.join(tmp, "r15.json"), env, 900
+        )
+        results["flash_1p5b_restore_shm_s"] = r["restore_shm_s"]
+        results["flash_1p5b_restore_storage_s"] = r["restore_storage_s"]
+        results["flash_1p5b_restore_link_MBps"] = r.get("h2d_MBps")
+        results["flash_1p5b_note"] = (
+            "live 1.5B bf16+8bit-Adam state async-staged off the train "
+            "loop (save_block is the critical-path cost), committed to "
+            "disk by the agent saver, restored by a FRESH trainer "
+            "process from agent shm and, separately, from storage "
+            "(full loss). Stage/persist ride the harness's ~45 MB/s "
+            "tunneled d2h link off the critical path"
+        )
+    except Exception as e:
+        results["flash_1p5b_error"] = repr(e)
     finally:
         engine.close()
         AsyncCheckpointSaver.reset()
 
 
+def _write_json(path: str, obj: dict, rc: int) -> int:
+    with open(path, "w") as f:
+        json.dump(obj, f)
+    return rc
+
+
+def _spawn_goodput_child(phase, out_path, env, timeout_s):
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--goodput-child", phase, out_path],
+        env=env, timeout=timeout_s, capture_output=True, text=True,
+    )
+    if os.path.exists(out_path):
+        # a child that failed gracefully wrote a structured {"error": …}
+        # before exiting nonzero — surface that, not a stderr dump
+        with open(out_path) as f:
+            return json.load(f)
+    raise RuntimeError(
+        f"goodput child {phase} rc={proc.returncode}: "
+        f"{proc.stderr[-1500:]}"
+    )
+
+
+def run_goodput_124m(jax, results: dict):
+    """Goodput at REAL scale with the REAL restart architecture
+    (VERDICT r4 #1): gpt2_small 124M, full ~1.5 GB fp32 train state,
+    one injected preemption where the trainer PROCESS dies and a fresh
+    one restores — from the surviving agent's shared memory (fast path)
+    — then a separate full-loss scenario restores from storage.
+
+    Three real OS processes against the in-parent agent saver:
+    A (train + stage + die), B (shm restore + train on), B2 (storage
+    restore, replacement-node case). The goodput window spans A's first
+    timed step to B's last, so it INCLUDES process death, python/jax
+    bring-up, compile-cache loads and the restore itself — costs the
+    round-4 in-process probe never paid.
+    """
+    from dlrover_tpu.ckpt.saver import AsyncCheckpointSaver
+
+    if jax.devices()[0].platform == "cpu":
+        return
+
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt124_")
+    cache_dir = os.path.join(
+        tempfile.gettempdir(), "dlrover_tpu_bench_jaxcache"
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    env = _goodput_child_env(cache_dir)
+    env["DLROVER_TPU_BENCH_CKPT"] = ckpt_dir
+    tmp = tempfile.mkdtemp(prefix="bench_goodput_")
+
+    AsyncCheckpointSaver.reset()
+    AsyncCheckpointSaver.start_async_saving_ckpt(local_shard_num=1)
+    try:
+        a = _spawn_goodput_child(
+            "A", os.path.join(tmp, "a.json"), env, 900
+        )
+        if "error" in a:
+            results["goodput_124m_error"] = a["error"]
+            return
+        b = _spawn_goodput_child(
+            "B", os.path.join(tmp, "b.json"), env, 900
+        )
+        step_time = a["step_time"] + b["step_time"]
+        wall = b["t_end"] - a["t_start"]
+        lost_steps = a["steps"] - a["staged_step"]
+        step_s = a["step_time"] / max(a["steps"], 1)
+        # restart overhead: preemption -> B's first step done (process
+        # spawn + jax init + spec + restore + cached-compile load)
+        restart_s = b["t_first_step_done"] - a["t_end"]
+        # one preemption per hour: restart + work since last commit lost
+        overhead_s = restart_s + lost_steps * step_s
+        # restore decomposition: the link-bound seconds are the state
+        # crossing B's MEASURED h2d link; the rest is framework overhead
+        # (shm read, pack, unpack compile, stitch)
+        link_s = a["state_GB"] * 1e3 / max(b.get("h2d_MBps", 25.0), 1.0)
+        restore_overhead_s = max(b["restore_s"] - link_s, 0.0)
+        # derived, clearly labeled: same window on a real TPU-VM host
+        # where d2h moves >= 1 GB/s (restore's link term collapses)
+        restore_1gbps = restore_overhead_s + a["state_GB"]
+        wall_real_link = wall - b["restore_s"] + restore_1gbps
+        results.update(
+            {
+                "goodput_124m_window_pct": round(
+                    100.0 * step_time / wall, 2
+                ),
+                "goodput_124m_per_hr_pct": round(
+                    100.0 * (1.0 - overhead_s / 3600.0), 2
+                ),
+                "goodput_124m_window_at_1GBps_pct": round(
+                    100.0 * step_time / wall_real_link, 2
+                ),
+                "goodput_124m_state_GB": a["state_GB"],
+                "goodput_124m_save_block_ms": a["save_block_ms"],
+                "goodput_124m_stage_commit_s": a["stage_commit_s"],
+                "goodput_124m_restore_shm_s": b["restore_s"],
+                "goodput_124m_restore_link_MBps": b.get("h2d_MBps"),
+                "goodput_124m_restore_implied_MBps": round(
+                    a["state_GB"] * 1e3 / max(b["restore_s"], 0.1), 1
+                ),
+                "goodput_124m_compile_overlap_s": b.get("compile_s"),
+                "goodput_124m_restore_overhead_s": round(
+                    restore_overhead_s, 1
+                ),
+                "goodput_124m_restart_s": round(restart_s, 1),
+                "goodput_124m_lost_steps": int(lost_steps),
+                "goodput_124m_note": (
+                    "REAL process-restart scenario, every timing closed "
+                    "by a data-dependent readback: trainer A dies after "
+                    "async stage+commit; fresh trainer B restores from "
+                    "the agent's shm and trains on. Window spans A-first-"
+                    "step..B-last-step incl. process death, bring-up and "
+                    "restore. restore_shm_s is ~all link: 1.49 GB over "
+                    "the harness's measured ~"
+                    f"{b.get('h2d_MBps', '?')} MB/s h2d tunnel; "
+                    "framework overhead beyond the link is "
+                    f"{restore_overhead_s:.1f}s (was ~25s of per-leaf "
+                    "dispatch before the packed-transfer restore). "
+                    "per_hr_pct is the number comparable to the "
+                    "reference's 95% (its GLM-65B preemptions are "
+                    "hour-scale); window_at_1GBps is the same window "
+                    "with the restore's link term at a real TPU-VM's "
+                    "d2h floor, labeled derived"
+                ),
+            }
+        )
+        try:
+            b2 = _spawn_goodput_child(
+                "B2", os.path.join(tmp, "b2.json"), env, 600
+            )
+            results["goodput_124m_restore_storage_s"] = b2["restore_s"]
+        except Exception as e:  # full-loss row is additive
+            results["goodput_124m_restore_storage_s"] = None
+            results["goodput_124m_b2_error"] = repr(e)
+    finally:
+        AsyncCheckpointSaver.reset()
+
+
 def run_sp_compare(jax, results: dict):
-    """Ring vs Ulysses sequence parallelism: the per-device COMPUTE
-    each scheme runs at long context, timed with the Pallas flash
-    kernel on the real chip (VERDICT r3 #9 — make cfg.sp_scheme
-    selection data-driven).
+    """Ring vs Ulysses sequence parallelism with the KERNEL STRATEGY
+    HELD CONSTANT (VERDICT r4 #8): each scheme's per-device compute is
+    timed both ways — "fused" = [1024x1024] fused-kernel tiles + online
+    merges (``flash_attention_fwd_chunked``; ring's hops get the same
+    driver so T/sp > 1024 chunks also tile), "stream" = the block-tiled
+    streaming kernel — at seq 4096 AND 8192, sp=4, bf16.
 
     One harness chip cannot run the sp=4 collectives, so this times
-    exactly the part that differs per device and is measurable here:
-    ring = sp sequential kernel calls over [T/sp]-key chunks (its
-    ppermute overlaps compute; per-hop kernel-launch + small-shape
-    overhead is ring's real cost), ulysses = ONE full-sequence kernel
-    on heads/sp heads (its cost is the two all-to-alls, which ride
-    ICI and move act_bytes/sp per device — noted analytically). The
-    dryrun proves both schemes' collectives compile+run on the 8-way
-    virtual mesh; this records which one's compute wins at seq 4096.
+    exactly the part that differs per device (ring's ppermute overlaps
+    compute; Ulysses' two all-to-alls move act_bytes/sp per device over
+    ICI — noted analytically). The dryrun proves both schemes'
+    collectives compile+run on the 8-way virtual mesh. ``sp_scheme``
+    selection reads this table: rows are written as
+    ``sp_{scheme}_{kernel}_ms_{T}`` plus ``sp_recommended_{T}``.
     """
     import functools
 
     import jax.numpy as jnp
 
-    from dlrover_tpu.ops.flash_attention import flash_attention_fwd
+    from dlrover_tpu.ops.flash_attention import (
+        flash_attention_fwd,
+        flash_attention_fwd_chunked,
+        merge_partials,
+    )
 
     if jax.devices()[0].platform == "cpu":
         return
-    B, T, H, D = 2, 4096, 16, 128
+    B, H, D = 2, 16, 128
     sp = 4
     rng = np.random.default_rng(3)
 
@@ -447,63 +826,110 @@ def run_sp_compare(jax, results: dict):
             jnp.asarray(rng.normal(size=(B, t, h, D)), jnp.bfloat16),
         )
 
-    @functools.partial(jax.jit, static_argnums=(3,))
-    def ring_device(q, k, v, iters):
-        # one device's work per step: sp kernel calls, q [T/sp] local,
-        # each hop's k/v chunk [T/sp] (causal offsets as in
-        # parallel/ring_attention.py), chained via the accumulator
-        def one(acc, _):
-            o = acc
-            for hop in range(sp):
-                # the LAST rank's hops (the causal bottleneck with
-                # plain chunk order): every earlier chunk fully
-                # visible, the diagonal hop causal
-                o_h, _ = flash_attention_fwd(
-                    q, k, v, causal=True,
-                    q_offset=(sp - 1) * (T // sp),
-                    k_offset=hop * (T // sp),
-                )
-                o = o + o_h.astype(jnp.float32)
-            return o, None
-        acc0 = jnp.zeros((B, T // sp, H, D), jnp.float32)
-        out, _ = jax.lax.scan(one, acc0, jnp.arange(iters))
-        return out[0, 0, 0, 0]
+    def make_ring(T, fused):
+        chunk = min(1024, T // sp)
 
-    @functools.partial(jax.jit, static_argnums=(3,))
-    def ulysses_device(q, k, v, iters):
-        # one device's work per step: full sequence, H/sp heads
-        def one(acc, _):
-            o, _ = flash_attention_fwd(q, k, v, causal=True)
-            return acc + o.astype(jnp.float32), None
-        acc0 = jnp.zeros((B, T, H // sp, D), jnp.float32)
-        out, _ = jax.lax.scan(one, acc0, jnp.arange(iters))
-        return out[0, 0, 0, 0]
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def ring_device(q, k, v, iters):
+            # one device's work per step: sp hop calls, q [T/sp] local,
+            # each hop's k/v chunk [T/sp], ONLINE-MERGED across hops
+            # exactly as parallel/ring_attention.py does (the last
+            # rank's causal bottleneck hops)
+            def one(acc, _):
+                o_acc, lse_acc = None, None
+                for hop in range(sp):
+                    if fused:
+                        o_h, lse_h = flash_attention_fwd_chunked(
+                            q, k, v, causal=True,
+                            q_offset=(sp - 1) * (T // sp),
+                            k_offset=hop * (T // sp),
+                            chunk=chunk,
+                        )
+                    else:
+                        o_h, lse_h = flash_attention_fwd(
+                            q, k, v, causal=True,
+                            q_offset=(sp - 1) * (T // sp),
+                            k_offset=hop * (T // sp),
+                            allow_fused=False,
+                        )
+                    o_h = o_h.astype(jnp.float32)
+                    if o_acc is None:
+                        o_acc, lse_acc = o_h, lse_h
+                    else:
+                        o_acc, lse_acc = merge_partials(
+                            o_acc, lse_acc, o_h, lse_h
+                        )
+                return acc + o_acc, None
+
+            acc0 = jnp.zeros((B, T // sp, H, D), jnp.float32)
+            out, _ = jax.lax.scan(one, acc0, jnp.arange(iters))
+            return out[0, 0, 0, 0]
+
+        return ring_device
+
+    def make_ulysses(T, fused):
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def ulysses_device(q, k, v, iters):
+            # one device's work per step: full sequence, H/sp heads
+            def one(acc, _):
+                if fused:
+                    o, _ = flash_attention_fwd_chunked(
+                        q, k, v, causal=True, chunk=1024
+                    )
+                else:
+                    o, _ = flash_attention_fwd(
+                        q, k, v, causal=True, allow_fused=False
+                    )
+                return acc + o.astype(jnp.float32), None
+
+            acc0 = jnp.zeros((B, T, H // sp, D), jnp.float32)
+            out, _ = jax.lax.scan(one, acc0, jnp.arange(iters))
+            return out[0, 0, 0, 0]
+
+        return ulysses_device
 
     iters = 20
-    qr, kr, vr = mk(H, T // sp)
-    qu, ku, vu = mk(H // sp, T)
-    for name, fn, args in (
-        ("ring", ring_device, (qr, kr, vr)),
-        ("ulysses", ulysses_device, (qu, ku, vu)),
-    ):
-        # warm up the SAME static-iters executable the timer runs —
-        # iters is a static argnum, a different value would compile a
-        # fresh program inside the timed region
-        float(fn(*args, iters))
-        t0 = time.perf_counter()
-        float(fn(*args, iters))
-        results[f"sp_{name}_attn_ms"] = round(
-            (time.perf_counter() - t0) / iters * 1e3, 2
+    for T in (4096, 8192):
+        qr, kr, vr = mk(H, T // sp)
+        qu, ku, vu = mk(H // sp, T)
+        best = {}
+        for scheme, maker, args in (
+            ("ring", make_ring, (qr, kr, vr)),
+            ("ulysses", make_ulysses, (qu, ku, vu)),
+        ):
+            for kernel, fused in (("fused", True), ("stream", False)):
+                fn = maker(T, fused)
+                # warm up the SAME static-iters executable the timer
+                # runs (iters is static — another value recompiles)
+                float(fn(*args, iters))
+                t0 = time.perf_counter()
+                float(fn(*args, iters))
+                ms = round((time.perf_counter() - t0) / iters * 1e3, 2)
+                results[f"sp_{scheme}_{kernel}_ms_{T}"] = ms
+                best[(scheme, kernel)] = ms
+        results[f"sp_recommended_{T}"] = min(
+            ("ring", "ulysses"),
+            key=lambda s: min(best[(s, "fused")], best[(s, "stream")]),
         )
+    # legacy comparability rows (round-4 names, best kernel per scheme)
+    results["sp_ring_attn_ms"] = min(
+        results["sp_ring_fused_ms_4096"], results["sp_ring_stream_ms_4096"]
+    )
+    results["sp_ulysses_attn_ms"] = min(
+        results["sp_ulysses_fused_ms_4096"],
+        results["sp_ulysses_stream_ms_4096"],
+    )
     results["sp_compare_note"] = (
-        f"per-device flash-attention compute at seq {T}, sp={sp}, "
-        f"H={H}, D={D}, bf16: ring = {sp} chunked kernel calls "
-        "(comm overlaps), ulysses = 1 full-seq call on H/sp heads "
-        "(+2 all-to-alls moving act_bytes/sp per device over ICI)"
+        f"per-device flash-attention compute, sp={sp}, H={H}, D={D}, "
+        "bf16, kernel strategy held constant per row: fused = "
+        "1024x1024 fused tiles + online merges (both schemes), stream "
+        "= block-tiled streaming kernel (both schemes). Ring rows "
+        "include its per-hop merge cost; ulysses pays +2 all-to-alls "
+        "(act_bytes/sp per device over ICI) not timeable on one chip"
     )
 
 
-def run_mfu_big(jax, results: dict):
+def run_mfu_big(jax, results: dict, carry: Optional[dict] = None):
     """Big-model MFU probe: GPT-2 XL (1.557B params) FULL training
     update on one chip — bf16 params/activations, flash attention, the
     repo's fused 8-bit Adam, gradient accumulation.
@@ -637,6 +1063,11 @@ def run_mfu_big(jax, results: dict):
     results["opt_pass_ms"] = round(
         (time.perf_counter() - t0) / opt_iters * 1000, 1
     )
+    if carry is not None:
+        # hand the live 1.5B state to the flash-ckpt probe (params were
+        # donated through apply_probe — p3/o3 are the current buffers)
+        carry["state"] = {"params": p3, "opt_state": o3}
+        carry["cfg"] = cfg
 
 
 def run_staging_bench(jax, results: dict):
@@ -826,14 +1257,20 @@ def main() -> int:
         results["mfu_small_error"] = repr(e)
     # the headline MFU: 1.5B full-update probe (one retry — at ~95% HBM
     # occupancy a transient allocation race can OOM a first attempt)
+    carry: dict = {}
     for attempt in (1, 2):
         try:
-            run_mfu_big(jax, results)
+            carry.clear()
+            run_mfu_big(jax, results, carry)
             results.pop("mfu_big_error", None)
             break
         except Exception as e:
             results["mfu_pct"] = None
             results["mfu_big_error"] = repr(e)
+    try:
+        run_flashckpt_1p5b(jax, results, carry)
+    except Exception as e:
+        results["flash_1p5b_error"] = repr(e)
     print(json.dumps(results))
     sys.stdout.flush()
     sys.stderr.flush()
@@ -844,4 +1281,11 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--goodput-child":
+        rc = goodput_child_main(sys.argv[2:])
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # tunneled-runtime teardown can abort after success (rc=134) —
+        # everything is written and flushed, exit without running it
+        os._exit(rc)
     sys.exit(main())

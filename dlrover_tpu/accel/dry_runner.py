@@ -224,6 +224,43 @@ def _analytic_estimate(
     report.est_source = "analytic"
 
 
+def _finalize_estimate(
+    report: DryRunReport, cfg: TransformerConfig, batch, seq, devices
+) -> None:
+    """Decide which estimate tier a report uses, then price it.
+
+    - empty cost analysis (flops == 0): CPU/virtual backends often
+      return nothing — "unknown", not "free"; use the analytic model so
+      candidates keep DISTINCT estimates and the sort stays meaningful.
+    - implausibly small cost analysis: the same backends can also
+      return a nonempty but bogus analysis (observed: est 7.4 µs for a
+      measured 26 ms step, 3,500x off, still labeled [xla]). Gate:
+      anything below a tenth of the analytic flops lower bound cannot
+      be a real count of this model's matmuls — fall back and label it,
+      so ranking-by-estimate cannot mis-prune before the timed
+      finalists run.
+    """
+    if report.flops_per_device > 0.0:
+        xla_flops = report.flops_per_device
+        xla_bytes = report.bytes_per_device
+        probe = DryRunReport(strategy=report.strategy, ok=False)
+        _analytic_estimate(probe, cfg, batch, seq, devices)
+        if xla_flops >= probe.flops_per_device / 10.0:
+            report.est_source = "xla"
+        else:
+            report.flops_per_device = probe.flops_per_device
+            report.bytes_per_device = max(
+                xla_bytes, probe.bytes_per_device
+            )
+            report.est_source = "analytic(xla-implausible)"
+    else:
+        _analytic_estimate(report, cfg, batch, seq, devices)
+    report.est_step_s = max(
+        report.flops_per_device * _SEC_PER_FLOP,
+        report.bytes_per_device * _SEC_PER_BYTE,
+    )
+
+
 def compiled_cost(
     strategy: Strategy,
     cfg: TransformerConfig,
@@ -254,16 +291,7 @@ def compiled_cost(
                 + getattr(ma, "temp_size_in_bytes", 0)
             )
         report.fits = hbm_fits(report.mem_bytes, hbm_budget)
-        if report.flops_per_device <= 0.0:
-            # tri-state, like `fits`: an empty cost_analysis() (CPU /
-            # virtual backends) means "unknown", not "free" — fall back
-            # to the analytic per-module model so candidates still get
-            # DISTINCT estimates and the sort stays meaningful
-            _analytic_estimate(report, cfg2, batch, seq, devices)
-        report.est_step_s = max(
-            report.flops_per_device * _SEC_PER_FLOP,
-            report.bytes_per_device * _SEC_PER_BYTE,
-        )
+        _finalize_estimate(report, cfg2, batch, seq, devices)
         report.ok = True
     except Exception as e:  # invalid factorization, OOM during compile, …
         report.error = f"{type(e).__name__}: {e}"
@@ -343,6 +371,25 @@ def dry_run(
         r.step_s, _ = timed_run(
             r.strategy, cfg, tx, batch, seq, devices, steps=timed_steps
         )
+    # self-calibrate the roofline: the static weights assume TPU-class
+    # peak numbers, so on any other backend (virtual CPU meshes in
+    # tests/dryruns) estimates are absolute nonsense even when the
+    # flops/bytes are right. The timed finalists ARE ground truth for
+    # this backend — rescale every estimate by the median
+    # measured/estimated ratio so printed ests live in real seconds
+    # (ranking is unchanged; the rescale is monotonic).
+    timed = [
+        r
+        for r in viable[:max_timed]
+        if r.step_s is not None and r.est_step_s > 0
+    ]
+    if timed:
+        calib = float(np.median([r.step_s / r.est_step_s for r in timed]))
+        if calib > 3.0 or calib < 1.0 / 3.0:
+            for r in reports:
+                if r.ok and r.est_step_s > 0:
+                    r.est_step_s *= calib
+                    r.est_source += "+calib"
 
     def rank(r: DryRunReport):
         """Same tier order as tpe_search: measured+fit < measured+unknown

@@ -247,12 +247,14 @@ class CheckpointEngine:
         return saver_mod.read_tracker(self.storage, checkpoint_dir)
 
     def load(
-        self, target: Any, checkpoint_dir: str
+        self, target: Any, checkpoint_dir: str, prefer_memory: bool = True
     ) -> Tuple[int, Optional[Any]]:
         """Restore ``target``-shaped state. Prefers shm when *every*
         process holds the same usable step at least as new as the committed
         one (fast elastic-restart path, engine.py:315), else reads the
-        committed step from storage.
+        committed step from storage. ``prefer_memory=False`` skips the shm
+        proposal entirely — the full-loss path (replacement node, no
+        surviving agent shm).
 
         The cross-process agreement mirrors the reference's
         ``verify_all_rank_step_consistent`` (engine.py:318): because
@@ -269,7 +271,7 @@ class CheckpointEngine:
         candidate = -1
         records = []
         got_lock = False
-        if self._agent_mode and self._shm is not None:
+        if prefer_memory and self._agent_mode and self._shm is not None:
             try:
                 got_lock = self._lock.acquire(blocking=True)
             except (TimeoutError, RuntimeError):

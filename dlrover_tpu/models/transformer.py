@@ -475,6 +475,79 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int):
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+def _cached_decode_layer(
+    x, layer, k_cache, v_cache, *, positions, mask, cfg, dt, write_kv
+):
+    """One cached transformer block: (x, this layer's K/V buffers) →
+    (x', K', V'). The ONLY thing that varies between the all-equal
+    decode (``forward_step``) and the per-slot ragged decode
+    (``forward_step_ragged``) is how new K/V lands in the cache —
+    ``write_kv`` — and the ``positions``/``mask`` the caller computed;
+    everything else (QKV, rope, muP scale, GQA attention, wo, MLP) is
+    this shared body, so the two entries cannot drift."""
+    B, t = x.shape[0], x.shape[1]
+    g = cfg.num_heads // cfg.kv_heads
+    h = _norm(x, layer["attn_norm"], cfg)
+    q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"].astype(dt))
+    k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"].astype(dt))
+    v = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wv"].astype(dt))
+    if cfg.rope:
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+    if cfg.mup_attn_scale is not None:
+        # same muP 1/d fold as _attention_block — decode must score
+        # with the training attention math
+        q = q * (cfg.mup_attn_scale * cfg.head_dim**0.5)
+    k_all = write_kv(k_cache, k)
+    v_all = write_kv(v_cache, v)
+    # GQA: fold the head group next to kv heads, no KV replication.
+    # fp32 accumulation throughout, matching the flash path's
+    # numerics (a bf16-accumulated decode would diverge from the
+    # teacher-forced re-scoring and bias PPO ratios)
+    qg = q.reshape(B, t, cfg.kv_heads, g, cfg.head_dim)
+    scores = jnp.einsum(
+        "btkgh,bskh->bkgts", qg, k_all,
+        preferred_element_type=jnp.float32,
+    ) * (cfg.head_dim**-0.5)
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum(
+        "bkgts,bskh->btkgh", probs, v_all,
+        preferred_element_type=jnp.float32,
+    ).astype(dt)
+    o = o.reshape(B, t, cfg.num_heads, cfg.head_dim)
+    x = x + jnp.einsum(
+        "bthk,hkd->btd", o, layer["attn"]["wo"].astype(dt)
+    )
+    x, _ = _mlp_block(x, layer, cfg, None)
+    return x, k_all, v_all
+
+
+def _run_cached_layers(x, params, cache, cfg, decode_layer):
+    """Drive ``decode_layer`` over every layer — scanned or unrolled —
+    returning (x, updated cache). Shared by both cached entries."""
+    if cfg.scan_layers:
+
+        def sbody(x, inp):
+            layer, k_cache, v_cache = inp
+            x, k_all, v_all = decode_layer(x, layer, k_cache, v_cache)
+            return x, (k_all, v_all)
+
+        x, (k_new, v_new) = lax.scan(
+            sbody, x, (params["layers"], cache["k"], cache["v"])
+        )
+        return x, {"k": k_new, "v": v_new}
+
+    new_k, new_v = [], []
+    for i, layer in enumerate(params["layers"]):
+        x, k_all, v_all = decode_layer(
+            x, layer, cache["k"][i], cache["v"][i]
+        )
+        new_k.append(k_all)
+        new_v.append(v_all)
+    return x, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
 def forward_step(
     params: Params,
     tokens: jnp.ndarray,
@@ -491,7 +564,6 @@ def forward_step(
     dt = _dtype(cfg)
     B, t = tokens.shape
     S = cache["k"].shape[2]
-    g = cfg.num_heads // cfg.kv_heads
 
     x = params["embed"]["tokens"].astype(dt)[tokens]
     positions = cur_len + jnp.arange(t)[None, :]  # [1, t] broadcasts to B
@@ -507,69 +579,17 @@ def forward_step(
     q_pos = positions[:, :, None]  # [B, t, 1]
     mask = key_pos <= q_pos  # [B, t, S]
 
-    def decode_layer(x, layer, k_cache, v_cache):
-        """One cached block: (x, this layer's K/V buffers) → (x', K',
-        V'). Shared verbatim by the unrolled loop and the scan path."""
-        h = _norm(x, layer["attn_norm"], cfg)
-        q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"].astype(dt))
-        k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"].astype(dt))
-        v = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wv"].astype(dt))
-        if cfg.rope:
-            q = _rope(q, positions, cfg.rope_theta)
-            k = _rope(k, positions, cfg.rope_theta)
-        if cfg.mup_attn_scale is not None:
-            # same muP 1/d fold as _attention_block — decode must score
-            # with the training attention math
-            q = q * (cfg.mup_attn_scale * cfg.head_dim**0.5)
-        k_all = lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, cur_len, 0, 0)
+    def write_kv(c, val):
+        return lax.dynamic_update_slice(
+            c, val.astype(c.dtype), (0, cur_len, 0, 0)
         )
-        v_all = lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, cur_len, 0, 0)
-        )
-        # GQA: fold the head group next to kv heads, no KV replication.
-        # fp32 accumulation throughout, matching the flash path's
-        # numerics (a bf16-accumulated decode would diverge from the
-        # teacher-forced re-scoring and bias PPO ratios)
-        qg = q.reshape(B, t, cfg.kv_heads, g, cfg.head_dim)
-        scores = jnp.einsum(
-            "btkgh,bskh->bkgts", qg, k_all,
-            preferred_element_type=jnp.float32,
-        ) * (cfg.head_dim**-0.5)
-        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum(
-            "bkgts,bskh->btkgh", probs, v_all,
-            preferred_element_type=jnp.float32,
-        ).astype(dt)
-        o = o.reshape(B, t, cfg.num_heads, cfg.head_dim)
-        x = x + jnp.einsum(
-            "bthk,hkd->btd", o, layer["attn"]["wo"].astype(dt)
-        )
-        x, _ = _mlp_block(x, layer, cfg, None)
-        return x, k_all, v_all
 
-    if cfg.scan_layers:
-
-        def sbody(x, inp):
-            layer, k_cache, v_cache = inp
-            x, k_all, v_all = decode_layer(x, layer, k_cache, v_cache)
-            return x, (k_all, v_all)
-
-        x, (k_new, v_new) = lax.scan(
-            sbody, x, (params["layers"], cache["k"], cache["v"])
-        )
-        logits = lm_head(params, x, cfg)
-        return logits, {"k": k_new, "v": v_new}
-
-    new_k, new_v = [], []
-    for i, layer in enumerate(params["layers"]):
-        x, k_all, v_all = decode_layer(x, layer, cache["k"][i], cache["v"][i])
-        new_k.append(k_all)
-        new_v.append(v_all)
-
-    logits = lm_head(params, x, cfg)
-    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    decode_layer = functools.partial(
+        _cached_decode_layer,
+        positions=positions, mask=mask, cfg=cfg, dt=dt, write_kv=write_kv,
+    )
+    x, new_cache = _run_cached_layers(x, params, cache, cfg, decode_layer)
+    return lm_head(params, x, cfg), new_cache
 
 
 def forward_step_ragged(
@@ -592,7 +612,6 @@ def forward_step_ragged(
     dt = _dtype(cfg)
     S_slots = tokens.shape[0]
     T = cache["k"].shape[2]
-    g = cfg.num_heads // cfg.kv_heads
     slot_ix = jnp.arange(S_slots)
 
     x = params["embed"]["tokens"].astype(dt)[tokens][:, None]  # [S,1,D]
@@ -603,59 +622,13 @@ def forward_step_ragged(
     key_pos = jnp.arange(T)[None, None, :]  # [1, 1, T]
     mask = key_pos <= positions[:, :, None]  # [S, 1, T]
 
-    def decode_layer(x, layer, k_cache, v_cache):
-        h = _norm(x, layer["attn_norm"], cfg)
-        q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"].astype(dt))
-        k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"].astype(dt))
-        v = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wv"].astype(dt))
-        if cfg.rope:
-            q = _rope(q, positions, cfg.rope_theta)
-            k = _rope(k, positions, cfg.rope_theta)
-        if cfg.mup_attn_scale is not None:
-            q = q * (cfg.mup_attn_scale * cfg.head_dim**0.5)
-        # per-slot scatter: cache[s, cur_lens[s]] = k[s, 0]
-        k_all = k_cache.at[slot_ix, cur_lens].set(
-            k[:, 0].astype(k_cache.dtype)
-        )
-        v_all = v_cache.at[slot_ix, cur_lens].set(
-            v[:, 0].astype(v_cache.dtype)
-        )
-        qg = q.reshape(S_slots, 1, cfg.kv_heads, g, cfg.head_dim)
-        scores = jnp.einsum(
-            "btkgh,bskh->bkgts", qg, k_all,
-            preferred_element_type=jnp.float32,
-        ) * (cfg.head_dim**-0.5)
-        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum(
-            "bkgts,bskh->btkgh", probs, v_all,
-            preferred_element_type=jnp.float32,
-        ).astype(dt)
-        o = o.reshape(S_slots, 1, cfg.num_heads, cfg.head_dim)
-        x = x + jnp.einsum(
-            "bthk,hkd->btd", o, layer["attn"]["wo"].astype(dt)
-        )
-        x, _ = _mlp_block(x, layer, cfg, None)
-        return x, k_all, v_all
+    def write_kv(c, val):
+        # per-slot scatter: cache[s, cur_lens[s]] = val[s, 0]
+        return c.at[slot_ix, cur_lens].set(val[:, 0].astype(c.dtype))
 
-    if cfg.scan_layers:
-
-        def sbody(x, inp):
-            layer, k_cache, v_cache = inp
-            x, k_all, v_all = decode_layer(x, layer, k_cache, v_cache)
-            return x, (k_all, v_all)
-
-        x, (k_new, v_new) = lax.scan(
-            sbody, x, (params["layers"], cache["k"], cache["v"])
-        )
-        return lm_head(params, x, cfg)[:, 0], {"k": k_new, "v": v_new}
-
-    new_k, new_v = [], []
-    for i, layer in enumerate(params["layers"]):
-        x, k_all, v_all = decode_layer(
-            x, layer, cache["k"][i], cache["v"][i]
-        )
-        new_k.append(k_all)
-        new_v.append(v_all)
-    logits = lm_head(params, x, cfg)[:, 0]  # [S, V]
-    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    decode_layer = functools.partial(
+        _cached_decode_layer,
+        positions=positions, mask=mask, cfg=cfg, dt=dt, write_kv=write_kv,
+    )
+    x, new_cache = _run_cached_layers(x, params, cache, cfg, decode_layer)
+    return lm_head(params, x, cfg)[:, 0], new_cache

@@ -935,6 +935,95 @@ def flash_attention_fwd(
     )
 
 
+def merge_partials(o_a, lse_a, o_b, lse_b):
+    """Online-softmax merge of two partial attention results over the
+    same queries, different key sets: ``o`` [B,T,H,D] f32 normalized,
+    ``lse`` [B,H,T] f32 log-sum-exp. The algebra ring attention uses
+    per hop (parallel/ring_attention.py), shared here so chunked
+    single-device attention and cross-device merges cannot diverge."""
+    lse_new = jnp.logaddexp(lse_a, lse_b)
+    w_a = jnp.exp(lse_a - lse_new)
+    w_b = jnp.exp(lse_b - lse_new)
+
+    def to_o(w):  # [B,H,T] -> [B,T,H,1]
+        return w.transpose(0, 2, 1)[..., None]
+
+    return o_a * to_o(w_a) + o_b * to_o(w_b), lse_new
+
+
+def flash_attention_fwd_chunked(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    sm_scale=None,
+    mask_fn: Optional[MaskFn] = None,
+    q_offset: int = 0,
+    k_offset: int = 0,
+    chunk: int = _FUSED_MAX_T,
+):
+    """Long-sequence forward as fused [chunk x chunk] tile calls plus
+    online-softmax merges (``merge_partials``) — the streaming kernel's
+    outer loop lifted to XLA level so every tile rides the fused
+    short-seq kernel. ``[B,T,H,D]`` layout; T must divide by ``chunk``.
+    Returns ``(o, lse[B,H,Tq])`` like ``flash_attention_fwd``.
+
+    Exists because the fused kernel caps at T=``_FUSED_MAX_T`` (the
+    [T,T] score tile must fit VMEM): a full-sequence caller (Ulysses'
+    per-device attention after its all-to-all) otherwise drops to the
+    streaming kernels for the WHOLE sequence, paying a different
+    kernel strategy than ring attention's naturally-chunked hops — the
+    like-for-like gap VERDICT r4 #8 flagged. Causal chunks below the
+    diagonal are skipped entirely (the work-skipping a causal streaming
+    grid does with masked blocks)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if Tq % chunk or Tk % chunk or chunk % 8:
+        raise ValueError(
+            f"{Tq=}/{Tk=} must divide into 8-aligned {chunk=}"
+        )
+    if not isinstance(q_offset, int) or not isinstance(k_offset, int):
+        raise ValueError(
+            "chunked driver needs static int offsets (tile skipping "
+            "is decided at trace time)"
+        )
+    n_q, n_k = Tq // chunk, Tk // chunk
+    o_parts, lse_parts = [], []
+    for i in range(n_q):
+        qi = lax.slice_in_dim(q, i * chunk, (i + 1) * chunk, axis=1)
+        o_acc = None
+        lse_acc = None
+        for j in range(n_k):
+            if causal and (
+                q_offset + (i + 1) * chunk - 1 < k_offset + j * chunk
+            ):
+                continue  # tile fully above the causal diagonal
+            o_j, lse_j = flash_attention_fwd(
+                qi,
+                lax.slice_in_dim(k, j * chunk, (j + 1) * chunk, axis=1),
+                lax.slice_in_dim(v, j * chunk, (j + 1) * chunk, axis=1),
+                causal=causal,
+                sm_scale=sm_scale,
+                mask_fn=mask_fn,
+                q_offset=q_offset + i * chunk,
+                k_offset=k_offset + j * chunk,
+            )
+            o_j = o_j.astype(jnp.float32)
+            if o_acc is None:
+                o_acc, lse_acc = o_j, lse_j
+            else:
+                o_acc, lse_acc = merge_partials(o_acc, lse_acc, o_j, lse_j)
+        if o_acc is None:  # every key after every query: empty softmax
+            o_acc = jnp.zeros((B, chunk, H, D), jnp.float32)
+            lse_acc = jnp.full((B, H, chunk), NEG_INF, jnp.float32)
+        o_parts.append(o_acc)
+        lse_parts.append(lse_acc)
+    o = jnp.concatenate(o_parts, axis=1).astype(q.dtype)
+    lse = jnp.concatenate(lse_parts, axis=2)
+    return o, lse
+
+
 def flash_attention_bwd(
     q,
     k,
@@ -1109,20 +1198,33 @@ def flash_attention(
     except ValueError:
         if force is not None:
             raise
-        # odd sequence length: the jnp path has no tiling constraint
-        return flash_attention(
-            q,
-            k,
-            v,
-            causal=causal,
-            sm_scale=scale,
-            mask_fn=mask_fn,
-            q_offset=q_offset,
-            k_offset=k_offset,
-            return_residuals=return_residuals,
-            force="reference",
-            layout=layout,
-        )
+        seq_axis = 2 if layout == "bhtd" else 1
+        if (
+            allow_fused
+            and q.shape[seq_axis] % 8 == 0
+            and k.shape[seq_axis] % 8 == 0
+            and _fused_eligible(q.shape, k.shape, layout)
+        ):
+            # block tiling is a STREAMING-kernel constraint; fused-kernel
+            # shapes (T<=_FUSED_MAX_T, e.g. T=520) have none beyond
+            # 8-alignment, so they stay on the Pallas path. The block
+            # sizes are unused there but must be valid.
+            bq = bk = 8
+        else:
+            # odd sequence length: the jnp path has no tiling constraint
+            return flash_attention(
+                q,
+                k,
+                v,
+                causal=causal,
+                sm_scale=scale,
+                mask_fn=mask_fn,
+                q_offset=q_offset,
+                k_offset=k_offset,
+                return_residuals=return_residuals,
+                force="reference",
+                layout=layout,
+            )
     if return_residuals:
         # raw forward — callers own the VJP (e.g. the ring merge)
         return flash_attention_fwd(

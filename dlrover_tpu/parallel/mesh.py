@@ -86,11 +86,33 @@ def build_mesh(
             1 if a in config.dcn_axes else getattr(config, a)
             for a in AXIS_ORDER
         )
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            ici_sizes,
-            dcn_mesh_shape=dcn_sizes,
-            devices=devices,
+        has_slice_meta = (
+            getattr(list(devices)[0], "slice_index", None) is not None
         )
+        if has_slice_meta:
+            # real multi-slice hardware: a config/topology mismatch here
+            # is a REAL error — emulating would silently route fsdp/tp
+            # collectives over DCN
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_sizes,
+                dcn_mesh_shape=dcn_sizes,
+                devices=devices,
+            )
+        else:
+            # CPU/virtual devices carry no slice metadata (slice_index);
+            # emulate the hybrid layout — DCN axes get the LARGEST
+            # strides (outermost), so consecutive devices ("one slice")
+            # stay adjacent on the ICI axes, which is the property the
+            # hybrid mesh exists to provide
+            order = [a for a in AXIS_ORDER if a in config.dcn_axes] + [
+                a for a in AXIS_ORDER if a not in config.dcn_axes
+            ]
+            arr = np.asarray(list(devices)).reshape(
+                [getattr(config, a) for a in order]
+            )
+            dev_array = arr.transpose(
+                [order.index(a) for a in AXIS_ORDER]
+            )
     else:
         try:
             dev_array = mesh_utils.create_device_mesh(

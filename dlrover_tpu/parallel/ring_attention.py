@@ -123,15 +123,11 @@ def ring_attention_local(
 # kernel-backed ring: flash-attention Pallas kernel per KV hop
 # ---------------------------------------------------------------------------
 def _merge_partials(o_a, lse_a, o_b, lse_b):
-    """Online-softmax merge of two normalized partial attentions.
+    """Online-softmax merge — the shared helper in ops.flash_attention
+    (one algebra for ring hops AND chunked single-device attention)."""
+    from dlrover_tpu.ops.flash_attention import merge_partials
 
-    ``o`` [B,T,H,D] f32 normalized, ``lse`` [B,H,T] f32 log-sum-exp.
-    """
-    lse_new = jnp.logaddexp(lse_a, lse_b)
-    w_a = jnp.exp(lse_a - lse_new)  # [B,H,T]
-    w_b = jnp.exp(lse_b - lse_new)
-    to_o = lambda w: w.transpose(0, 2, 1)[..., None]  # noqa: E731
-    return o_a * to_o(w_a) + o_b * to_o(w_b), lse_new
+    return merge_partials(o_a, lse_a, o_b, lse_b)
 
 
 def _ring_fwd_scan(q, k, v, axis_name, causal, sm_scale, mask_fn):

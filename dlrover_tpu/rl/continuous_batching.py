@@ -79,11 +79,22 @@ def continuous_generate(
     generated part (zero-padded), and the total sequence length. A
     sequence stops at ``eos_id`` (the EOS token is kept, budget
     permitting) or after ``max_new_tokens``.
+
+    Tail-latency note: once the prompt queue drains, idle slots
+    (``pidx == N``) still run full forward passes and dummy sampling
+    each iteration until the slowest active slot finishes — the price
+    of static shapes under ``lax.while_loop``. With ``slots`` far above
+    the expected concurrency, that idle work can dominate the tail;
+    size ``slots`` to the live prompt count.
     """
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if top_k < 0:
         raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not greedy and temperature <= 0.0:
+        raise ValueError(
+            f"temperature must be > 0 for sampling, got {temperature}"
+        )
     N, P_max = prompts.shape
     S = min(slots, N)
     T = P_max + max_new_tokens

@@ -23,6 +23,13 @@ def main() -> int:
     ctx = init_elastic()
     import jax.numpy as jnp
 
+    world_log = os.getenv("CHAOS_WORLD_LOG", "")
+    if world_log:
+        # slice-unit tests assert every frozen world honored node_unit:
+        # append this incarnation's (rdzv_round, node_num) observation
+        with open(world_log, "a") as f:
+            f.write(f"{ctx.rdzv_round} {ctx.node_num}\n")
+
     total = int(os.getenv("CHAOS_STEPS", "60"))
     step_secs = float(os.getenv("CHAOS_STEP_SECS", "0.2"))
     # ONE shared dir for the whole job: the commit protocol counts done

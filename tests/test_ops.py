@@ -169,6 +169,51 @@ class TestFusedShortSeq:
         np.testing.assert_allclose(of, os_, atol=2e-5)
         np.testing.assert_allclose(lf, ls, atol=2e-5)
 
+    def test_chunked_fwd_matches_full(self):
+        """flash_attention_fwd_chunked (fused tiles + online merges)
+        must equal the one-call forward — same o AND lse, causal and
+        non-causal, so Ulysses' full-seq path can chunk onto the fused
+        kernel without a numerics change."""
+        from dlrover_tpu.ops.flash_attention import (
+            flash_attention_fwd_chunked,
+        )
+
+        q, k, v = _qkv(T=256)
+        for causal in (True, False):
+            o_full, lse_full = flash_attention_fwd(
+                q, k, v, causal=causal, block_q=64
+            )
+            o_ch, lse_ch = flash_attention_fwd_chunked(
+                q, k, v, causal=causal, chunk=64
+            )
+            np.testing.assert_allclose(
+                np.asarray(o_ch, np.float32),
+                np.asarray(o_full, np.float32),
+                atol=3e-5,
+            )
+            np.testing.assert_allclose(lse_ch, lse_full, atol=3e-5)
+
+    def test_chunked_fwd_respects_offsets(self):
+        """Global q/k offsets flow through to every tile (a ring hop
+        holding a chunked long block must mask correctly)."""
+        from dlrover_tpu.ops.flash_attention import (
+            flash_attention_fwd_chunked,
+        )
+
+        q, k, v = _qkv(T=128)
+        o_full, lse_full = flash_attention_fwd(
+            q, k, v, causal=True, q_offset=128, k_offset=0, block_q=64
+        )
+        o_ch, lse_ch = flash_attention_fwd_chunked(
+            q, k, v, causal=True, q_offset=128, k_offset=0, chunk=64
+        )
+        np.testing.assert_allclose(
+            np.asarray(o_ch, np.float32),
+            np.asarray(o_full, np.float32),
+            atol=3e-5,
+        )
+        np.testing.assert_allclose(lse_ch, lse_full, atol=3e-5)
+
     def test_bwd_matches_streaming(self):
         q, k, v = _qkv()
         o, lse = flash_attention_fwd(q, k, v, causal=True, block_q=64)

@@ -130,6 +130,9 @@ def candidate_strategies(
                                 dtype=dtype,
                                 num_microbatches=mb,
                                 grad_accum=1 if pp > 1 else grad_accum,
+                                # sp candidates pick their scheme from
+                                # the measured table (sp_select)
+                                opts=("sp_auto",) if sp > 1 else (),
                             )
                         )
                         # deep models with few microbatches: the

@@ -114,3 +114,22 @@ register_optimization(
     "interleaved",
     lambda cfg, s: (cfg, dc_replace(s, pp_schedule="interleaved")),
 )
+
+
+def _apply_sp_auto(cfg, s):
+    from dlrover_tpu.parallel.sp_select import pick_sp_scheme
+
+    if s.mesh.sp <= 1:
+        return cfg, s
+    return (
+        dc_replace(
+            cfg, sp_scheme=pick_sp_scheme(cfg.max_seq_len)
+        ),
+        s,
+    )
+
+
+# sequence-parallel candidates carry this by default: the scheme is
+# read from the measured kernel-strategy-constant table
+# (parallel/sp_select.py) instead of whatever the config hardcodes
+register_optimization("sp_auto", _apply_sp_auto, tunable=True)

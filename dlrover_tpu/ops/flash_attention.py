@@ -130,8 +130,10 @@ def _fwd_kernel(
         alpha = jnp.exp(m_prev - m_safe)
         p = jnp.exp(s - m_safe)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p in input precision for the MXU (f32 operands run the
+        # systolic array at a fraction of bf16 rate); f32 accumulator
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p,
+            p.astype(v_ref.dtype),
             v_ref[0, 0],
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -247,7 +249,7 @@ def _fwd_pallas(
 # fused short-sequence kernels (one program per batch element)
 # ---------------------------------------------------------------------------
 # Eligibility: the [T, T] f32 score tile must fit scoped VMEM (see
-# _head_chunk, which sizes head chunks against a 24 MB live-set budget
+# _head_chunk, which sizes head chunks against a 48 MB live-set budget
 # under the raised _FUSED_VMEM_LIMIT). At T=2048 a single head's
 # backward live set (~3.5 x 16 MB) no longer fits; the streaming
 # kernels take over there.
@@ -298,8 +300,12 @@ def _fused_fwd_kernel(
             m_safe = jnp.where(m > NEG_INF * 0.5, m, 0.0)
             p = jnp.exp(s - m_safe)
             l = jnp.sum(p, axis=-1, keepdims=True)
+            # p rides the MXU in the INPUT precision (f32 operands run
+            # the systolic array at a fraction of bf16 rate); the
+            # accumulator stays f32 via preferred_element_type
             acc = jax.lax.dot_general(
-                p, v_ref[0, h], (((1,), (0,)), ((), ())),
+                p.astype(v_ref.dtype), v_ref[0, h],
+                (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             safe_l = jnp.where(l > 0.0, l, 1.0)
@@ -365,10 +371,14 @@ def _fused_bwd_kernel(
             lse = lse_ref[0, h]  # [T, 1]
             row_valid = lse > NEG_INF * 0.5
             p = jnp.where(row_valid, jnp.exp(s - lse), 0.0)  # [T, T]
-            do = do_ref[0, h].astype(jnp.float32)
+            # every grad matmul feeds the MXU input-precision operands
+            # (f32 operands run the systolic array at a fraction of
+            # bf16 rate); accumulation stays f32
+            p_lo = p.astype(q_ref.dtype)
+            do = do_ref[0, h]
             # dv = p^T @ do
             dv_ref[0, h] = jax.lax.dot_general(
-                p, do, (((0,), (0,)), ((), ())),
+                p_lo, do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ).astype(dv_ref.dtype)
             dp = jax.lax.dot_general(
@@ -376,13 +386,14 @@ def _fused_bwd_kernel(
                 preferred_element_type=jnp.float32,
             )
             ds = p * (dp - delta_ref[0, h]) * sm_scale  # [T, T]
+            ds_lo = ds.astype(q_ref.dtype)
             dq_ref[0, h] = jax.lax.dot_general(
-                ds, k, (((1,), (0,)), ((), ())),
+                ds_lo, k, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ).astype(dq_ref.dtype)
             # dk = ds^T @ q
             dk_ref[0, h] = jax.lax.dot_general(
-                ds, q, (((0,), (0,)), ((), ())),
+                ds_lo, q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ).astype(dk_ref.dtype)
 
@@ -406,7 +417,12 @@ def _head_chunk(H: int, T: int, live_f32_per_head: float) -> int:
     occupy scoped VMEM stack; chunk so ``Hc * live set`` stays under a
     conservative budget (the raised ``vmem_limit_bytes`` leaves slack for
     the compiler's own scheduling)."""
-    budget = 24 * 1024 * 1024
+    # measured on v5e (bf16, D=64/128): larger chunks amortize
+    # per-program overhead — T=512 all-12-heads beats 9 by 27%, T=1024
+    # Hc=4 beats Hc=2 by 28% — and Mosaic tolerates a live set past
+    # physical VMEM by scheduling spills; the hard compile failure on
+    # v5e lands near ~64 MB x live-factor, so 48 MB keeps margin
+    budget = 48 * 1024 * 1024
     per_head = live_f32_per_head * T * T * 4
     best = 1
     for d in range(1, H + 1):
@@ -539,7 +555,9 @@ def _bwd_dq_kernel(
         # forward zeroed — zero p explicitly
         row_valid = lse > NEG_INF * 0.5
         p = jnp.where(row_valid, jnp.exp(s - lse), 0.0)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # MXU operands stay in input precision (f32 operands run the
+        # systolic array at a fraction of bf16 rate); f32 accumulation
+        do = do_ref[0, 0]
         dp = jax.lax.dot_general(
             do,
             v_ref[0, 0],
@@ -549,7 +567,7 @@ def _bwd_dq_kernel(
         delta = delta_ref[0, 0, :, :1]
         ds = p * (dp - delta) * sm_scale
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
-            ds,
+            ds.astype(k.dtype),
             k,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -615,10 +633,12 @@ def _bwd_dkv_kernel(
         # zero p on fully-masked rows (see _bwd_dq_kernel)
         row_valid = lse > NEG_INF * 0.5
         p = jnp.where(row_valid, jnp.exp(s - lse), 0.0)  # [bq, bk]
-        do = do_ref[0, 0].astype(jnp.float32)
+        # MXU operands stay in input precision (f32 operands run the
+        # systolic array at a fraction of bf16 rate); f32 accumulation
+        do = do_ref[0, 0]
         # dv += p^T @ do
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-            p,
+            p.astype(do.dtype),
             do,
             (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -633,7 +653,7 @@ def _bwd_dkv_kernel(
         ds = p * (dp - delta) * sm_scale  # [bq, bk]
         # dk += ds^T @ q
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
-            ds,
+            ds.astype(q.dtype),
             q,
             (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -1204,6 +1224,16 @@ def flash_attention(
             and q.shape[seq_axis] % 8 == 0
             and k.shape[seq_axis] % 8 == 0
             and _fused_eligible(q.shape, k.shape, layout)
+            # the differentiable pallas path below needs static offsets;
+            # traced-offset callers keep the jnp fallback (the raw-fwd
+            # return_residuals path handles traced offsets fine)
+            and (
+                return_residuals
+                or (
+                    isinstance(q_offset, int)
+                    and isinstance(k_offset, int)
+                )
+            )
         ):
             # block tiling is a STREAMING-kernel constraint; fused-kernel
             # shapes (T<=_FUSED_MAX_T, e.g. T=520) have none beyond

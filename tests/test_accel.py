@@ -164,6 +164,38 @@ def test_cost_estimate_gates_implausible_xla_analysis():
     assert sane.est_step_s > 0
 
 
+def test_sp_auto_reads_measured_table():
+    """sp candidates carry the sp_auto optimization; applying it sets
+    cfg.sp_scheme from the measured kernel-constant table
+    (parallel/sp_select.py) — VERDICT r4 #8."""
+    import dataclasses
+
+    from dlrover_tpu.accel.opt_lib import apply_optimizations
+    from dlrover_tpu.parallel.sp_select import MEASURED_MS, pick_sp_scheme
+
+    cfg = dataclasses.replace(tiny(), max_seq_len=4096)
+    s = Strategy(mesh=MeshConfig(sp=4, dp=2), opts=("sp_auto",))
+    cfg2, s2 = apply_optimizations(cfg, s, s.opts)
+    assert cfg2.sp_scheme == pick_sp_scheme(4096)
+    # the table is the source of truth: a fake table must flip the pick
+    orig = dict(MEASURED_MS)
+    try:
+        MEASURED_MS.clear()
+        MEASURED_MS[4096] = {"ring": 10.0, "ulysses": 1.0}
+        assert pick_sp_scheme(4096) == "ulysses"
+        MEASURED_MS[4096] = {"ring": 1.0, "ulysses": 1.05}
+        assert pick_sp_scheme(4096) == "ring"  # tie -> comm overlap
+    finally:
+        MEASURED_MS.clear()
+        MEASURED_MS.update(orig)
+    # non-sp strategies are untouched
+    cfg3, _ = apply_optimizations(
+        cfg, Strategy(mesh=MeshConfig(dp=8), opts=("sp_auto",)),
+        ("sp_auto",),
+    )
+    assert cfg3.sp_scheme == cfg.sp_scheme
+
+
 def test_memory_gate_beats_naive_dp():
     """With an HBM budget only a sharded layout satisfies, the search
     must reject replicated-param DP and pick a non-trivial mesh."""

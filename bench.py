@@ -1135,6 +1135,54 @@ def run_staging_bench(jax, results: dict):
         shm.unlink()
 
 
+def run_coworker_feed(results: dict):
+    """Cross-host coworker data plane throughput (VERDICT r4 #5): a
+    DataNodeServer streaming batches over TCP into a trainer-side
+    RemoteBatchFeeder (fetcher processes -> local shm ring -> consumer).
+    Loopback TCP on this host — an upper bound for the network leg, an
+    honest end-to-end number for serialize + socket + decode + shm-ring
+    machinery."""
+    from dlrover_tpu.data.remote_feed import (
+        DataNodeServer,
+        RemoteBatchFeeder,
+    )
+
+    n_batches, mb = 16, 16
+    batch = {
+        "x": np.arange(mb << 18, dtype=np.int32).reshape(-1, 1024),
+        "y": np.ones((mb << 8,), np.float32),
+    }
+    nbytes = sum(a.nbytes for a in batch.values())
+
+    def gen():
+        for _ in range(n_batches):
+            yield batch
+
+    server = feeder = None
+    try:
+        server = DataNodeServer(gen(), host="127.0.0.1")
+        feeder = RemoteBatchFeeder(
+            [f"127.0.0.1:{server.port}"], fetchers_per_node=2,
+            slot_bytes=(mb + 4) << 20, name="bench_feed",
+        )
+        t0 = time.perf_counter()
+        got = sum(1 for _ in feeder)
+        dt = time.perf_counter() - t0
+        assert got == n_batches, got
+        results["coworker_feed_MBps"] = round(
+            n_batches * nbytes / dt / 1e6, 1
+        )
+        results["coworker_feed_note"] = (
+            f"{n_batches} x {nbytes >> 20} MB batches, TCP data node -> "
+            "2 fetcher procs -> shm ring -> trainer iterator, loopback"
+        )
+    finally:
+        if feeder is not None:
+            feeder.close()
+        if server is not None:
+            server.close()
+
+
 def run_mfu(jax, results: dict):
     """Compute-bound probe: GPT-2 124M, bf16, on-device data, chained
     state. No checkpointing, no host transfers inside the timed region.
@@ -1251,6 +1299,11 @@ def main() -> int:
     except Exception as e:
         results["sp_ring_attn_ms"] = None
         results["sp_compare_error"] = repr(e)
+    try:
+        run_coworker_feed(results)
+    except Exception as e:
+        results["coworker_feed_MBps"] = None
+        results["coworker_feed_error"] = repr(e)
     try:
         run_mfu(jax, results)
     except Exception as e:

@@ -1,0 +1,387 @@
+"""Cross-host coworker data plane.
+
+Parity: atorch feeds preprocessed batches from dedicated coworker
+PODS over gRPC into the training hosts' shared memory
+(atorch/atorch/distributed/distributed.py:489 ``_build_grpc_networks``,
+atorch/atorch/data/shm_context.py:139,527, coworker_dataset.py). The
+TPU translation keeps the same shape with two pieces:
+
+- ``DataNodeServer`` runs on a CPU-rich data node: local coworker
+  processes (the intra-node ``ShmDataFeeder``) preprocess batches, and
+  a TCP server hands them to whichever trainer host asks next — the
+  pull protocol load-balances and back-pressures for free, and a batch
+  is handed out exactly once (global round-robin across trainer hosts
+  = dynamic sharding, consistent with the master's batch-level
+  dispatch model).
+- ``RemoteBatchFeeder`` runs on each trainer host: fetcher processes
+  pull batches over TCP and drain them into the SAME local shm ring
+  the intra-node feeder uses, so the training loop's consumption path
+  is identical whether batches are produced on-host or across DCN.
+
+Discovery is master-mediated: data nodes register
+``data_node/<name> -> host:port`` in the master KV store
+(master/kv_store.py) and trainers look the addresses up — no extra
+service, and the master's failover snapshot carries the registry.
+
+The wire format is pickle-free (length-prefixed JSON tree spec + raw
+array bytes): the network boundary has the same trust model as
+``common/comm.py``'s restricted unpickler — a compromised peer must
+not get arbitrary-object deserialization.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+_LEN = struct.Struct("<Q")
+_GET = b"GET\n"
+KV_PREFIX = "data_node/"
+
+
+# ---------------------------------------------------------------------------
+# pickle-free batch wire format
+# ---------------------------------------------------------------------------
+def _encode_tree(obj: Any, arrays: List[np.ndarray]):
+    """Batch pytree -> JSON-able spec; arrays collected by position."""
+    if isinstance(obj, dict):
+        return {
+            "t": "dict",
+            "k": list(obj.keys()),
+            "v": [_encode_tree(obj[k], arrays) for k in obj],
+        }
+    if isinstance(obj, (list, tuple)):
+        return {
+            "t": "list" if isinstance(obj, list) else "tuple",
+            "v": [_encode_tree(x, arrays) for x in obj],
+        }
+    if isinstance(obj, (np.ndarray, np.generic)):
+        arr = np.asarray(obj)
+        # reshape back: ascontiguousarray promotes 0-d to (1,)
+        arrays.append(np.ascontiguousarray(arr).reshape(arr.shape))
+        # dtype/shape live ONLY in the header's arrays list (one
+        # source of truth for decoding)
+        return {"t": "arr", "i": len(arrays) - 1}
+    if isinstance(obj, (bool, int, float, str)) or obj is None:
+        return {"t": "val", "v": obj}
+    raise TypeError(
+        f"unsupported leaf {type(obj).__name__} in batch (numpy arrays, "
+        f"scalars and dict/list/tuple nesting only — the wire format is "
+        f"deliberately pickle-free)"
+    )
+
+
+def _decode_tree(spec: Any, arrays: List[np.ndarray]):
+    t = spec["t"]
+    if t == "dict":
+        return {
+            k: _decode_tree(v, arrays)
+            for k, v in zip(spec["k"], spec["v"])
+        }
+    if t in ("list", "tuple"):
+        out = [_decode_tree(v, arrays) for v in spec["v"]]
+        return out if t == "list" else tuple(out)
+    if t == "arr":
+        return arrays[spec["i"]]
+    return spec["v"]
+
+
+def encode_batch(batch: Any) -> bytes:
+    arrays: List[np.ndarray] = []
+    spec = _encode_tree(batch, arrays)
+    header = json.dumps(
+        {
+            "spec": spec,
+            "arrays": [
+                {"d": a.dtype.str, "s": list(a.shape)} for a in arrays
+            ],
+        }
+    ).encode()
+    parts = [_LEN.pack(len(header)), header]
+    for a in arrays:
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def decode_batch(payload: bytes) -> Any:
+    (hlen,) = _LEN.unpack_from(payload, 0)
+    header = json.loads(payload[_LEN.size : _LEN.size + hlen])
+    off = _LEN.size + hlen
+    arrays = []
+    for meta in header["arrays"]:
+        dt = np.dtype(meta["d"])
+        shape = tuple(meta["s"])
+        count = int(np.prod(shape))  # () -> 1, any 0-dim -> 0
+        arrays.append(
+            np.frombuffer(payload, dt, count=count, offset=off)
+            .reshape(shape)
+            .copy()
+        )
+        off += count * dt.itemsize
+    return _decode_tree(header["spec"], arrays)
+
+
+# ---------------------------------------------------------------------------
+# data-node server
+# ---------------------------------------------------------------------------
+def _default_advertise_host() -> str:
+    try:
+        import socket as _s
+
+        host = _s.gethostbyname(_s.gethostname())
+        if not host.startswith("127."):
+            return host
+    except OSError:
+        pass
+    logger.warning(
+        "data node advertising loopback (no resolvable host address; "
+        "set DLROVER_TPU_NODE_IP for cross-host discovery)"
+    )
+    return "127.0.0.1"
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class DataNodeServer:
+    """Serve batches from ``source`` (any iterator of batch pytrees —
+    typically a local ``ShmDataFeeder`` whose coworker processes do the
+    preprocessing) to trainer hosts over TCP.
+
+    Each ``GET`` pops the next batch under a lock: N trainer hosts
+    pulling concurrently partition the stream without coordination.
+    After exhaustion every GET answers a 0-length frame (end of
+    stream)."""
+
+    def __init__(
+        self,
+        source: Iterator[Any],
+        host: str = "0.0.0.0",
+        port: int = 0,
+        name: str = "data0",
+        master_client=None,
+        advertise_host: Optional[str] = None,
+    ):
+        self._source = iter(source)
+        self._lock = threading.Lock()
+        self._done = False
+        # batches popped-but-undelivered (trainer died mid-send) are
+        # requeued here so a surviving trainer gets them
+        self._retry: List[bytes] = []
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self.name = name
+        self._threads: List[threading.Thread] = []
+        self._conns: set = set()
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name=f"datanode-{name}"
+        )
+        self._accept_thread.start()
+        if master_client is not None:
+            self.register(master_client, advertise_host)
+
+    def register(
+        self, master_client, advertise_host: Optional[str] = None
+    ):
+        """Publish ``data_node/<name> -> host:port`` in the master KV
+        store so trainers can discover this node. The advertised host
+        must be reachable from the TRAINER hosts: explicit argument,
+        then ``DLROVER_TPU_NODE_IP``, then this host's resolved
+        address (loopback only as a last resort)."""
+        import os
+
+        host = (
+            advertise_host
+            or os.getenv("DLROVER_TPU_NODE_IP")
+            or _default_advertise_host()
+        )
+        master_client.kv_store_set(
+            KV_PREFIX + self.name, f"{host}:{self.port}".encode()
+        )
+
+    def _next_payload(self) -> bytes:
+        with self._lock:
+            if self._retry:
+                return self._retry.pop()
+            if self._done:
+                return b""
+            try:
+                batch = next(self._source)
+            except StopIteration:
+                self._done = True
+                return b""
+        return encode_batch(batch)
+
+    def _serve_conn(self, conn: socket.socket):
+        payload = None
+        try:
+            with conn:
+                while not self._stop.is_set():
+                    req = _recv_exact(conn, len(_GET))
+                    if req != _GET:
+                        logger.warning(
+                            f"data node {self.name}: bad request {req!r}"
+                        )
+                        return
+                    payload = self._next_payload()
+                    conn.sendall(_LEN.pack(len(payload)) + payload)
+                    if not payload:
+                        return
+                    payload = None  # delivered
+        except (ConnectionError, OSError):
+            # trainer went away mid-delivery: requeue the popped batch
+            # for a surviving trainer (redelivery is safe — the dead
+            # trainer never consumed it)
+            if payload:
+                with self._lock:
+                    self._retry.append(payload)
+                logger.warning(
+                    f"data node {self.name}: trainer dropped mid-send; "
+                    f"requeued its batch"
+                )
+        finally:
+            self._conns.discard(conn)
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            self._conns.add(conn)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            # reap finished connection threads as new ones arrive
+            self._threads = [
+                th for th in self._threads if th.is_alive()
+            ] + [t]
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        # unblock threads parked in _recv_exact on idle connections
+        for conn in list(self._conns):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# trainer-side remote feeder
+# ---------------------------------------------------------------------------
+def _pull_stream(worker_id: int, addrs: List[str]) -> Iterator[Any]:
+    """Coworker-process body: pull batches from this worker's data node
+    until end-of-stream. Runs inside a ``ShmDataFeeder`` worker process,
+    so decode + network wait never touch the trainer's GIL.
+
+    A timeout or connection failure RAISES (after a log line) instead of
+    ending the stream: the feeder's liveness poll then reports the dead
+    fetcher loudly, rather than silently truncating the epoch."""
+    import os
+
+    timeout = float(os.getenv("DLROVER_TPU_FEED_TIMEOUT", "600"))
+    addr = addrs[worker_id % len(addrs)]
+    host, port = addr.rsplit(":", 1)
+    conn = socket.create_connection((host, int(port)), timeout=timeout)
+    try:
+        while True:
+            conn.sendall(_GET)
+            try:
+                (n,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
+                if n == 0:
+                    return
+                yield decode_batch(_recv_exact(conn, n))
+            except (socket.timeout, ConnectionError, OSError) as e:
+                logger.error(
+                    f"remote feed fetcher {worker_id}: data node "
+                    f"{addr} failed mid-stream ({e!r}); aborting so the "
+                    f"truncation is loud, not silent"
+                )
+                raise
+    finally:
+        conn.close()
+
+
+def discover_data_nodes(
+    master_client, names: Optional[List[str]] = None,
+    timeout: float = 60.0,
+) -> List[str]:
+    """Resolve registered data-node addresses from the master KV store.
+    With ``names`` given, waits for exactly those registrations."""
+    import time as _time
+
+    if names is None:
+        names = ["data0"]
+    deadline = _time.time() + timeout
+    addrs = []
+    for name in names:
+        while True:
+            raw = master_client.kv_store_get(KV_PREFIX + name)
+            if raw:
+                addrs.append(raw.decode())
+                break
+            if _time.time() > deadline:
+                raise TimeoutError(
+                    f"data node {name!r} never registered in master KV"
+                )
+            _time.sleep(0.3)
+    return addrs
+
+
+class RemoteBatchFeeder:
+    """Trainer-host facade: fetcher processes pull from ``addrs`` and
+    drain into the local shm ring; iterate it like the intra-node
+    ``ShmDataFeeder`` (same consumption path, ref shm_context.py:527).
+    """
+
+    def __init__(
+        self,
+        addrs: List[str],
+        fetchers_per_node: int = 1,
+        slot_bytes: int = 16 << 20,
+        slots_per_worker: int = 2,
+        name: str = "",
+    ):
+        import functools
+
+        from dlrover_tpu.data.shm_feed import ShmDataFeeder
+
+        self._feeder = ShmDataFeeder(
+            functools.partial(_pull_stream, addrs=list(addrs)),
+            num_workers=max(1, len(addrs) * fetchers_per_node),
+            slot_bytes=slot_bytes,
+            slots_per_worker=slots_per_worker,
+            name=name,
+        )
+
+    def __iter__(self):
+        return iter(self._feeder)
+
+    def close(self):
+        self._feeder.close()

@@ -148,6 +148,7 @@ def _worker_main(
     import queue as _queue
 
     writer = ShmBatchWriter(name, slot_bytes)
+    clean = False
     try:
         for batch in produce_fn(worker_id):
             while True:
@@ -162,8 +163,14 @@ def _worker_main(
                         f"shm feed worker {worker_id}: ring full, "
                         f"trainer busy; retrying"
                     )
+        clean = True
     finally:
-        writer._ready.put(ShmBatchReader.stop_token(worker_id))
+        # STOP only on clean exhaustion: a producer that DIED (network
+        # fetch failure, crash) must be reported by the reader's
+        # liveness poll as a dead worker, not read as a finished stream
+        # — silent epoch truncation is the failure mode this guards
+        if clean:
+            writer._ready.put(ShmBatchReader.stop_token(worker_id))
         writer.close()
 
 

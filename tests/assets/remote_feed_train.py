@@ -1,0 +1,39 @@
+"""Trainer-node script for the cross-host coworker data-plane e2e:
+discover the data node via the master KV store, pull batches through
+the remote feeder into the local shm ring, consume, report totals."""
+
+import os
+import sys
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.data.remote_feed import (
+    RemoteBatchFeeder,
+    discover_data_nodes,
+)
+from dlrover_tpu.trainer.elastic.distributed import init_elastic
+
+
+def main() -> int:
+    ctx = init_elastic()
+    client = MasterClient(
+        ctx.master_addr, node_id=ctx.node_rank, node_type="worker"
+    )
+    addrs = discover_data_nodes(client, timeout=60)
+    feeder = RemoteBatchFeeder(addrs, name=f"rf{os.getpid()}")
+    count = 0
+    total = 0
+    try:
+        for batch in feeder:
+            count += 1
+            total += int(batch["x"].sum())
+    finally:
+        feeder.close()
+    out = os.environ["RF_OUT"]
+    with open(f"{out}.{ctx.node_rank}", "w") as f:
+        f.write(f"{count} {total}")
+    print(f"node {ctx.node_rank}: {count} batches", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

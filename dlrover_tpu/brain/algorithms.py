@@ -254,13 +254,28 @@ def underperformance_check(
 
 
 def bad_node_exclusion(
-    ds: Datastore, now: Optional[float] = None
+    ds: Datastore, now: Optional[float] = None, cluster: str = "default"
 ) -> Tuple[str, ...]:
     """Hostnames condemned by the CLUSTER's recent evidence: an
     oom/failed event in >= BAD_NODE_MIN_JOBS distinct jobs, or sustained
     hot-cpu events (>= HOT_MIN_EVENTS at >= HOT_CPU_THRESHOLD%), all
-    within ``BAD_NODE_WINDOW_S``."""
+    within ``BAD_NODE_WINDOW_S``. Datastores exposing per-cluster
+    config records (``cluster_config``) can override the thresholds
+    with ``bad_node_min_jobs`` / ``hot_cpu_threshold`` /
+    ``hot_min_events`` — the reference Brain's multi-tenant config."""
     now = time.time() if now is None else now
+    cfg: Dict[str, str] = {}
+    get_cfg = getattr(ds, "cluster_config", None)
+    if get_cfg is not None:
+        try:
+            cfg = get_cfg(cluster) or {}
+        except Exception:
+            cfg = {}
+    min_jobs = int(cfg.get("bad_node_min_jobs", BAD_NODE_MIN_JOBS))
+    hot_threshold = float(
+        cfg.get("hot_cpu_threshold", HOT_CPU_THRESHOLD)
+    )
+    hot_min = int(cfg.get("hot_min_events", HOT_MIN_EVENTS))
     jobs_by_host: Dict[str, set] = {}
     hot_counts: Dict[str, int] = {}
     for e in ds.node_events(since_ts=now - BAD_NODE_WINDOW_S):
@@ -268,14 +283,12 @@ def bad_node_exclusion(
             continue
         if e.event in ("oom", "failed"):
             jobs_by_host.setdefault(e.hostname, set()).add(e.job_name)
-        elif e.event == "hot" and e.cpu_percent >= HOT_CPU_THRESHOLD:
+        elif e.event == "hot" and e.cpu_percent >= hot_threshold:
             hot_counts[e.hostname] = hot_counts.get(e.hostname, 0) + 1
     bad = {
-        h
-        for h, jobs in jobs_by_host.items()
-        if len(jobs) >= BAD_NODE_MIN_JOBS
+        h for h, jobs in jobs_by_host.items() if len(jobs) >= min_jobs
     }
-    bad |= {h for h, n in hot_counts.items() if n >= HOT_MIN_EVENTS}
+    bad |= {h for h, n in hot_counts.items() if n >= hot_min}
     return tuple(sorted(bad))
 
 

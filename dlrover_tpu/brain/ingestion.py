@@ -1,0 +1,123 @@
+"""Brain's own cluster-event ingestion pipeline.
+
+Parity: the reference Brain runs its OWN k8s watchers + processors
+writing node incidents to the datastore, independent of any job master
+(dlrover/go/brain/pkg/server/server.go:176 starts the watch manager;
+pkg/datastore/implementation/utils/mysql.go:339 is the sink). Without
+this, the Brain only learns what masters choose to push
+(``BrainClient.report_node_event``) — cross-job decisions like
+``bad_node_exclusion`` go blind for jobs whose masters crashed before
+reporting, which is exactly when the evidence matters.
+
+``BrainNodeWatcher`` watches ALL job pods in a namespace on the
+``K8sApi`` seam (streaming list-watch when available, list+diff
+otherwise), maps pod lifecycle to node incidents, and writes them
+straight into the ``BrainServicer`` datastore:
+
+- pod phase ``Failed``: an ``oom`` event when a container terminated
+  with reason OOMKilled (exit 137 also counts — the kubelet loses the
+  reason on some runtimes), else ``failed``.
+
+Only EXPLICIT failure phases condemn a host. A pod that simply
+vanishes is deliberately NOT recorded: scale-downs, job deletion and
+operator GC all delete healthy running pods, and with
+``BAD_NODE_MIN_JOBS`` = 2 two routine downscales would blacklist a
+healthy host; preemptions/evictions that matter surface as phase
+``Failed`` (status.reason Preempted/Evicted) and are caught above.
+
+Per-cluster configuration records (the reference's multi-tenant config
+tables) live in the same datastore: ``set_cluster_config`` /
+``cluster_config`` on the servicer; ``bad_node_exclusion`` reads the
+``bad_node_min_jobs`` / ``hot_cpu_threshold`` / ``hot_min_events``
+overrides per cluster.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.daemon import WatchingDaemon
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.k8s.client import K8sApi
+from dlrover_tpu.k8s.scaler import JOB_LABEL, NODE_ID_LABEL
+
+
+def _pod_incident(pod: dict) -> Optional[Tuple[str, int]]:
+    """(event, memory_mb) when this pod's state is an incident."""
+    status = pod.get("status", {}) or {}
+    if status.get("phase") != "Failed":
+        return None
+    for cs in status.get("containerStatuses", []) or []:
+        term = (cs.get("state", {}) or {}).get("terminated", {}) or {}
+        if term.get("reason") == "OOMKilled" or term.get("exitCode") == 137:
+            return "oom", int(term.get("memoryMB", 0) or 0)
+    return "failed", 0
+
+
+class BrainNodeWatcher(WatchingDaemon):
+    """Cluster-scope pod watcher feeding the Brain datastore directly
+    (no job master in the loop)."""
+
+    def __init__(
+        self,
+        api: K8sApi,
+        servicer,
+        namespace: str = "default",
+        interval: float = 5.0,
+        resync: float = 60.0,
+    ):
+        super().__init__("brain-node-watcher", interval, resync=resync)
+        self._api = api
+        self._servicer = servicer
+        self._ns = namespace
+        # pod name -> (job, node_id, hostname, phase)
+        self._tracked: Dict[str, tuple] = {}
+
+    def _watch_stream(self):
+        return self._api.watch(self._ns, ())
+
+    def _record(self, job, node_id, hostname, event, memory_mb=0):
+        self._servicer.record_node_event(
+            comm.BrainNodeEventReport(
+                job_name=job,
+                node_id=node_id,
+                hostname=hostname,
+                event=event,
+                memory_mb=memory_mb,
+            )
+        )
+        logger.info(
+            f"brain ingested {event} on {hostname or '?'} (job {job})"
+        )
+
+    def _tick(self):
+        pods = self._api.list_pods(self._ns)
+        seen = set()
+        for pod in pods:
+            meta = pod.get("metadata", {})
+            labels = meta.get("labels", {}) or {}
+            job = labels.get(JOB_LABEL, "")
+            if not job:
+                continue  # not an elastic-job pod
+            name = meta.get("name", "")
+            seen.add(name)
+            phase = (pod.get("status", {}) or {}).get("phase", "Pending")
+            host = (pod.get("spec", {}) or {}).get("nodeName", "")
+            try:
+                node_id = int(labels.get(NODE_ID_LABEL, -1))
+            except ValueError:
+                node_id = -1
+            prev = self._tracked.get(name)
+            self._tracked[name] = (job, node_id, host, phase)
+            if prev is not None and prev[3] == phase:
+                continue
+            incident = _pod_incident(pod)
+            if incident is not None:
+                self._record(job, node_id, host, incident[0], incident[1])
+        # forget vanished pods — deliberately WITHOUT recording an
+        # incident (see module docstring: deletion is routine during
+        # scale-down/GC; only explicit Failed phases condemn a host)
+        for name in list(self._tracked):
+            if name not in seen:
+                self._tracked.pop(name)

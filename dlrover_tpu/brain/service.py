@@ -49,6 +49,12 @@ CREATE TABLE IF NOT EXISTS node_events (
 );
 CREATE INDEX IF NOT EXISTS node_events_job ON node_events (job, event);
 CREATE INDEX IF NOT EXISTS node_events_ts ON node_events (ts);
+CREATE TABLE IF NOT EXISTS cluster_config (
+    cluster TEXT NOT NULL,
+    key TEXT NOT NULL,
+    value TEXT NOT NULL,
+    PRIMARY KEY (cluster, key)
+);
 """
 
 # incident rows older than this are useless to every consumer (the
@@ -228,6 +234,26 @@ class BrainServicer:
                 (now - _NODE_EVENT_RETENTION_S,),
             )
             self._conn.commit()
+
+    # -- per-cluster configuration (multi-tenant config records, the
+    # reference's config tables in the Brain MySQL datastore) ---------
+    def set_cluster_config(self, cluster: str, key: str, value: str):
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO cluster_config VALUES (?,?,?) "
+                "ON CONFLICT(cluster, key) DO UPDATE SET value=excluded"
+                ".value",
+                (cluster, key, str(value)),
+            )
+            self._conn.commit()
+
+    def cluster_config(self, cluster: str) -> dict:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT key, value FROM cluster_config WHERE cluster=?",
+                (cluster,),
+            ).fetchall()
+        return dict(rows)
 
     def fleet_size_curve(self):
         """(size -> best steps/sec, fleet per-worker memory peak MB,

@@ -16,7 +16,15 @@ import copy
 import os
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import (
+    Callable,
+    Dict,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 from dlrover_tpu.common.log import default_logger as logger
 
@@ -46,6 +54,9 @@ class K8sApi:
         raise NotImplementedError
 
     def list_services(self, namespace: str) -> List[dict]:
+        raise NotImplementedError
+
+    def delete_service(self, namespace: str, name: str) -> bool:
         raise NotImplementedError
 
     def delete_pod(self, namespace: str, name: str) -> bool:
@@ -79,6 +90,18 @@ class K8sApi:
         self, namespace: str, plural: str, name: str
     ) -> bool:
         raise NotImplementedError
+
+    # watch (optional capability): yields (kind, event_type, object)
+    # tuples as cluster state changes — kind in {"pod", <plural>},
+    # event_type in {"ADDED","MODIFIED","DELETED"}. Implementations
+    # that cannot stream return None and callers fall back to polling.
+    def watch(
+        self,
+        namespace: str,
+        plurals: Sequence[str] = (),
+        timeout: float = 30.0,
+    ) -> Optional[Iterator[Tuple[str, str, dict]]]:
+        return None
 
 
 class ApiError(Exception):
@@ -216,6 +239,15 @@ class RealK8sApi(K8sApi):
         ret = self._request("GET", self._services(namespace))
         return (ret or {}).get("items", [])
 
+    def delete_service(self, namespace, name):
+        try:
+            self._request(
+                "DELETE", f"{self._services(namespace)}/{name}"
+            )
+            return True
+        except ApiError as e:
+            return e.status == 404
+
     def delete_pod(self, namespace, name):
         try:
             self._request("DELETE", f"{self._pods(namespace)}/{name}")
@@ -274,6 +306,73 @@ class RealK8sApi(K8sApi):
         except ApiError as e:
             return e.status == 404
 
+    def watch(self, namespace, plurals=(), timeout: float = 30.0):
+        """Streaming list-watch over pods + the given CR plurals: one
+        ``?watch=1`` chunked GET per resource, line-delimited JSON
+        events (the protocol client-go's informers speak), merged into
+        one iterator. Returns None if the server rejects watches (e.g.
+        a replay server without streaming) — callers then poll."""
+        import json as _json
+        import queue as _q
+        import urllib.request
+
+        out: _q.Queue = _q.Queue()
+        stop = threading.Event()
+
+        def _stream(kind: str, path: str):
+            req = urllib.request.Request(
+                f"{self._base}{path}?watch=1&timeoutSeconds="
+                f"{int(timeout)}"
+            )
+            req.add_header("Accept", "application/json")
+            token = self._token
+            if token:
+                req.add_header("Authorization", f"Bearer {token}")
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=timeout + 5, context=self._ssl_ctx
+                ) as resp:
+                    for line in resp:
+                        if stop.is_set():
+                            return
+                        if not line.strip():
+                            continue
+                        ev = _json.loads(line)
+                        out.put(
+                            (kind, ev.get("type", ""), ev.get("object", {}))
+                        )
+            except Exception as e:  # stream ended/refused: signal EOF
+                logger.info(f"watch stream {kind} ended: {e!r}")
+            finally:
+                out.put(None)
+
+        streams = [
+            ("pod", self._pods(namespace)),
+            ("service", self._services(namespace)),
+        ] + [(p, self._crs(namespace, p)) for p in plurals]
+        threads = [
+            threading.Thread(
+                target=_stream, args=s, daemon=True, name=f"watch-{s[0]}"
+            )
+            for s in streams
+        ]
+        for t in threads:
+            t.start()
+
+        def _events():
+            eof = 0
+            try:
+                while eof < len(streams):
+                    item = out.get()
+                    if item is None:
+                        eof += 1
+                        continue
+                    yield item
+            finally:
+                stop.set()
+
+        return _events()
+
 
 class FakeK8sApi(K8sApi):
     """In-memory cluster double for tests and local simulation."""
@@ -284,6 +383,12 @@ class FakeK8sApi(K8sApi):
         self.services: Dict[str, dict] = {}
         self.objects: Dict[str, Dict[str, dict]] = {}  # plural -> name -> obj
         self.events: List[str] = []
+        self._watchers: List = []  # live watch queues
+        self._uid = 0
+
+    def _emit(self, kind: str, etype: str, obj: dict):
+        for q in list(self._watchers):
+            q.put((kind, etype, copy.deepcopy(obj)))
 
     def create_pod(self, namespace, body):
         with self._lock:
@@ -294,6 +399,7 @@ class FakeK8sApi(K8sApi):
             body.setdefault("status", {})["phase"] = "Pending"
             self.pods[name] = body
             self.events.append(f"create_pod:{name}")
+            self._emit("pod", "ADDED", body)
             return body
 
     def create_service(self, namespace, body):
@@ -302,16 +408,29 @@ class FakeK8sApi(K8sApi):
             if name in self.services:
                 raise AlreadyExists(name)
             self.services[name] = copy.deepcopy(body)
+            self.events.append(f"create_service:{name}")
+            self._emit("service", "ADDED", body)
             return body
 
     def list_services(self, namespace):
         with self._lock:
             return copy.deepcopy(list(self.services.values()))
 
+    def delete_service(self, namespace, name):
+        with self._lock:
+            self.events.append(f"delete_service:{name}")
+            svc = self.services.pop(name, None)
+            if svc is not None:
+                self._emit("service", "DELETED", svc)
+            return svc is not None
+
     def delete_pod(self, namespace, name):
         with self._lock:
             self.events.append(f"delete_pod:{name}")
-            return self.pods.pop(name, None) is not None
+            pod = self.pods.pop(name, None)
+            if pod is not None:
+                self._emit("pod", "DELETED", pod)
+            return pod is not None
 
     def list_pods(self, namespace, label_selector=""):
         with self._lock:
@@ -333,6 +452,7 @@ class FakeK8sApi(K8sApi):
         with self._lock:
             if name in self.pods:
                 self.pods[name].setdefault("status", {})["phase"] = phase
+                self._emit("pod", "MODIFIED", self.pods[name])
 
     def get_custom_object(self, namespace, plural, name):
         with self._lock:
@@ -348,18 +468,53 @@ class FakeK8sApi(K8sApi):
             name = body["metadata"]["name"]
             if name in self.objects.get(plural, {}):
                 raise AlreadyExists(name)
-            self.objects.setdefault(plural, {})[name] = copy.deepcopy(body)
+            body = copy.deepcopy(body)
+            # the API server assigns uids; reconcilers stamp them into
+            # ownerReferences for GC
+            self._uid += 1
+            body["metadata"].setdefault("uid", f"fake-uid-{self._uid}")
+            self.objects.setdefault(plural, {})[name] = body
             self.events.append(f"create_{plural}:{name}")
-            return body
+            self._emit(plural, "ADDED", body)
+            return copy.deepcopy(body)
 
     def patch_custom_object_status(self, namespace, plural, name, status):
         with self._lock:
             obj = self.objects.get(plural, {}).get(name)
             if obj is not None:
                 obj.setdefault("status", {}).update(status)
+                self._emit(plural, "MODIFIED", obj)
 
     def delete_custom_object(self, namespace, plural, name):
         with self._lock:
-            return (
-                self.objects.get(plural, {}).pop(name, None) is not None
-            )
+            obj = self.objects.get(plural, {}).pop(name, None)
+            if obj is not None:
+                self._emit(plural, "DELETED", obj)
+            return obj is not None
+
+    def watch(self, namespace, plurals=(), timeout: float = 30.0):
+        """Event-queue watch double: mutations push (kind, type, obj)
+        into every live watcher; the iterator ends after ``timeout``
+        of silence (mirrors the API server closing idle watches)."""
+        import queue as _q
+
+        q: _q.Queue = _q.Queue()
+        with self._lock:
+            self._watchers.append(q)
+        kinds = {"pod", "service", *plurals}
+
+        def _events():
+            try:
+                while True:
+                    try:
+                        item = q.get(timeout=timeout)
+                    except _q.Empty:
+                        return
+                    if item[0] in kinds:
+                        yield item
+            finally:
+                with self._lock:
+                    if q in self._watchers:
+                        self._watchers.remove(q)
+
+        return _events()

@@ -15,13 +15,14 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from dlrover_tpu.common.daemon import PollingDaemon
+from dlrover_tpu.common.daemon import WatchingDaemon
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.node import Node
 from dlrover_tpu.k8s.client import MASTER_PORT, AlreadyExists, K8sApi
 from dlrover_tpu.k8s.scaler import JOB_LABEL, build_worker_pod
 
 MASTER_SUFFIX = "-master"
+GROUP_VERSION = "elastic.dlrover-tpu.org/v1alpha1"
 
 
 def master_service_addr(job_name: str, namespace: str) -> str:
@@ -30,23 +31,55 @@ def master_service_addr(job_name: str, namespace: str) -> str:
     return f"{job_name}{MASTER_SUFFIX}.{namespace}.svc:{MASTER_PORT}"
 
 
-def build_master_service(job_name: str, namespace: str) -> dict:
+def owner_reference(job: dict) -> Optional[dict]:
+    """ownerReference to an ElasticJob, for API-server garbage
+    collection of everything the job spawned (parity:
+    elasticjob_controller.go SetControllerReference). None when the CR
+    carries no uid (e.g. hand-built test objects)."""
+    uid = job.get("metadata", {}).get("uid")
+    if not uid:
+        return None
     return {
-        "apiVersion": "v1",
-        "kind": "Service",
-        "metadata": {
-            "name": f"{job_name}{MASTER_SUFFIX}",
-            "namespace": namespace,
-            "labels": {JOB_LABEL: job_name},
-        },
-        "spec": {
-            "selector": {
-                JOB_LABEL: job_name,
-                "elastic.dlrover-tpu.org/role": "master",
-            },
-            "ports": [{"port": MASTER_PORT, "targetPort": MASTER_PORT}],
-        },
+        "apiVersion": GROUP_VERSION,
+        "kind": "ElasticJob",
+        "name": job["metadata"]["name"],
+        "uid": uid,
+        "controller": True,
+        "blockOwnerDeletion": True,
     }
+
+
+def _own(body: dict, job: Optional[dict]):
+    ref = owner_reference(job) if job else None
+    if ref is not None:
+        body["metadata"].setdefault("ownerReferences", []).append(ref)
+    return body
+
+
+def build_master_service(
+    job_name: str, namespace: str, job: Optional[dict] = None
+) -> dict:
+    return _own(
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": f"{job_name}{MASTER_SUFFIX}",
+                "namespace": namespace,
+                "labels": {JOB_LABEL: job_name},
+            },
+            "spec": {
+                "selector": {
+                    JOB_LABEL: job_name,
+                    "elastic.dlrover-tpu.org/role": "master",
+                },
+                "ports": [
+                    {"port": MASTER_PORT, "targetPort": MASTER_PORT}
+                ],
+            },
+        },
+        job,
+    )
 
 
 def build_master_pod(job: dict, namespace: str) -> dict:
@@ -60,83 +93,229 @@ def build_master_pod(job: dict, namespace: str) -> dict:
         .get("containers", [{}])[0]
         .get("image", "dlrover-tpu:latest")
     )
-    return {
-        "apiVersion": "v1",
-        "kind": "Pod",
-        "metadata": {
-            "name": f"{name}{MASTER_SUFFIX}",
-            "namespace": namespace,
-            "labels": {
-                JOB_LABEL: name,
-                "elastic.dlrover-tpu.org/role": "master",
+    return _own(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"{name}{MASTER_SUFFIX}",
+                "namespace": namespace,
+                "labels": {
+                    JOB_LABEL: name,
+                    "elastic.dlrover-tpu.org/role": "master",
+                },
+            },
+            "spec": {
+                "restartPolicy": "OnFailure",
+                "containers": [
+                    {
+                        "name": "master",
+                        "image": image,
+                        "command": [
+                            "python",
+                            "-m",
+                            "dlrover_tpu.master.main",
+                            "--platform=k8s",
+                            f"--port={MASTER_PORT}",
+                            f"--job_name={name}",
+                            "--node_num="
+                            + str(workers.get("replicas", 1)),
+                        ],
+                    }
+                ],
             },
         },
-        "spec": {
-            "restartPolicy": "OnFailure",
-            "containers": [
-                {
-                    "name": "master",
-                    "image": image,
-                    "command": [
-                        "python",
-                        "-m",
-                        "dlrover_tpu.master.main",
-                        "--platform=k8s",
-                        f"--port={MASTER_PORT}",
-                        f"--job_name={name}",
-                        "--node_num="
-                        + str(workers.get("replicas", 1)),
-                    ],
-                }
-            ],
-        },
-    }
+        job,
+    )
 
 
-class ElasticJobOperator(PollingDaemon):
-    """Reconciles ElasticJobs (ensure master pod) and executes pending
-    ScalePlans (create/remove worker pods)."""
+class ElasticJobOperator(WatchingDaemon):
+    """Reconciles ElasticJobs (ensure master pod, drive
+    ``.status.phase``/``.status.conditions``) and executes pending
+    ScalePlans (create/remove worker pods).
+
+    Reconciliation is WATCH-DRIVEN when the API supports it (both
+    ``RealK8sApi`` streaming list-watch and ``FakeK8sApi``'s event
+    queue do): a watcher thread wakes the reconcile loop on every pod /
+    ElasticJob / ScalePlan event, and the polling interval degrades to
+    a slow full-resync backstop (parity:
+    elasticjob_controller.go:287's controller-runtime informers +
+    periodic resync). Everything the operator creates carries an
+    ownerReference to its ElasticJob — the API server's GC collects it
+    when the job is deleted; ``gc_orphans`` does the same for fakes and
+    belt-and-braces."""
 
     def __init__(
-        self, api: K8sApi, namespace: str = "default", interval: float = 5.0
+        self,
+        api: K8sApi,
+        namespace: str = "default",
+        interval: float = 5.0,
+        resync_interval: float = 60.0,
     ):
-        super().__init__("elasticjob-operator", interval)
+        super().__init__(
+            "elasticjob-operator", interval, resync=resync_interval
+        )
         self._api = api
         self._ns = namespace
 
-    def _tick(self):
-        self.reconcile_jobs()
-        self.reconcile_scaleplans()
+    def _watch_stream(self):
+        return self._api.watch(self._ns, ("elasticjobs", "scaleplans"))
 
-    # -- ElasticJob → master pod + service -----------------------------
-    def reconcile_jobs(self):
+    def _tick(self):
+        # one list per resource per tick, shared by every phase
         pods = {
-            p["metadata"]["name"] for p in self._api.list_pods(self._ns)
+            p["metadata"]["name"]: p
+            for p in self._api.list_pods(self._ns)
         }
-        services = {
-            s["metadata"]["name"]
-            for s in self._api.list_services(self._ns)
-        }
-        for job in self._api.list_custom_objects(self._ns, "elasticjobs"):
+        services = self._api.list_services(self._ns)
+        jobs = self._api.list_custom_objects(self._ns, "elasticjobs")
+        self.reconcile_jobs(pods=pods, services=services, jobs=jobs)
+        self.reconcile_scaleplans()
+        self.gc_orphans(pods=pods, services=services, jobs=jobs)
+
+    # -- status conditions ---------------------------------------------
+    def _set_condition(
+        self, job: dict, phase: str, ctype: str, reason: str
+    ):
+        """Transition ``.status.phase`` and append a condition (typed,
+        timestamped, deduplicated on consecutive repeats) — the
+        observable history the reference controller maintains on the
+        CRD status."""
+        import time as _time
+
+        name = job["metadata"]["name"]
+        status = job.get("status", {}) or {}
+        conds = list(status.get("conditions", []))
+        if status.get("phase") == phase and conds and (
+            conds[-1].get("type") == ctype
+        ):
+            return
+        conds.append(
+            {
+                "type": ctype,
+                "status": "True",
+                "reason": reason,
+                "lastTransitionTime": _time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", _time.gmtime()
+                ),
+            }
+        )
+        # a flapping master would otherwise grow the history without
+        # bound (and every patch re-sends the whole list): keep the
+        # newest window, like reference controllers compact theirs
+        conds = conds[-20:]
+        self._api.patch_custom_object_status(
+            self._ns, "elasticjobs", name,
+            {"phase": phase, "conditions": conds},
+        )
+        job.setdefault("status", {}).update(
+            {"phase": phase, "conditions": conds}
+        )
+
+    # -- ElasticJob → master pod + service + phase ----------------------
+    def reconcile_jobs(self, pods=None, services=None, jobs=None):
+        if pods is None:
+            pods = {
+                p["metadata"]["name"]: p
+                for p in self._api.list_pods(self._ns)
+            }
+        if services is None:
+            services = self._api.list_services(self._ns)
+        if jobs is None:
+            jobs = self._api.list_custom_objects(self._ns, "elasticjobs")
+        services = {s["metadata"]["name"] for s in services}
+        for job in jobs:
             name = job["metadata"]["name"]
             master = f"{name}{MASTER_SUFFIX}"
+            phase = (job.get("status", {}) or {}).get("phase", "")
             try:
+                if phase in ("Succeeded", "Failed"):
+                    continue
                 if master not in services:
                     self._api.create_service(
-                        self._ns, build_master_service(name, self._ns)
+                        self._ns,
+                        build_master_service(name, self._ns, job),
                     )
                 if master not in pods:
                     logger.info(f"operator creating master pod {master}")
                     self._api.create_pod(
                         self._ns, build_master_pod(job, self._ns)
                     )
-                    self._api.patch_custom_object_status(
-                        self._ns, "elasticjobs", name, {"phase": "Starting"}
+                    if phase:
+                        # a previously-started job whose master pod
+                        # vanished: this is a relaunch, not a first start
+                        self._set_condition(
+                            job, "Starting", "MasterRelaunched",
+                            "master pod missing; recreated",
+                        )
+                    else:
+                        self._set_condition(
+                            job, "Starting", "MasterCreated",
+                            "master pod and service created",
+                        )
+                    continue
+                mphase = (
+                    pods[master].get("status", {}).get("phase", "Pending")
+                )
+                if mphase == "Running" and phase != "Running":
+                    self._set_condition(
+                        job, "Running", "JobRunning",
+                        "master pod is running",
+                    )
+                elif mphase == "Succeeded":
+                    self._set_condition(
+                        job, "Succeeded", "JobCompleted",
+                        "master pod succeeded",
+                    )
+                elif mphase == "Failed":
+                    self._set_condition(
+                        job, "Failed", "JobFailed", "master pod failed"
                     )
             except AlreadyExists:
                 pass  # raced our own previous tick; converged
             except Exception as e:
                 logger.error(f"reconcile of job {name} failed: {e!r}")
+
+    # -- ownerRef garbage collection ------------------------------------
+    def gc_orphans(self, pods=None, services=None, jobs=None):
+        """Delete pods/services whose owning ElasticJob is gone. Real
+        API servers do this from the ownerReferences; the fake (and any
+        cluster with GC disabled) gets the same semantics here."""
+        if pods is None:
+            pods = {
+                p["metadata"]["name"]: p
+                for p in self._api.list_pods(self._ns)
+            }
+        if services is None:
+            services = self._api.list_services(self._ns)
+        if jobs is None:
+            jobs = self._api.list_custom_objects(self._ns, "elasticjobs")
+        jobs = {j["metadata"]["name"] for j in jobs}
+        for pod in pods.values():
+            meta = pod.get("metadata", {})
+            for ref in meta.get("ownerReferences", []):
+                if (
+                    ref.get("kind") == "ElasticJob"
+                    and ref.get("name") not in jobs
+                ):
+                    logger.info(
+                        f"GC: deleting orphaned pod {meta['name']} "
+                        f"(owner {ref.get('name')} gone)"
+                    )
+                    self._api.delete_pod(self._ns, meta["name"])
+                    break
+        for svc in services:
+            meta = svc.get("metadata", {})
+            for ref in meta.get("ownerReferences", []):
+                if (
+                    ref.get("kind") == "ElasticJob"
+                    and ref.get("name") not in jobs
+                ):
+                    logger.info(
+                        f"GC: deleting orphaned service {meta['name']}"
+                    )
+                    self._api.delete_service(self._ns, meta["name"])
+                    break
 
     # -- ScalePlan → pods ----------------------------------------------
     KEEP_SUCCEEDED = 5  # retained per tick for operator debugging
@@ -214,6 +393,7 @@ class ElasticJobOperator(PollingDaemon):
                 exclude_hosts=tuple(spec.get("excludeHosts", ())),
             )
             body["metadata"]["name"] = meta["name"]
+            _own(body, jobobj)  # GC with the owning ElasticJob
             logger.info(f"operator creating pod {meta['name']}")
             try:
                 self._api.create_pod(self._ns, body)

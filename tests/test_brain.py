@@ -445,3 +445,157 @@ def test_master_env_wiring_reports_job_end(brain, monkeypatch):
         assert "cold-start" in plan.reason, plan
     finally:
         fresh.close()
+
+
+class TestBrainIngestion:
+    """VERDICT r4 #7: the Brain watches node events ITSELF (ref
+    brain/pkg/server/server.go:176 watch manager -> mysql.go:339 sink)
+    — raw pod lifecycle drives the datastore and cross-job
+    bad-node exclusion with NO job master involved."""
+
+    def _pod(self, api, name, job, node_id, host):
+        api.create_pod(
+            "default",
+            {
+                "metadata": {
+                    "name": name,
+                    "labels": {
+                        "elastic.dlrover-tpu.org/job": job,
+                        "elastic.dlrover-tpu.org/node-id": str(node_id),
+                    },
+                },
+                "spec": {"nodeName": host},
+            },
+        )
+
+    def test_raw_pod_failures_drive_exclusion_without_master(self):
+        from dlrover_tpu.brain.algorithms import bad_node_exclusion
+        from dlrover_tpu.brain.ingestion import BrainNodeWatcher
+        from dlrover_tpu.brain.service import BrainServicer
+        from dlrover_tpu.k8s.client import FakeK8sApi
+
+        api = FakeK8sApi()
+        servicer = BrainServicer()
+        watcher = BrainNodeWatcher(api, servicer)
+
+        # the same physical host eats failures in TWO distinct jobs
+        self._pod(api, "j1-w0", "job1", 0, "host-bad")
+        self._pod(api, "j2-w0", "job2", 0, "host-bad")
+        self._pod(api, "j1-w1", "job1", 1, "host-ok")
+        watcher._tick()  # records identities, no incidents yet
+        assert servicer.node_events() == []
+
+        api.set_pod_phase("j1-w0", "Failed")
+        api.set_pod_phase("j2-w0", "Failed")
+        watcher._tick()
+        events = servicer.node_events()
+        assert {(e.job_name, e.event) for e in events} == {
+            ("job1", "failed"),
+            ("job2", "failed"),
+        }
+        assert bad_node_exclusion(servicer) == ("host-bad",)
+
+    def test_oom_detected_from_container_status(self):
+        from dlrover_tpu.brain.ingestion import BrainNodeWatcher
+        from dlrover_tpu.brain.service import BrainServicer
+        from dlrover_tpu.k8s.client import FakeK8sApi
+
+        api = FakeK8sApi()
+        servicer = BrainServicer()
+        watcher = BrainNodeWatcher(api, servicer)
+        self._pod(api, "jo-w0", "jobo", 0, "host-x")
+        watcher._tick()
+        with api._lock:
+            pod = api.pods["jo-w0"]
+            pod["status"]["phase"] = "Failed"
+            pod["status"]["containerStatuses"] = [
+                {
+                    "state": {
+                        "terminated": {
+                            "reason": "OOMKilled",
+                            "exitCode": 137,
+                            "memoryMB": 12345,
+                        }
+                    }
+                }
+            ]
+        watcher._tick()
+        events = servicer.node_events()
+        assert [(e.event, e.memory_mb) for e in events] == [
+            ("oom", 12345)
+        ]
+
+    def test_vanished_pod_is_not_an_incident(self):
+        """Routine deletion (scale-down, job GC) must NOT condemn the
+        host: with BAD_NODE_MIN_JOBS=2, two ordinary downscales would
+        blacklist a healthy machine. Only explicit Failed phases count
+        (preemptions surface as Failed with a reason)."""
+        from dlrover_tpu.brain.ingestion import BrainNodeWatcher
+        from dlrover_tpu.brain.service import BrainServicer
+        from dlrover_tpu.k8s.client import FakeK8sApi
+
+        api = FakeK8sApi()
+        servicer = BrainServicer()
+        watcher = BrainNodeWatcher(api, servicer)
+        self._pod(api, "jv-w0", "jobv", 0, "host-p")
+        watcher._tick()
+        api.delete_pod("default", "jv-w0")  # deliberate scale-down
+        watcher._tick()
+        assert servicer.node_events() == []
+
+    def test_cluster_config_overrides_exclusion_thresholds(self):
+        from dlrover_tpu.brain.algorithms import bad_node_exclusion
+        from dlrover_tpu.brain.ingestion import BrainNodeWatcher
+        from dlrover_tpu.brain.service import BrainServicer
+        from dlrover_tpu.k8s.client import FakeK8sApi
+
+        api = FakeK8sApi()
+        servicer = BrainServicer()
+        watcher = BrainNodeWatcher(api, servicer)
+        self._pod(api, "jc-w0", "job1", 0, "host-c")
+        self._pod(api, "jc-w1", "job2", 0, "host-c")
+        watcher._tick()
+        api.set_pod_phase("jc-w0", "Failed")
+        api.set_pod_phase("jc-w1", "Failed")
+        watcher._tick()
+        # defaults: 2 distinct jobs condemn the host
+        assert bad_node_exclusion(servicer) == ("host-c",)
+        # per-cluster override raises the bar
+        servicer.set_cluster_config("default", "bad_node_min_jobs", "3")
+        assert bad_node_exclusion(servicer) == ()
+
+    def test_event_driven_ingestion(self):
+        """With a watch-capable API, incidents land without waiting a
+        poll interval (poll AND resync pushed beyond the test horizon,
+        so only a watch wakeup can deliver)."""
+        from dlrover_tpu.brain.ingestion import BrainNodeWatcher
+        from dlrover_tpu.brain.service import BrainServicer
+        from dlrover_tpu.k8s.client import FakeK8sApi
+
+        api = FakeK8sApi()
+        servicer = BrainServicer()
+        watcher = BrainNodeWatcher(
+            api, servicer, interval=3600.0, resync=3600.0
+        )
+        watcher.start()
+        try:
+            time.sleep(0.5)  # let the startup tick pass (empty cluster)
+            deadline = time.time() + 5
+            self._pod(api, "je-w0", "jobe", 0, "host-e")
+            api.set_pod_phase("je-w0", "Failed")
+            while not servicer.node_events() and time.time() < deadline:
+                time.sleep(0.05)
+            assert [e.event for e in servicer.node_events()] == ["failed"]
+        finally:
+            watcher.stop()
+
+    def test_cluster_config_records(self):
+        from dlrover_tpu.brain.service import BrainServicer
+
+        s = BrainServicer()
+        s.set_cluster_config("cl-a", "bad_node_min_jobs", "3")
+        s.set_cluster_config("cl-a", "bad_node_min_jobs", "4")  # upsert
+        s.set_cluster_config("cl-b", "hot_cpu_threshold", "85")
+        assert s.cluster_config("cl-a") == {"bad_node_min_jobs": "4"}
+        assert s.cluster_config("cl-b") == {"hot_cpu_threshold": "85"}
+        assert s.cluster_config("cl-c") == {}

@@ -530,3 +530,224 @@ def test_exclusion_rides_scaleplan_cr_through_operator():
         "operator": "NotIn",
         "values": ["bad-host"],
     }
+
+
+class TestOperatorProductionSemantics:
+    """VERDICT r4 #6: watch-driven reconcile, status conditions and
+    ownerReference GC (ref elasticjob_controller.go:287 conditions,
+    master.go:289 SetControllerReference)."""
+
+    def _job(self, api, name="condjob"):
+        return api.create_custom_object(
+            "default",
+            "elasticjobs",
+            {
+                "metadata": {"name": name},
+                "spec": {
+                    "replicaSpecs": {
+                        "worker": {
+                            "replicas": 2,
+                            "template": {
+                                "spec": {
+                                    "containers": [
+                                        {"name": "w", "image": "i:1"}
+                                    ]
+                                }
+                            },
+                        }
+                    }
+                },
+            },
+        )
+
+    def test_condition_history_through_job_lifecycle(self):
+        """The full replay: create -> scale -> master death -> complete,
+        with .status.phase transitions and the typed condition trail."""
+        api = FakeK8sApi()
+        self._job(api)
+        op = ElasticJobOperator(api)
+
+        op._tick()  # create: master pod + service, phase Starting
+        job = api.get_custom_object("default", "elasticjobs", "condjob")
+        assert job["status"]["phase"] == "Starting"
+
+        api.set_pod_phase("condjob-master", "Running")
+        op._tick()  # master up: phase Running
+        job = api.get_custom_object("default", "elasticjobs", "condjob")
+        assert job["status"]["phase"] == "Running"
+
+        # master writes a ScalePlan; operator converges it
+        api.create_custom_object(
+            "default",
+            "scaleplans",
+            {
+                "metadata": {"name": "condjob-scaleplan-1-0"},
+                "spec": {
+                    "ownerJob": "condjob",
+                    "createPods": [{"name": "condjob-worker-0", "id": 0}],
+                },
+            },
+        )
+        op._tick()
+        assert "condjob-worker-0" in api.pods
+
+        # master pod dies out of band -> operator relaunches it
+        api.delete_pod("default", "condjob-master")
+        op._tick()
+        assert "condjob-master" in api.pods
+        job = api.get_custom_object("default", "elasticjobs", "condjob")
+        assert job["status"]["phase"] == "Starting"
+
+        api.set_pod_phase("condjob-master", "Running")
+        op._tick()
+        api.set_pod_phase("condjob-master", "Succeeded")
+        op._tick()
+        job = api.get_custom_object("default", "elasticjobs", "condjob")
+        assert job["status"]["phase"] == "Succeeded"
+        trail = [c["type"] for c in job["status"]["conditions"]]
+        assert trail == [
+            "MasterCreated",
+            "JobRunning",
+            "MasterRelaunched",
+            "JobRunning",
+            "JobCompleted",
+        ], trail
+        # terminal: a further tick must not resurrect anything
+        api.delete_pod("default", "condjob-master")
+        op.reconcile_jobs()
+        assert "condjob-master" not in api.pods
+
+    def test_owner_references_and_gc(self):
+        api = FakeK8sApi()
+        self._job(api, "gcjob")
+        op = ElasticJobOperator(api)
+        op._tick()
+        api.create_custom_object(
+            "default",
+            "scaleplans",
+            {
+                "metadata": {"name": "gcjob-scaleplan-1-0"},
+                "spec": {
+                    "ownerJob": "gcjob",
+                    "createPods": [{"name": "gcjob-worker-0", "id": 0}],
+                },
+            },
+        )
+        op._tick()
+        # everything the operator created carries the job ownerRef
+        for name in ("gcjob-master", "gcjob-worker-0"):
+            refs = api.pods[name]["metadata"]["ownerReferences"]
+            assert refs[0]["kind"] == "ElasticJob"
+            assert refs[0]["name"] == "gcjob"
+            assert refs[0]["uid"].startswith("fake-uid-")
+        assert (
+            api.services["gcjob-master"]["metadata"]["ownerReferences"][0][
+                "name"
+            ]
+            == "gcjob"
+        )
+        # job deleted -> owned pods + service are collected
+        api.delete_custom_object("default", "elasticjobs", "gcjob")
+        op._tick()
+        assert "gcjob-master" not in api.pods
+        assert "gcjob-worker-0" not in api.pods
+        assert "gcjob-master" not in api.services
+
+    def test_watch_driven_reconcile_no_hot_poll(self):
+        """With a watch-capable API the operator reconciles on EVENTS:
+        both the poll interval AND resync sit far beyond the test
+        horizon, so convergence within the deadline can ONLY come from
+        a watch wakeup."""
+        import time
+
+        api = FakeK8sApi()
+        op = ElasticJobOperator(
+            api, interval=3600.0, resync_interval=3600.0
+        )
+        op.start()
+        try:
+            time.sleep(0.5)  # let the startup tick pass (empty cluster)
+            deadline = time.time() + 5
+            self._job(api, "watchjob")
+            while (
+                "watchjob-master" not in api.pods
+                and time.time() < deadline
+            ):
+                time.sleep(0.05)
+            assert "watchjob-master" in api.pods
+            # and pod phase events flow too: Running transition
+            api.set_pod_phase("watchjob-master", "Running")
+            while time.time() < deadline:
+                job = api.get_custom_object(
+                    "default", "elasticjobs", "watchjob"
+                )
+                if (job.get("status") or {}).get("phase") == "Running":
+                    break
+                time.sleep(0.05)
+            assert (
+                api.get_custom_object(
+                    "default", "elasticjobs", "watchjob"
+                )["status"]["phase"]
+                == "Running"
+            )
+        finally:
+            op.stop()
+
+
+def test_real_api_streaming_watch_protocol():
+    """RealK8sApi.watch speaks the API server's ?watch=1 line-delimited
+    JSON protocol over real HTTP: events from the pod stream and each
+    CR-plural stream merge into one iterator; stream close = EOF."""
+    import http.server
+    import json as _json
+    import threading
+
+    from dlrover_tpu.k8s.client import RealK8sApi
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if "watch=1" not in self.path:
+                self.send_response(404)
+                self.end_headers()
+                return
+            if "elasticjobs" in self.path:
+                kind = "elasticjobs"
+            elif "services" in self.path:
+                kind = "service"
+            else:
+                kind = "pod"
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            for etype in ("ADDED", "MODIFIED"):
+                ev = {
+                    "type": etype,
+                    "object": {"metadata": {"name": f"{kind}-obj"}},
+                }
+                self.wfile.write((_json.dumps(ev) + "\n").encode())
+                self.wfile.flush()
+            # connection closes -> client sees EOF for this stream
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        api = RealK8sApi(
+            base_url=f"http://127.0.0.1:{srv.server_address[1]}",
+            token="tok",
+        )
+        events = list(api.watch("ns", ("elasticjobs",), timeout=5))
+        got = {(k, t, o["metadata"]["name"]) for k, t, o in events}
+        assert got == {
+            ("pod", "ADDED", "pod-obj"),
+            ("pod", "MODIFIED", "pod-obj"),
+            ("service", "ADDED", "service-obj"),
+            ("service", "MODIFIED", "service-obj"),
+            ("elasticjobs", "ADDED", "elasticjobs-obj"),
+            ("elasticjobs", "MODIFIED", "elasticjobs-obj"),
+        }
+    finally:
+        srv.shutdown()
+        srv.server_close()

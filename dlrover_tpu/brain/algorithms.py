@@ -298,6 +298,7 @@ def run_algorithms(
     node_unit: int = 1,
     local=None,
     now: Optional[float] = None,
+    cluster: str = "default",
 ) -> ResourcePlan:
     """The suite the servicer's optimize() runs. Plans MERGE rather than
     first-match-win: the base plan is cold-start (sample-less job) or
@@ -348,5 +349,5 @@ def run_algorithms(
         logger.warning(f"brain: job {job} {sick}")
         plan.reason = "; ".join(p for p in (plan.reason, sick) if p)
 
-    plan.exclude_nodes = bad_node_exclusion(ds, now=now)
+    plan.exclude_nodes = bad_node_exclusion(ds, now=now, cluster=cluster)
     return plan

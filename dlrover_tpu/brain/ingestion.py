@@ -43,16 +43,19 @@ from dlrover_tpu.k8s.client import K8sApi
 from dlrover_tpu.k8s.scaler import JOB_LABEL, NODE_ID_LABEL
 
 
-def _pod_incident(pod: dict) -> Optional[Tuple[str, int]]:
-    """(event, memory_mb) when this pod's state is an incident."""
+def _pod_incident(pod: dict) -> Optional[str]:
+    """The incident event for this pod's state, or None. Memory at the
+    kill is NOT available here — kubelet terminated-state carries only
+    exitCode/reason/signal/finishedAt — so oom_adjust's sizing falls
+    back to its sampled/default path for Brain-ingested OOMs."""
     status = pod.get("status", {}) or {}
     if status.get("phase") != "Failed":
         return None
     for cs in status.get("containerStatuses", []) or []:
         term = (cs.get("state", {}) or {}).get("terminated", {}) or {}
         if term.get("reason") == "OOMKilled" or term.get("exitCode") == 137:
-            return "oom", int(term.get("memoryMB", 0) or 0)
-    return "failed", 0
+            return "oom"
+    return "failed"
 
 
 class BrainNodeWatcher(WatchingDaemon):
@@ -73,6 +76,11 @@ class BrainNodeWatcher(WatchingDaemon):
         self._ns = namespace
         # pod name -> (job, node_id, hostname, phase)
         self._tracked: Dict[str, tuple] = {}
+        # first tick is a BASELINE pass: pods already Failed at startup
+        # are stale evidence (kubelets keep failed pods for days) — re-
+        # ingesting them timestamped now would re-condemn their hosts
+        # on every Brain restart
+        self._primed = False
 
     def _watch_stream(self):
         return self._api.watch(self._ns, ())
@@ -112,9 +120,12 @@ class BrainNodeWatcher(WatchingDaemon):
             self._tracked[name] = (job, node_id, host, phase)
             if prev is not None and prev[3] == phase:
                 continue
+            if prev is None and not self._primed:
+                continue  # baseline pass: record identity only
             incident = _pod_incident(pod)
             if incident is not None:
-                self._record(job, node_id, host, incident[0], incident[1])
+                self._record(job, node_id, host, incident)
+        self._primed = True
         # forget vanished pods — deliberately WITHOUT recording an
         # incident (see module docstring: deletion is routine during
         # scale-down/GC; only explicit Failed phases condemn a host)

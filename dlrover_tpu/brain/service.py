@@ -78,6 +78,11 @@ class BrainServicer:
     sqlite datastore (parity: server.go + datastore/mysql.go)."""
 
     def __init__(self, db_path: str = ":memory:", max_rows_per_job: int = 10000):
+        import os as _os
+
+        # this Brain's cluster identity: keys the per-cluster config
+        # records consumed by the algorithms' threshold overrides
+        self.cluster = _os.getenv("DLROVER_TPU_CLUSTER", "default")
         # one connection guarded by a lock: the RPC pool is many threads
         self._conn = sqlite3.connect(db_path, check_same_thread=False)
         self._conn.executescript(_SCHEMA)
@@ -348,6 +353,7 @@ class BrainServicer:
         return run_algorithms(
             self, job, node_unit,
             local=JobResourceOptimizer(node_unit=node_unit),
+            cluster=self.cluster,
         )
 
     def close(self):

@@ -88,8 +88,13 @@ class WatchingDaemon(PollingDaemon):
             try:
                 stream = self._watch_stream()
             except Exception as e:
+                # transient backend failure: keep polling responsive and
+                # RETRY — a daemon that quietly stops watching while
+                # claiming _watch_ok would slow itself to resync cadence
                 logger.warning(f"{self._name} watch failed: {e!r}")
-                stream = None
+                self._watch_ok = False
+                self._stopped.wait(10.0)
+                continue
             if stream is None:
                 return  # backend cannot stream: stay pure-polling
             t0 = _time.time()
@@ -102,14 +107,18 @@ class WatchingDaemon(PollingDaemon):
                 self._wake.set()
             if delivered == 0 and _time.time() - t0 < 1.0:
                 duds += 1
+                self._watch_ok = False
                 if duds >= self._MAX_DUD_STREAMS:
+                    # long cool-off, then try again — the API server may
+                    # just be restarting; never abandon forever
                     logger.warning(
                         f"{self._name}: watch streams end instantly "
-                        f"({duds}x); falling back to polling"
+                        f"({duds}x); polling, retrying watch in 60s"
                     )
-                    self._watch_ok = False
-                    return
-                _time.sleep(min(2.0**duds, 10.0))
+                    duds = 0
+                    self._stopped.wait(60.0)
+                else:
+                    self._stopped.wait(min(2.0**duds, 10.0))
             else:
                 duds = 0
             # stream closed (server-side watch timeout) -> re-watch

@@ -166,6 +166,20 @@ class RealK8sApi(K8sApi):
             )
 
     # -- HTTP core -----------------------------------------------------
+    def _bearer_token(self) -> str:
+        """Projected service-account tokens are time-bound and rotated
+        by the kubelet: re-read the mounted file per request (what
+        client-go does), falling back to the constructor-given token.
+        Shared by plain requests AND watch streams — an unauthenticated
+        watch would 401 and silently degrade to polling in-cluster."""
+        if self._token:
+            return self._token
+        try:
+            with open(f"{_SA_DIR}/token") as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+
     def _request(
         self,
         method: str,
@@ -185,16 +199,7 @@ class RealK8sApi(K8sApi):
         req.add_header("Accept", "application/json")
         if data is not None:
             req.add_header("Content-Type", content_type)
-        # projected service-account tokens are time-bound and rotated by
-        # the kubelet: re-read the mounted file per request (what
-        # client-go does), falling back to the constructor-given token
-        token = self._token
-        if not token:
-            try:
-                with open(f"{_SA_DIR}/token") as f:
-                    token = f.read().strip()
-            except OSError:
-                token = ""
+        token = self._bearer_token()
         if token:
             req.add_header("Authorization", f"Bearer {token}")
         try:
@@ -325,7 +330,7 @@ class RealK8sApi(K8sApi):
                 f"{int(timeout)}"
             )
             req.add_header("Accept", "application/json")
-            token = self._token
+            token = self._bearer_token()
             if token:
                 req.add_header("Authorization", f"Bearer {token}")
             try:

@@ -162,16 +162,20 @@ class ElasticJobOperator(WatchingDaemon):
         return self._api.watch(self._ns, ("elasticjobs", "scaleplans"))
 
     def _tick(self):
-        # one list per resource per tick, shared by every phase
+        # one list per resource per tick, shared by every phase. GC runs
+        # FIRST and prunes what it deletes from the shared snapshot:
+        # with reconcile first, a job deleted-and-recreated under the
+        # same name would have its FRESH master created by reconcile and
+        # then deleted by a GC acting on the stale pre-reconcile list.
         pods = {
             p["metadata"]["name"]: p
             for p in self._api.list_pods(self._ns)
         }
         services = self._api.list_services(self._ns)
         jobs = self._api.list_custom_objects(self._ns, "elasticjobs")
+        self.gc_orphans(pods=pods, services=services, jobs=jobs)
         self.reconcile_jobs(pods=pods, services=services, jobs=jobs)
         self.reconcile_scaleplans()
-        self.gc_orphans(pods=pods, services=services, jobs=jobs)
 
     # -- status conditions ---------------------------------------------
     def _set_condition(
@@ -290,32 +294,40 @@ class ElasticJobOperator(WatchingDaemon):
             services = self._api.list_services(self._ns)
         if jobs is None:
             jobs = self._api.list_custom_objects(self._ns, "elasticjobs")
-        jobs = {j["metadata"]["name"] for j in jobs}
-        for pod in pods.values():
-            meta = pod.get("metadata", {})
+        # key on UID, not name: a recreated same-name job must not keep
+        # the old incarnation's pods alive (real k8s GC keys on uid)
+        live_uids = {
+            j["metadata"].get("uid")
+            for j in jobs
+            if j["metadata"].get("uid")
+        }
+
+        def _orphaned(meta) -> bool:
             for ref in meta.get("ownerReferences", []):
                 if (
                     ref.get("kind") == "ElasticJob"
-                    and ref.get("name") not in jobs
+                    and ref.get("uid")
+                    and ref["uid"] not in live_uids
                 ):
-                    logger.info(
-                        f"GC: deleting orphaned pod {meta['name']} "
-                        f"(owner {ref.get('name')} gone)"
-                    )
-                    self._api.delete_pod(self._ns, meta["name"])
-                    break
-        for svc in services:
+                    return True
+            return False
+
+        for name in list(pods):
+            meta = pods[name].get("metadata", {})
+            if _orphaned(meta):
+                logger.info(
+                    f"GC: deleting orphaned pod {name} (owner uid gone)"
+                )
+                self._api.delete_pod(self._ns, name)
+                pods.pop(name)  # keep the shared tick snapshot truthful
+        for svc in list(services):
             meta = svc.get("metadata", {})
-            for ref in meta.get("ownerReferences", []):
-                if (
-                    ref.get("kind") == "ElasticJob"
-                    and ref.get("name") not in jobs
-                ):
-                    logger.info(
-                        f"GC: deleting orphaned service {meta['name']}"
-                    )
-                    self._api.delete_service(self._ns, meta["name"])
-                    break
+            if _orphaned(meta):
+                logger.info(
+                    f"GC: deleting orphaned service {meta['name']}"
+                )
+                self._api.delete_service(self._ns, meta["name"])
+                services.remove(svc)
 
     # -- ScalePlan → pods ----------------------------------------------
     KEEP_SUCCEEDED = 5  # retained per tick for operator debugging

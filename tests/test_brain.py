@@ -521,9 +521,31 @@ class TestBrainIngestion:
             ]
         watcher._tick()
         events = servicer.node_events()
-        assert [(e.event, e.memory_mb) for e in events] == [
-            ("oom", 12345)
-        ]
+        # kubelet terminated-state carries no memory reading: the event
+        # classifies as oom, sizing falls to oom_adjust's fallback path
+        assert [(e.event, e.memory_mb) for e in events] == [("oom", 0)]
+
+    def test_stale_failed_pods_not_reingested_at_startup(self):
+        """A restarted Brain must not re-condemn hosts from pods that
+        failed long ago (kubelets keep Failed pods for days): the first
+        tick is a baseline pass."""
+        from dlrover_tpu.brain.ingestion import BrainNodeWatcher
+        from dlrover_tpu.brain.service import BrainServicer
+        from dlrover_tpu.k8s.client import FakeK8sApi
+
+        api = FakeK8sApi()
+        self._pod(api, "js-w0", "jobs1", 0, "host-s")
+        api.set_pod_phase("js-w0", "Failed")  # failed BEFORE Brain start
+        servicer = BrainServicer()
+        watcher = BrainNodeWatcher(api, servicer)
+        watcher._tick()
+        assert servicer.node_events() == []
+        # but a FRESH failure after startup is ingested
+        self._pod(api, "js-w1", "jobs1", 1, "host-s")
+        watcher._tick()
+        api.set_pod_phase("js-w1", "Failed")
+        watcher._tick()
+        assert [e.event for e in servicer.node_events()] == ["failed"]
 
     def test_vanished_pod_is_not_an_incident(self):
         """Routine deletion (scale-down, job GC) must NOT condemn the

@@ -751,3 +751,41 @@ def test_real_api_streaming_watch_protocol():
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_recreated_same_name_job_gets_fresh_master():
+    """GC keys on owner UID and runs before reconcile within a tick:
+    deleting a job and recreating it under the same name must converge
+    to a FRESH master pod in one tick — found live when GC (acting on a
+    stale snapshot) deleted the master reconcile had just created."""
+    from dlrover_tpu.k8s.client import FakeK8sApi
+    from dlrover_tpu.k8s.operator import ElasticJobOperator
+
+    api = FakeK8sApi()
+    spec = {
+        "metadata": {"name": "x"},
+        "spec": {
+            "replicaSpecs": {
+                "worker": {
+                    "replicas": 1,
+                    "template": {
+                        "spec": {"containers": [{"name": "w", "image": "i"}]}
+                    },
+                }
+            }
+        },
+    }
+    api.create_custom_object("default", "elasticjobs", dict(spec))
+    op = ElasticJobOperator(api)
+    op._tick()
+    old_uid = api.pods["x-master"]["metadata"]["ownerReferences"][0]["uid"]
+    api.set_pod_phase("x-master", "Succeeded")
+    op._tick()
+    api.delete_custom_object("default", "elasticjobs", "x")
+    api.create_custom_object("default", "elasticjobs", dict(spec))
+    op._tick()
+    assert "x-master" in api.pods
+    new_uid = api.pods["x-master"]["metadata"]["ownerReferences"][0]["uid"]
+    assert new_uid != old_uid
+    job = api.get_custom_object("default", "elasticjobs", "x")
+    assert job["status"]["phase"] == "Starting"

@@ -522,9 +522,11 @@ def goodput_child_main(argv) -> int:
 
 def _r15_child(jax, ckpt_dir: str, out_path: str, t_proc0: float) -> int:
     """Fresh-trainer restore of the 1.5B (bf16 + 8-bit Adam) state the
-    parent staged: shm path first (agent survives), then the full-loss
-    storage path. A fresh process is the honest restore client — it IS
-    the restarted trainer, and it pays (only) real restart costs."""
+    parent staged, from agent shm (the agent-survives path). A fresh
+    process is the honest restore client — it IS the restarted trainer,
+    and it pays (only) real restart costs. The full-loss storage leg is
+    measured per-run by the 124M B2 child instead (at this scale it
+    re-moves 6.3 GB through the tunnel, ~6 min of bench wall)."""
     import gc
 
     from jax.sharding import SingleDeviceSharding
@@ -559,11 +561,10 @@ def _r15_child(jax, ckpt_dir: str, out_path: str, t_proc0: float) -> int:
         out["restored_step"] = int(step0)
         del state
         gc.collect()
-        t0 = time.perf_counter()
-        step1, state = engine.load(spec, ckpt_dir, prefer_memory=False)
-        sync(state)
-        out["restore_storage_s"] = round(time.perf_counter() - t0, 2)
-        out["restored_step_storage"] = int(step1)
+        # NOTE: no storage-restore leg at 1.5B — it re-moves 6.3 GB
+        # through the ~25 MB/s tunnel (~6 min of bench wall) and the
+        # 124M probe's B2 child already measures the full-loss path;
+        # the link-budget math extrapolates (bytes / measured link)
         out["t_end"] = time.time()
         return _write_json(out_path, out, 0 if step0 >= 0 else 1)
     finally:
@@ -573,10 +574,11 @@ def _r15_child(jax, ckpt_dir: str, out_path: str, t_proc0: float) -> int:
 def run_flashckpt_1p5b(jax, results: dict, carry: dict):
     """Flash-checkpoint lifecycle at 1.5B (VERDICT r4 #1b): the live
     GPT-2 XL bf16 params + 8-bit Adam state from the MFU probe goes
-    through async stage -> commit -> fresh-process restore (shm and
-    full-loss storage paths). The bar: the reference's 1.5B blog
-    scenario (flash_checkpoint.md:292-332 — 0.5 s save block, in-memory
-    restore) and BASELINE.md's restore < 10 s north star."""
+    through async stage -> commit -> fresh-process restore from agent
+    shm (full-loss storage is the 124M B2 child's job). The bar: the
+    reference's 1.5B blog scenario (flash_checkpoint.md:292-332 —
+    0.5 s save block, in-memory restore) and BASELINE.md's
+    restore < 10 s north star."""
     import gc
 
     from dlrover_tpu.ckpt.engine import CheckpointEngine
@@ -627,15 +629,16 @@ def run_flashckpt_1p5b(jax, results: dict, carry: dict):
             "R15", os.path.join(tmp, "r15.json"), env, 900
         )
         results["flash_1p5b_restore_shm_s"] = r["restore_shm_s"]
-        results["flash_1p5b_restore_storage_s"] = r["restore_storage_s"]
         results["flash_1p5b_restore_link_MBps"] = r.get("h2d_MBps")
         results["flash_1p5b_note"] = (
             "live 1.5B bf16+8bit-Adam state async-staged off the train "
             "loop (save_block is the critical-path cost), committed to "
             "disk by the agent saver, restored by a FRESH trainer "
-            "process from agent shm and, separately, from storage "
-            "(full loss). Stage/persist ride the harness's ~45 MB/s "
-            "tunneled d2h link off the critical path"
+            "process from agent shm; restore is link physics (6.3 GB "
+            "over the measured ~25 MB/s tunnel; ~6 s on a >=1 GB/s "
+            "TPU-VM host). Full-loss storage restore measured once in "
+            "round-5 validation at 366 s (disk read + same link) and "
+            "is covered per-run by the 124M B2 child"
         )
     except Exception as e:
         results["flash_1p5b_error"] = repr(e)
@@ -904,9 +907,18 @@ def run_sp_compare(jax, results: dict):
                 ms = round((time.perf_counter() - t0) / iters * 1e3, 2)
                 results[f"sp_{scheme}_{kernel}_ms_{T}"] = ms
                 best[(scheme, kernel)] = ms
-        results[f"sp_recommended_{T}"] = min(
-            ("ring", "ulysses"),
-            key=lambda s: min(best[(s, "fused")], best[(s, "stream")]),
+        # same tie rule (and the same constant) as
+        # parallel/sp_select.py: ulysses must WIN by margin (its
+        # all-to-alls don't overlap; ring's ppermute does) — run-to-run
+        # tunnel variance otherwise flips a ~1% difference
+        from dlrover_tpu.parallel.sp_select import _TIE_MARGIN
+
+        ring_ms = min(best[("ring", "fused")], best[("ring", "stream")])
+        uly_ms = min(
+            best[("ulysses", "fused")], best[("ulysses", "stream")]
+        )
+        results[f"sp_recommended_{T}"] = (
+            "ulysses" if uly_ms < ring_ms * _TIE_MARGIN else "ring"
         )
     # legacy comparability rows (round-4 names, best kernel per scheme)
     results["sp_ring_attn_ms"] = min(

@@ -14,6 +14,10 @@ scheme, per-device attention ms:
     seq 4096:  ring 3.83   ulysses 6.29
     seq 8192:  ring 6.91   ulysses 6.86   (a tie)
 
+(A second full-bench run measured ring 4.09 / ulysses 4.05 at 4096 —
+run-to-run tunnel variance swamps sub-10% differences, which is what
+the tie margin below exists to absorb.)
+
 Compute converges at long context; what the one-chip table cannot time
 is communication, and there the schemes differ structurally: ring's
 per-hop ppermute overlaps the next chunk's kernel, while Ulysses pays

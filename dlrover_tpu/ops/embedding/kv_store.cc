@@ -25,8 +25,10 @@
 //   (seed, key): deterministic across shards/restarts, no RNG state.
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <mutex>
+#include <string>
 #include <unordered_map>
 #include <vector>
 
@@ -565,6 +567,230 @@ int64_t kv_delete_before_timestamp(void* h, int64_t ts_limit) {
       }
     }
   }
+  return n;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Native cold tier (hybrid embedding spill store).
+//
+// Parity: tfplus hybrid_embedding keeps the TIER MANAGER native
+// (table_manager.h:547, storage_table.h:199): the hot->cold eviction and
+// cold->hot fault-in move rows entirely inside C++ — one pass over the
+// buckets, no per-row Python/sqlite marshaling — which is what makes
+// recommender-scale gathers with faulting viable. The cold tier is an
+// append-only spill log (fixed header + row floats; tombstones on
+// fault-in) with an in-memory index rebuilt by a single scan at open, so
+// it survives restarts and compacts naturally on rewrite.
+//
+// Concurrency contract: the embedding wrapper's tier lock (tiered.py
+// _RWLock) serializes tier MOVES against gathers; within that contract
+// the cold store needs only its own mutex for file/index access.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct ColdRecHeader {
+  int64_t key;
+  int64_t freq;
+  int64_t ts;
+  int64_t seq;
+  int64_t kind;  // 1 = row payload follows, 0 = tombstone
+};
+
+struct ColdEnt {
+  int64_t offset;  // file offset of the row payload
+  int64_t freq;
+  int64_t ts;
+  int64_t seq;
+};
+
+struct ColdStore {
+  std::mutex mu;
+  std::FILE* f = nullptr;
+  int64_t row_floats = 0;
+  int64_t max_seq = 0;
+  std::unordered_map<int64_t, ColdEnt> index;
+};
+
+bool cold_append(ColdStore* c, const ColdRecHeader& hdr,
+                 const float* row) {
+  std::fseek(c->f, 0, SEEK_END);
+  if (std::fwrite(&hdr, sizeof(hdr), 1, c->f) != 1) return false;
+  if (hdr.kind == 1) {
+    int64_t payload = std::ftell(c->f);
+    if (std::fwrite(row, sizeof(float),
+                    static_cast<size_t>(c->row_floats),
+                    c->f) != static_cast<size_t>(c->row_floats))
+      return false;
+    c->index[hdr.key] = ColdEnt{payload, hdr.freq, hdr.ts, hdr.seq};
+  } else {
+    c->index.erase(hdr.key);
+  }
+  if (hdr.seq > c->max_seq) c->max_seq = hdr.seq;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open (creating if absent) a spill log; rebuilds the index by scan.
+// Returns nullptr when the file cannot be opened or is malformed for
+// this row size.
+void* cold_open(const char* path, int64_t row_floats) {
+  std::FILE* f = std::fopen(path, "r+b");
+  if (!f) f = std::fopen(path, "w+b");
+  if (!f) return nullptr;
+  ColdStore* c = new ColdStore();
+  c->f = f;
+  c->row_floats = row_floats;
+  std::fseek(f, 0, SEEK_END);
+  const int64_t fsize = std::ftell(f);
+  const int64_t row_bytes =
+      static_cast<int64_t>(sizeof(float)) * row_floats;
+  std::fseek(f, 0, SEEK_SET);
+  int64_t off = 0;
+  ColdRecHeader hdr;
+  // crash recovery: a record torn mid-append (writer died between the
+  // header and the payload landing) is the un-completed tail of the
+  // log — drop it and every byte after it, keep everything before.
+  // (fseek past EOF SUCCEEDS on binary streams, so truncation must be
+  // detected against the byte count, not a seek failure.)
+  while (off + static_cast<int64_t>(sizeof(hdr)) <= fsize) {
+    if (std::fread(&hdr, sizeof(hdr), 1, f) != 1) break;
+    off += static_cast<int64_t>(sizeof(hdr));
+    if (hdr.kind == 1) {
+      if (off + row_bytes > fsize) break;  // torn payload: drop tail
+      c->index[hdr.key] = ColdEnt{off, hdr.freq, hdr.ts, hdr.seq};
+      off += row_bytes;
+      std::fseek(f, static_cast<long>(off), SEEK_SET);
+    } else {
+      c->index.erase(hdr.key);
+    }
+    if (hdr.seq > c->max_seq) c->max_seq = hdr.seq;
+  }
+  return c;
+}
+
+void cold_close(void* h) {
+  ColdStore* c = static_cast<ColdStore*>(h);
+  if (c->f) std::fclose(c->f);
+  delete c;
+}
+
+int64_t cold_count(void* h) {
+  ColdStore* c = static_cast<ColdStore*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  return static_cast<int64_t>(c->index.size());
+}
+
+int64_t cold_max_seq(void* h) {
+  ColdStore* c = static_cast<ColdStore*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  return c->max_seq;
+}
+
+// Move every hot row last touched before ts_limit into the cold log,
+// stamped with eviction sequence `seq`. Returns the number moved (or
+// -1 on a write error; rows stay hot on failure).
+int64_t kv_evict_to_cold(void* hot_h, void* cold_h, int64_t ts_limit,
+                         int64_t seq) {
+  Store* s = static_cast<Store*>(hot_h);
+  ColdStore* c = static_cast<ColdStore*>(cold_h);
+  int64_t moved = 0;
+  std::lock_guard<std::mutex> cg(c->mu);
+  for (auto& b : s->buckets) {
+    std::lock_guard<std::mutex> g(b.mu);
+    for (auto it = b.map.begin(); it != b.map.end();) {
+      if (it->second.ts >= ts_limit) {
+        ++it;
+        continue;
+      }
+      ColdRecHeader hdr{it->first, it->second.freq, it->second.ts, seq,
+                        1};
+      if (!cold_append(c, hdr, it->second.data.data())) return -1;
+      it = b.map.erase(it);
+      ++moved;
+    }
+  }
+  std::fflush(c->f);
+  return moved;
+}
+
+// Fault keys present in the cold tier back into the hot store (values
+// AND optimizer slots travel; freq/ts preserved), tombstoning them in
+// the log. Keys not in the cold tier are ignored. Returns the number
+// faulted in (or -1 on an IO error).
+int64_t kv_fault_from_cold(void* hot_h, void* cold_h,
+                           const int64_t* keys, int64_t n) {
+  Store* s = static_cast<Store*>(hot_h);
+  ColdStore* c = static_cast<ColdStore*>(cold_h);
+  int64_t rf = s->row_floats();
+  std::vector<float> row(static_cast<size_t>(rf));
+  int64_t moved = 0;
+  std::lock_guard<std::mutex> cg(c->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = c->index.find(keys[i]);
+    if (it == c->index.end()) continue;
+    if (std::fseek(c->f, static_cast<long>(it->second.offset),
+                   SEEK_SET) != 0)
+      return -1;
+    if (std::fread(row.data(), sizeof(float), static_cast<size_t>(rf),
+                   c->f) != static_cast<size_t>(rf))
+      return -1;
+    {
+      Bucket& b = s->bucket(keys[i]);
+      std::lock_guard<std::mutex> g(b.mu);
+      Row& r = b.map[keys[i]];
+      r.data.assign(row.begin(), row.end());
+      r.freq = it->second.freq;
+      r.ts = it->second.ts;
+      r.version = s->next_version();
+    }
+    ColdRecHeader tomb{keys[i], 0, 0, it->second.seq, 0};
+    if (!cold_append(c, tomb, nullptr)) return -1;
+    ++moved;
+  }
+  std::fflush(c->f);
+  return moved;
+}
+
+// Export live cold rows with seq > since into caller buffers; returns
+// the count, or -1 if capacity is too small, -2 on IO error.
+int64_t cold_export(void* h, int64_t since, int64_t* keys_out,
+                    float* rows_out, int64_t* freq_out, int64_t* ts_out,
+                    int64_t capacity) {
+  ColdStore* c = static_cast<ColdStore*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  int64_t n = 0;
+  for (auto& kv : c->index) {
+    if (kv.second.seq <= since) continue;
+    if (n >= capacity) return -1;
+    if (std::fseek(c->f, static_cast<long>(kv.second.offset),
+                   SEEK_SET) != 0)
+      return -2;
+    if (std::fread(rows_out + n * c->row_floats, sizeof(float),
+                   static_cast<size_t>(c->row_floats),
+                   c->f) != static_cast<size_t>(c->row_floats))
+      return -2;
+    keys_out[n] = kv.first;
+    freq_out[n] = kv.second.freq;
+    ts_out[n] = kv.second.ts;
+    ++n;
+  }
+  return n;
+}
+
+// Count of live cold rows with seq > since (delta-export sizing —
+// mirrors kv_export_count for the hot tier).
+int64_t cold_export_count(void* h, int64_t since) {
+  ColdStore* c = static_cast<ColdStore*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  int64_t n = 0;
+  for (auto& kv : c->index)
+    if (kv.second.seq > since) ++n;
   return n;
 }
 

@@ -98,6 +98,22 @@ def _load_library() -> ctypes.CDLL:
         lib.kv_delete_before_timestamp.restype = i64
         lib.kv_delete_before_timestamp.argtypes = [p, i64]
         lib.kv_meta.argtypes = [p, I64P, i64, I64P, I64P]
+        # native cold tier (hybrid embedding spill store)
+        lib.cold_open.restype = p
+        lib.cold_open.argtypes = [ctypes.c_char_p, i64]
+        lib.cold_close.argtypes = [p]
+        lib.cold_count.restype = i64
+        lib.cold_count.argtypes = [p]
+        lib.cold_max_seq.restype = i64
+        lib.cold_max_seq.argtypes = [p]
+        lib.kv_evict_to_cold.restype = i64
+        lib.kv_evict_to_cold.argtypes = [p, p, i64, i64]
+        lib.kv_fault_from_cold.restype = i64
+        lib.kv_fault_from_cold.argtypes = [p, p, I64P, i64]
+        lib.cold_export.restype = i64
+        lib.cold_export.argtypes = [p, i64, I64P, F32P, I64P, I64P, i64]
+        lib.cold_export_count.restype = i64
+        lib.cold_export_count.argtypes = [p, i64]
         _LIB = lib
         return lib
 

@@ -31,6 +31,15 @@ class AccelerateResult:
     step_fn: Callable
     init_fn: Callable  # key -> sharded TrainState
     reports: List[DryRunReport]
+    # a twin of step_fn that donates the input state AND batch buffers
+    # (donation-aware stepping: the trainer flips to it whenever no
+    # async checkpoint staging is reading the state, and back to the
+    # non-donating step_fn while one is). Built only when step_fn is
+    # actually safe to flip back to — i.e. the caller passed
+    # donate=False — and the path supports it (no pipeline parallel, no
+    # offloaded optimizer); None otherwise. jit is lazy, so the twin
+    # costs nothing until its first call.
+    donating_step_fn: Optional[Callable] = None
 
 
 def auto_accelerate(
@@ -177,6 +186,18 @@ def auto_accelerate(
     cfg2, mesh, step_fn, init_fn, _, _ = _build(
         strategy, cfg, tx, devices, donate=donate
     )
+    donating_step_fn = None
+    if strategy.mesh.pp == 1 and not strategy.offload_opt and not donate:
+        from dlrover_tpu.models.train import build_train_step
+
+        # same program, full donation (state + inputs) — the trainer's
+        # donation-aware stepping flips between the two per step based
+        # on whether checkpoint staging is reading the state buffers
+        donating_step_fn = build_train_step(
+            cfg2, mesh, tx, donate=True,
+            grad_accum=strategy.grad_accum,
+            donate_inputs=True,
+        )
     return AccelerateResult(
         strategy=strategy,
         cfg=cfg2,
@@ -184,6 +205,7 @@ def auto_accelerate(
         step_fn=step_fn,
         init_fn=init_fn,
         reports=reports,
+        donating_step_fn=donating_step_fn,
     )
 
 

@@ -76,6 +76,7 @@ def _build(
     tx,
     devices,
     donate: bool = False,
+    donate_inputs: bool = False,
 ):
     """Build (cfg, mesh, step_fn, init_fn, make_batch, abstract_state)
     for a strategy. ``donate=False`` for dry runs (state is reused across
@@ -149,6 +150,7 @@ def _build(
             opt_shardings=(
                 shardings.opt_state if strategy.offload_opt else None
             ),
+            donate_inputs=donate_inputs,
         )
 
         def init_fn(key):
@@ -281,7 +283,9 @@ def compiled_cost(
         )
         x, y = make_batch(batch, seq)
         compiled = step_fn.lower(abstract_state(), x, y).compile()
-        ca = compiled.cost_analysis() or {}
+        from dlrover_tpu.common.jax_compat import cost_analysis_dict
+
+        ca = cost_analysis_dict(compiled)
         ma = compiled.memory_analysis()
         report.flops_per_device = float(ca.get("flops", 0.0))
         report.bytes_per_device = float(ca.get("bytes accessed", 0.0))

@@ -45,6 +45,66 @@ def chip_peak_tflops(device) -> Optional[float]:
 
 
 @dataclass
+class PipelineStats:
+    """Counters for the overlapped host↔device pipeline: the device
+    prefetcher (data/prefetch.py), donation-aware stepping and chunked
+    checkpoint staging (ckpt/engine.py) all write into one record so the
+    train loop can report how much host work actually left the critical
+    path. A "hit" is a ``next()`` that found a device-placed batch
+    already waiting; a "miss" waited on the producer."""
+
+    prefetch_hits: int = 0
+    prefetch_misses: int = 0
+    prefetch_reprimes: int = 0
+    prefetch_wait_s: float = 0.0  # time the consumer blocked on misses
+    stage_chunks: int = 0
+    stage_bytes: int = 0
+    stage_backlog_bytes: int = 0  # bytes still to stage (last observed)
+    stage_block_s: float = 0.0  # critical-path seconds spent in advance()
+    stage_commits: int = 0
+    donated_steps: int = 0
+    safe_steps: int = 0  # steps run without donation (staging in flight)
+    donated_bytes: int = 0
+
+    @property
+    def prefetch_overlap_pct(self) -> Optional[float]:
+        n = self.prefetch_hits + self.prefetch_misses
+        if not n:
+            return None
+        return round(100.0 * self.prefetch_hits / n, 2)
+
+    def as_dict(self) -> Dict[str, Any]:
+        d = {
+            "prefetch_hits": self.prefetch_hits,
+            "prefetch_misses": self.prefetch_misses,
+            "prefetch_overlap_pct": self.prefetch_overlap_pct,
+            "prefetch_reprimes": self.prefetch_reprimes,
+            "prefetch_wait_s": round(self.prefetch_wait_s, 4),
+            "stage_chunks": self.stage_chunks,
+            "stage_bytes": self.stage_bytes,
+            "stage_backlog_bytes": self.stage_backlog_bytes,
+            "stage_block_s": round(self.stage_block_s, 4),
+            "stage_commits": self.stage_commits,
+            "donated_steps": self.donated_steps,
+            "safe_steps": self.safe_steps,
+            "donated_bytes": self.donated_bytes,
+        }
+        return d
+
+    def summary(self) -> str:
+        ov = self.prefetch_overlap_pct
+        return (
+            f"prefetch {self.prefetch_hits}h/{self.prefetch_misses}m"
+            f" ({'-' if ov is None else ov}% overlap), "
+            f"staged {self.stage_bytes >> 20} MiB in {self.stage_chunks} "
+            f"chunks ({self.stage_block_s * 1e3:.1f} ms on critical "
+            f"path, {self.stage_commits} commits), donated "
+            f"{self.donated_bytes >> 20} MiB over {self.donated_steps} "
+            f"steps ({self.safe_steps} safe)"
+        )
+
+
+@dataclass
 class ModuleProfile:
     name: str
     params: int

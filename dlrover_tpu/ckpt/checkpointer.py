@@ -69,6 +69,22 @@ class FlashCheckpointer(Checkpointer):
             )
         return self.engine.save_to_memory(step, state, self.checkpoint_dir)
 
+    def begin_chunked_save(
+        self, step: int, state: Any, chunk_bytes: int = 64 << 20
+    ):
+        """Start an incremental (chunked) in-memory save: the returned
+        stager's ``advance(budget_s)`` runs between train steps and
+        ``commit()`` is the barrier. None = skipped (saver busy). See
+        ``CheckpointEngine.begin_chunked_save``."""
+        return self.engine.begin_chunked_save(
+            step, state, self.checkpoint_dir, chunk_bytes=chunk_bytes
+        )
+
+    def staging_in_flight(self) -> bool:
+        """True while any async/chunked staging still reads state
+        buffers (the train loop must not donate them)."""
+        return self.engine.staging_in_flight()
+
     def load_checkpoint(self, target: Any) -> Tuple[int, Optional[Any]]:
         """Returns ``(step, state)``; ``(-1, None)`` when no checkpoint
         exists yet."""

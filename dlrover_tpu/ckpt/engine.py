@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
+import numpy as np
+
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.multi_process import (
     SharedLock,
@@ -31,6 +33,7 @@ from dlrover_tpu.ckpt.saver import SaveEvent
 from dlrover_tpu.ckpt.sharding import (
     ShardRecord,
     host_shard_index_set,
+    host_shard_plan,
     host_shard_records,
     restore_state,
 )
@@ -51,6 +54,313 @@ def _overlaps(a, b) -> bool:
     return all(max(alo, blo) < min(ahi, bhi) for (alo, ahi), (blo, bhi) in zip(a, b)) if a else True
 
 
+class ChunkedStager:
+    """Incremental device→shm staging of one checkpoint.
+
+    ``save_to_memory`` drains the whole state in one go — either a
+    synchronous block on the train loop or a background thread that
+    forbids donation for its whole lifetime. The chunked stager instead
+    interleaves fixed-size chunks *between* train steps: the trainer
+    calls ``advance(budget_s)`` once per step (bounded critical-path
+    cost, default a few ms), and ``commit()`` is the only barrier — it
+    drains what is left, publishes the shm metadata and notifies the
+    agent saver. Until commit the metadata stays invalid, so a
+    concurrent restore can never observe a half-staged step (the same
+    crash-safe ordering ``ShmHandler.save_records`` uses).
+
+    D2H is pipelined one chunk ahead (``copy_to_host_async`` on chunk
+    N+1 while chunk N memcpys into shm). State buffers are read across
+    many steps, so the train loop must not donate them while
+    ``CheckpointEngine.staging_in_flight()`` is True — the trainer's
+    donation-aware stepping handles this.
+
+    Recovery-window tradeoff: like every shm save, ``begin`` invalidates
+    the PREVIOUS in-memory checkpoint before the first byte moves, and
+    here the invalid window spans the whole multi-step drain, not one
+    blocking memcpy. A crash inside that window restores from the last
+    *committed* storage step instead of shm. Callers who cannot afford
+    the longer window (very long drains between rare disk commits)
+    should keep ``save_to_memory`` for some cadence or shorten the
+    drain via a bigger per-step budget.
+    """
+
+    def __init__(
+        self,
+        engine: "CheckpointEngine",
+        step: int,
+        state: Any,
+        checkpoint_dir: str,
+        sync: bool,
+        chunk_bytes: int,
+    ):
+        self._engine = engine
+        self.step = step
+        self.checkpoint_dir = checkpoint_dir
+        self._sync = sync
+        self._chunk_bytes = max(int(chunk_bytes), 1 << 10)
+        # the plan holds live references to every device shard: the
+        # buffers stay alive (and unmutated — jax.Array is immutable)
+        # until the drain finishes, whatever the caller does to `state`
+        self._plan = host_shard_plan(state)
+        self._metas = ShmHandler.layout_records(
+            [rec for rec, _ in self._plan]
+        )
+        self.total_bytes = sum(m.nbytes for m in self._metas)
+        self._staged_bytes = 0
+        self.chunks_written = 0
+        self._cursor = 0  # plan index
+        self._elem_off = 0  # element offset within the current record
+        self._inflight = None  # (byte_offset, nbytes, host_producer)
+        self._finished = False
+        self._failed = False
+        self._engine._shm.begin_save(max(self.total_bytes, 1))
+
+    # -- introspection -------------------------------------------------
+    @property
+    def backlog_bytes(self) -> int:
+        return self.total_bytes - self._staged_bytes
+
+    @property
+    def done(self) -> bool:
+        """Every byte staged (commit may still be pending)."""
+        return (
+            self._cursor >= len(self._plan) and self._inflight is None
+        )
+
+    @property
+    def finished(self) -> bool:
+        """Committed or aborted — the engine's lock is out of our hands."""
+        return self._finished
+
+    # small write groups are never deferred on readiness: their D2H
+    # completes in microseconds and deferring would crawl the drain at
+    # one group per step
+    _DEFER_MIN_BYTES = 1 << 20
+
+    # -- chunk pipeline ------------------------------------------------
+    def _start_next(self):
+        """Build the next write group and start its D2H. A group is a
+        list of ``(byte_offset, nbytes, source)`` members totalling at
+        most ``chunk_bytes``: consecutive small records coalesce into
+        one group (a pytree of many tiny leaves must not become one
+        chunk per leaf), a record larger than ``chunk_bytes`` is split
+        into equal-size windows (consistent slice shapes, so the eager
+        slice op compiles once). Returns None at plan's end."""
+        import jax
+
+        group = []
+        budget = self._chunk_bytes
+        while self._cursor < len(self._plan) and budget > 0:
+            rec, src = self._plan[self._cursor]
+            meta = self._metas[self._cursor]
+            if isinstance(src, np.ndarray):
+                if src.nbytes > budget and group:
+                    break
+                group.append((meta.offset, src.nbytes, src))
+                budget -= src.nbytes
+                self._cursor += 1
+                continue
+            itemsize = np.dtype(rec.dtype).itemsize
+            n_elems = meta.nbytes // itemsize
+            if self._elem_off >= n_elems:
+                self._cursor += 1
+                self._elem_off = 0
+                continue
+            if meta.nbytes <= budget and self._elem_off == 0:
+                # whole small record joins the group, no slicing
+                dev = jax.numpy.ravel(src)
+                lo, hi = 0, n_elems
+            elif group:
+                break  # the big record starts its own group next call
+            else:
+                per_chunk = max(1, self._chunk_bytes // itemsize)
+                lo = self._elem_off
+                hi = min(lo + per_chunk, n_elems)
+                dev = jax.numpy.ravel(src)[lo:hi]
+            self._elem_off = hi
+            if self._elem_off >= n_elems:
+                self._cursor += 1
+                self._elem_off = 0
+            try:
+                dev.copy_to_host_async()
+            except Exception:
+                pass
+            group.append(
+                (meta.offset + lo * itemsize, (hi - lo) * itemsize, dev)
+            )
+            budget -= (hi - lo) * itemsize
+        return group or None
+
+    @classmethod
+    def _may_defer(cls, group) -> bool:
+        """True when a budgeted advance should leave this group to ride
+        the async stream instead of blocking on its transfer."""
+        total = sum(n for _, n, _ in group)
+        if total < cls._DEFER_MIN_BYTES:
+            return False
+        for _, _, src in group:
+            if isinstance(src, np.ndarray):
+                continue
+            try:
+                if not src.is_ready():
+                    return True
+            except AttributeError:
+                return False
+        return False
+
+    def _write_one(self) -> int:
+        """Consume the inflight group (start the next one's D2H first so
+        the transfer overlaps this memcpy). Returns bytes written."""
+        if self._inflight is None:
+            self._inflight = self._start_next()
+            if self._inflight is None:
+                return 0
+        group = self._inflight
+        self._inflight = self._start_next()
+        written = 0
+        for offset, nbytes, src in group:
+            data = (
+                src if isinstance(src, np.ndarray) else np.asarray(src)
+            )
+            self._engine._shm.write_chunk(offset, data)
+            written += nbytes
+        self._staged_bytes += written
+        self.chunks_written += 1
+        return written
+
+    def advance(
+        self,
+        budget_s: Optional[float] = None,
+        stats=None,
+    ) -> int:
+        """Stage chunks until ``budget_s`` of wall time is spent (None =
+        drain everything). A budgeted call never blocks on a D2H that
+        has not landed yet — the chunk stays in flight and the next
+        step's call consumes it, so the per-step cost is the shm memcpy
+        of chunks whose transfer already overlapped compute. Bounded
+        overshoot: at most one chunk past the budget. Returns bytes
+        staged by this call."""
+        if self._finished:
+            return 0
+        t0 = time.perf_counter()
+        copied = 0
+        chunks0 = self.chunks_written
+        try:
+            while not self.done:
+                if self._inflight is None:
+                    self._inflight = self._start_next()
+                    if self._inflight is None:
+                        break
+                if budget_s is not None and self._may_defer(
+                    self._inflight
+                ):
+                    break  # transfer still riding the async stream
+                copied += self._write_one()
+                if (
+                    budget_s is not None
+                    and time.perf_counter() - t0 >= budget_s
+                ):
+                    break
+        except BaseException:
+            self.abort()
+            raise
+        if stats is not None:
+            stats.stage_chunks += self.chunks_written - chunks0
+            stats.stage_bytes += copied
+            stats.stage_backlog_bytes = self.backlog_bytes
+            stats.stage_block_s += time.perf_counter() - t0
+        return copied
+
+    # -- barrier -------------------------------------------------------
+    def commit(self, stats=None) -> bool:
+        """The commit barrier: drain the backlog, publish metadata,
+        notify the agent saver. After this the shm checkpoint is
+        visible and the saver owns the shard lock."""
+        if self._finished:
+            return not self._failed
+        try:
+            self.advance(budget_s=None, stats=stats)
+            self._engine._shm.commit_save(
+                self.step,
+                self._metas,
+                {
+                    "checkpoint_dir": self.checkpoint_dir,
+                    "global_shard_id": self._engine.global_shard_id,
+                    "global_shard_num": self._engine.global_shard_num,
+                },
+            )
+        except BaseException as e:
+            self.abort()
+            logger.error(
+                f"step {self.step}: chunked staging commit failed: {e!r}"
+            )
+            raise
+        self._finished = True
+        self._plan = []
+        if stats is not None:
+            stats.stage_commits += 1
+        self._engine._queue.put(
+            SaveEvent(
+                step=self.step,
+                checkpoint_dir=self.checkpoint_dir,
+                local_rank=self._engine.local_rank,
+                global_shard_id=self._engine.global_shard_id,
+                global_shard_num=self._engine.global_shard_num,
+                sync=self._sync,
+            )
+        )
+        return True
+
+    def abort(self):
+        """Give up: metadata stays invalid (begin_save cleared it), the
+        shard lock goes back so future saves are not starved."""
+        if self._finished:
+            return
+        self._finished = True
+        self._failed = True
+        self._plan = []
+        self._inflight = None
+        # force_release, not release: abort may run from a thread other
+        # than the acquirer's (same rationale as _stage_and_notify)
+        self._engine._lock.force_release()
+
+
+class _SyncFallbackStager:
+    """No agent (plain ``python train.py``): chunked staging has no shm
+    to stage into, so the commit barrier just runs the synchronous
+    storage save. advance() is free; the caller's loop stays uniform."""
+
+    def __init__(self, engine, step, state, checkpoint_dir):
+        self._engine = engine
+        self.step = step
+        self._state = state
+        self.checkpoint_dir = checkpoint_dir
+        self.total_bytes = 0
+        self.chunks_written = 0
+        self.backlog_bytes = 0
+        self.done = True
+        self.finished = False
+
+    def advance(self, budget_s=None, stats=None) -> int:
+        return 0
+
+    def commit(self, stats=None) -> bool:
+        if self.finished:
+            return True
+        self.finished = True
+        ok = self._engine._save_sync(
+            self.step, self._state, self.checkpoint_dir
+        )
+        self._state = None
+        if stats is not None:
+            stats.stage_commits += 1
+        return ok
+
+    def abort(self):
+        self.finished = True
+        self._state = None
+
+
 class CheckpointEngine:
     """One per training process. Talks to the per-host agent saver when one
     is serving the IPC endpoints; otherwise falls back to synchronous
@@ -66,6 +376,7 @@ class CheckpointEngine:
         self._queue: Optional[SharedQueue] = None
         self._lock: Optional[SharedLock] = None
         self._staging_threads: list = []
+        self._active_stager = None
         if self._agent_mode:
             self._shm = ShmHandler(self.local_rank, create=False)
             self._queue = SharedQueue(saver_mod.CKPT_EVENT_QUEUE)
@@ -123,6 +434,57 @@ class CheckpointEngine:
             t.start()
         return True
 
+    def begin_chunked_save(
+        self,
+        step: int,
+        state: Any,
+        checkpoint_dir: str,
+        sync: bool = False,
+        chunk_bytes: int = 64 << 20,
+    ):
+        """Chunked variant of ``save_to_memory``: returns a stager whose
+        ``advance(budget_s)`` the train loop calls between steps and
+        whose ``commit()`` is the barrier, or None when the saver still
+        holds the shard lock (save skipped, never blocked on — same
+        contract as ``save_to_memory``). Without an agent the returned
+        stager falls back to a synchronous storage save at commit."""
+        if self._agent_mode:
+            assert self._lock and self._shm and self._queue
+            if not self._lock.acquire(blocking=False):
+                logger.warning(
+                    f"step {step}: saver busy persisting a previous "
+                    f"checkpoint; skipping this chunked save"
+                )
+                return None
+            try:
+                stager = ChunkedStager(
+                    self, step, state, checkpoint_dir, sync, chunk_bytes
+                )
+            except BaseException:
+                self._lock.force_release()
+                raise
+        else:
+            stager = _SyncFallbackStager(
+                self, step, state, checkpoint_dir
+            )
+        self._active_stager = stager
+        return stager
+
+    def staging_in_flight(self) -> bool:
+        """True while ANY staging still reads state buffers — a
+        ``block=False`` background drain or an uncommitted chunked
+        stager. The train loop must not run a state-donating step while
+        this holds (donation would invalidate the buffers mid-read)."""
+        self._staging_threads = [
+            t for t in self._staging_threads if t.is_alive()
+        ]
+        if self._staging_threads:
+            return True
+        st = self._active_stager
+        if st is not None and st.finished:
+            self._active_stager = st = None
+        return st is not None
+
     def wait_staging(self, timeout: float = 60.0):
         """Join in-flight ``block=False`` staging threads. Call before
         process exit: a daemon thread doing D2H against a runtime that is
@@ -136,6 +498,19 @@ class CheckpointEngine:
 
     def close(self, timeout: float = 60.0):
         """Drain staging threads and drop IPC clients."""
+        if (
+            self._active_stager is not None
+            and not self._active_stager.finished
+        ):
+            # an uncommitted chunked stage dies with the process — abort
+            # so the shard lock is not leaked (metadata is already
+            # invalid, so no reader can see the partial bytes)
+            logger.warning(
+                f"closing engine with an uncommitted chunked stage at "
+                f"step {self._active_stager.step}; aborting it"
+            )
+            self._active_stager.abort()
+        self._active_stager = None
         self.wait_staging(timeout)
         if self._staging_threads:
             # a wedged thread is about to race the shm close below — make

@@ -113,6 +113,49 @@ def host_shard_records(state: Any) -> List[ShardRecord]:
     return records
 
 
+def host_shard_plan(state: Any) -> List[Tuple[ShardRecord, Any]]:
+    """``host_shard_records`` without the device→host copies: each
+    entry is ``(record_with_data_None, source)`` where ``source`` is
+    the single-device ``jax.Array`` shard still on the chip, or a host
+    numpy copy for non-device leaves. The chunked stager (ckpt/engine.py)
+    drains sources incrementally between train steps; host leaves are
+    copied eagerly because they are tiny AND mutable (e.g. sampler
+    state) — the snapshot must be of save time, not drain time."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    plan: List[Tuple[ShardRecord, Any]] = []
+    for kp, leaf in leaves:
+        path = _keystr(kp)
+        if isinstance(leaf, jax.Array):
+            gshape = tuple(leaf.shape)
+            dt = str(leaf.dtype)
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue
+                rec = ShardRecord(
+                    path=path,
+                    global_shape=gshape,
+                    dtype=dt,
+                    index=_slices_to_index(shard.index, gshape),
+                )
+                plan.append((rec, shard.data))
+        else:
+            arr = np.array(leaf)  # eager copy: see docstring
+            plan.append(
+                (
+                    ShardRecord(
+                        path=path,
+                        global_shape=tuple(arr.shape),
+                        dtype=str(arr.dtype),
+                        index=tuple((0, d) for d in arr.shape),
+                    ),
+                    arr,
+                )
+            )
+    return plan
+
+
 def target_shards(leaf) -> Optional[List[Tuple[Any, Index]]]:
     """``[(device, index), ...]`` this process must fill to rebuild
     ``leaf`` — one entry per addressable shard, replicas included.

@@ -62,9 +62,54 @@ class ShmHandler:
         self._shm: Optional[SharedMemory] = None
 
     # -- writer (training process) -------------------------------------
-    def save_records(
-        self, step: int, records: List[ShardRecord], extra: Dict
+    def begin_save(self, nbytes: int) -> None:
+        """Open an incremental write: invalidate the published metadata
+        (crash-safe ordering — a reader can never see new-step metadata
+        over partially written bytes) and (re)size the segment. Bytes
+        then land via ``write_chunk``; ``commit_save`` publishes."""
+        total = max(int(nbytes), 1)
+        if self._shm is None or self._shm.size < total:
+            if self._shm is not None:
+                self._shm.close()
+            self._shm = create_shared_memory(
+                shard_shm_name(self.local_rank), total
+            )
+            if self._shm is None:
+                raise RuntimeError("cannot allocate checkpoint shm")
+        self._meta.set("valid", False)
+
+    def write_chunk(self, offset: int, data: np.ndarray) -> None:
+        """Copy one chunk of raw bytes into the open segment. ``data``
+        is any array; its buffer lands byte-for-byte at ``offset``."""
+        src = np.ascontiguousarray(data)
+        view = np.ndarray(
+            (src.nbytes,),
+            dtype=np.uint8,
+            buffer=self._shm.buf,
+            offset=offset,
+        )
+        view[:] = src.view(np.uint8).reshape(-1)
+
+    def commit_save(
+        self, step: int, metas: List[RecordMeta], extra: Dict
     ) -> None:
+        """Publish the metadata for bytes already written — the moment
+        the checkpoint becomes visible to readers."""
+        self._meta.update(
+            {
+                "step": step,
+                "records": [asdict(m) for m in metas],
+                "extra": extra,
+                "shm_name": shard_shm_name(self.local_rank),
+                "valid": True,
+            }
+        )
+
+    @staticmethod
+    def layout_records(records: List[ShardRecord]) -> List[RecordMeta]:
+        """Assign contiguous offsets to ``records`` (data may be None —
+        only dtype/index sizes are read, so a chunked writer can lay
+        out the segment before any device→host copy happens)."""
         metas: List[RecordMeta] = []
         offset = 0
         for r in records:
@@ -75,37 +120,24 @@ class ShmHandler:
                     dtype=r.dtype,
                     index=r.index,
                     offset=offset,
-                    nbytes=r.data.nbytes,
+                    nbytes=r.nbytes,
                 )
             )
-            offset += r.data.nbytes
-        total = max(offset, 1)
-        if self._shm is None or self._shm.size < total:
-            if self._shm is not None:
-                self._shm.close()
-            self._shm = create_shared_memory(
-                shard_shm_name(self.local_rank), total
-            )
-            if self._shm is None:
-                raise RuntimeError("cannot allocate checkpoint shm")
-        # invalidate before mutating bytes
-        self._meta.set("valid", False)
-        buf = self._shm.buf
+            offset += r.nbytes
+        return metas
+
+    def save_records(
+        self, step: int, records: List[ShardRecord], extra: Dict
+    ) -> None:
+        """One-shot write: layout + begin + every chunk + commit (the
+        synchronous-drain path; the chunked stager in ckpt/engine.py
+        interleaves the same primitives between train steps)."""
+        metas = self.layout_records(records)
+        total = metas[-1].offset + metas[-1].nbytes if metas else 1
+        self.begin_save(total)
         for r, m in zip(records, metas):
-            src = np.ascontiguousarray(r.data)
-            view = np.ndarray(
-                (m.nbytes,), dtype=np.uint8, buffer=buf, offset=m.offset
-            )
-            view[:] = src.view(np.uint8).reshape(-1)
-        self._meta.update(
-            {
-                "step": step,
-                "records": [asdict(m) for m in metas],
-                "extra": extra,
-                "shm_name": shard_shm_name(self.local_rank),
-                "valid": True,
-            }
-        )
+            self.write_chunk(m.offset, r.data)
+        self.commit_save(step, metas, extra)
 
     # -- reader (agent saver, or engine on restore) --------------------
     def metadata(self) -> Dict:

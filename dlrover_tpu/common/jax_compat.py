@@ -1,0 +1,129 @@
+"""Version-compat shims for the JAX API surface this repo uses.
+
+The codebase targets the modern names; older jaxlibs in some
+deployment images (0.4.x) keep the same functionality under the
+pre-promotion paths. Import the symbols from here so every call site
+stays version-agnostic:
+
+- ``shard_map``: promoted to ``jax.shard_map`` in 0.5; lives in
+  ``jax.experimental.shard_map`` before that.
+- ``pallas_tpu_compiler_params``: ``pltpu.CompilerParams`` was named
+  ``TPUCompilerParams`` on 0.4.x.
+"""
+
+from __future__ import annotations
+
+import os
+
+try:  # jax >= 0.5
+    from jax import shard_map as _shard_map
+
+    _MODERN = True
+except ImportError:  # 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _MODERN = False
+
+
+def shard_map(
+    f,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    axis_names=None,
+    check_vma=None,
+    **kwargs,
+):
+    """``jax.shard_map`` with the modern keyword surface on any version.
+
+    On 0.4.x the same knobs exist under pre-promotion names with
+    inverted semantics: ``axis_names`` (manual over THESE axes) maps to
+    ``auto`` (its complement — axes left to GSPMD), and ``check_vma``
+    was called ``check_rep``."""
+    if _MODERN:
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+    else:
+        if axis_names is not None:
+            kwargs["auto"] = frozenset(mesh.axis_names) - set(
+                axis_names
+            )
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+def pcast(x, axis_names, to="varying"):
+    """``lax.pcast`` (the VMA replicated→varying marker, jax >= 0.7).
+
+    Older jaxlibs have no varying-manual-axes tracking: inside a
+    ``shard_map`` built with ``check_vma=False`` (which this shim maps
+    to ``check_rep=False``) replication is simply not checked, so the
+    cast is a semantic no-op there."""
+    from jax import lax
+
+    fn = getattr(lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axis_names, to=to)
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Force an ``n``-device virtual CPU backend on any jax version.
+
+    Modern jax has the ``jax_num_cpu_devices`` config option; 0.4.x
+    only honors the XLA flag, which works as long as the backend has
+    not been created yet (creation is lazy even when jax was imported
+    at interpreter start by sitecustomize)."""
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+        return
+    except AttributeError:
+        pass
+    flag = f"--xla_force_host_platform_device_count={int(n)}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+
+
+def set_cpu_collectives(impl: str = "gloo") -> None:
+    """Best-effort CPU collectives selection: newer jaxlibs accept the
+    config; older single-process ones reject gloo without a distributed
+    client — fall back to plain (in-process collectives don't need it).
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", impl)
+    except AttributeError:
+        return
+    try:
+        jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_cpu_collectives_implementation", "none")
+
+
+def cost_analysis_dict(compiled) -> dict:
+    """``compiled.cost_analysis()`` as one flat dict on any version
+    (0.4.x returned a list with one per-program dict)."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca or {}
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` under either name."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    return cls(**kwargs)

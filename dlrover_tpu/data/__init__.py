@@ -1,6 +1,10 @@
 """Host-side data plane (parity: atorch data/ — shm coworker feeds,
 elastic datasets)."""
 
+from dlrover_tpu.data.prefetch import (  # noqa: F401
+    DevicePrefetcher,
+    sharded_placement,
+)
 from dlrover_tpu.data.shm_feed import (  # noqa: F401
     ShmBatchReader,
     ShmBatchWriter,

@@ -1,0 +1,234 @@
+"""Double-buffered device prefetcher: overlap input transfer with compute.
+
+The train loop's input path was fully serial: pull the next host batch
+from the feed iterator (shm ring / remote feed / token dataset), turn it
+into device arrays, then run the step. Both host legs ride the critical
+path even though ``jax.device_put`` dispatches asynchronously and the
+feed iterator's cost is pure host work. ``DevicePrefetcher`` moves both
+off the step cadence (the TorchTitan/ATorch input-pipelining recipe):
+
+- a producer thread pulls batch N+1 from the source iterator and issues
+  its device placement while batch N computes on the chip;
+- placement is pluggable, so the sharded-batch path
+  (``make_array_from_process_local_data`` over the live mesh) composes
+  with pjit exactly like the synchronous path did — see
+  ``sharded_placement``;
+- the buffer survives elastic resizes: ``reprime(new_placement)`` drops
+  the *device* copies but keeps the buffered host batches and re-places
+  them under the new mesh, so a world change costs a re-transfer, never
+  lost samples;
+- exhaustion and producer exceptions propagate to the consumer in
+  order: every batch yielded before the failure is delivered first,
+  then the original exception is re-raised from ``__next__``.
+
+Stats land in an ``accel.profiler.PipelineStats`` record (hits = the
+batch was already device-placed when the step asked for it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Iterator, Optional
+
+from dlrover_tpu.accel.profiler import PipelineStats
+from dlrover_tpu.common.log import default_logger as logger
+
+# buffer entry kinds: ("batch", host, device) | ("perr", host, exc)
+# (placement failed; host kept so reprime can retry) | ("err", exc)
+# (source raised) | ("end",)
+
+
+def _default_placement(batch: Any):
+    import jax
+
+    return jax.device_put(batch)
+
+
+def sharded_placement(mesh) -> Callable[[Any], Any]:
+    """Placement fn for the mesh/pjit path: every array leaf becomes a
+    global ``jax.Array`` sharded like a training batch (same layout as
+    ``models.train.shard_batch``). Build a fresh one after an elastic
+    resize and hand it to ``reprime``."""
+    import jax
+    import numpy as np
+
+    from dlrover_tpu.parallel.mesh import batch_sharding
+
+    sharding = batch_sharding(mesh)
+
+    def place(batch: Any):
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            ),
+            batch,
+        )
+
+    return place
+
+
+class DevicePrefetcher:
+    """Wrap any batch iterator with a depth-``depth`` device-side buffer.
+
+    Iterate it exactly like the source; batches come back device-placed
+    (whatever ``placement`` returns). ``depth=2`` is classic double
+    buffering: one batch computing, one in flight.
+    """
+
+    def __init__(
+        self,
+        source: Iterator[Any],
+        placement: Optional[Callable[[Any], Any]] = None,
+        depth: int = 2,
+        stats: Optional[PipelineStats] = None,
+    ):
+        self._src = iter(source)
+        self._place = placement or _default_placement
+        self._depth = max(1, int(depth))
+        self.stats = stats if stats is not None else PipelineStats()
+        self._cond = threading.Condition()
+        self._buf: deque = deque()
+        self._gen = 0  # bumped by reprime; in-flight placements re-check
+        self._closed = False
+        self._producer_done = False
+        # True while the producer is inside next(self._src): the source
+        # cursor may have advanced for a batch not yet in the buffer
+        self._pulling = False
+        self._thread = threading.Thread(
+            target=self._produce, daemon=True, name="device-prefetch"
+        )
+        self._thread.start()
+
+    # -- producer ------------------------------------------------------
+    def _entry(self, host: Any, place: Callable[[Any], Any]):
+        try:
+            return ("batch", host, place(host))
+        except Exception as e:  # placement failure: host batch survives
+            return ("perr", host, e)
+
+    def _produce(self):
+        while True:
+            with self._cond:
+                while not self._closed and len(self._buf) >= self._depth:
+                    self._cond.wait()
+                if self._closed:
+                    return
+                gen, place = self._gen, self._place
+                self._pulling = True
+            # the slow legs (source pull + device placement dispatch)
+            # run OUTSIDE the lock so the consumer never blocks on them
+            try:
+                host = next(self._src)
+            except StopIteration:
+                entry = ("end",)
+            except BaseException as e:  # noqa: BLE001 — must propagate
+                entry = ("err", e)
+            else:
+                entry = self._entry(host, place)
+            with self._cond:
+                self._pulling = False
+                if entry[0] in ("batch", "perr") and self._gen != gen:
+                    # a reprime raced this placement: the device copy
+                    # targets the old world — re-place under the new one
+                    entry = self._entry(entry[1], self._place)
+                self._buf.append(entry)
+                self._cond.notify_all()
+                if entry[0] in ("end", "err"):
+                    self._producer_done = True
+                    return
+
+    # -- consumer ------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        with self._cond:
+            waited = None
+            if not self._buf:
+                t0 = time.perf_counter()
+                while not self._buf:
+                    if self._closed:
+                        raise RuntimeError("DevicePrefetcher is closed")
+                    self._cond.wait()
+                waited = time.perf_counter() - t0
+            head = self._buf[0]
+            kind = head[0]
+            if kind == "batch":
+                # hit/miss counts batch deliveries only — the final
+                # wait for the end sentinel is not a pipeline stall
+                if waited is None:
+                    self.stats.prefetch_hits += 1
+                else:
+                    self.stats.prefetch_misses += 1
+                    self.stats.prefetch_wait_s += waited
+            if kind == "end":
+                # leave the sentinel: repeated next() keeps raising
+                raise StopIteration
+            if kind == "err":
+                # source failure is terminal — keep it at the head so
+                # the caller sees the SAME error on every retry
+                raise head[1]
+            if kind == "perr":
+                # placement failure is retryable: reprime() re-places
+                # the kept host batch (elastic resize recovery)
+                raise head[2]
+            self._buf.popleft()
+            self._cond.notify_all()  # wake the producer to top up
+            return head[2]
+
+    def buffered_batches(self) -> int:
+        """Batches pulled from the source but not yet consumed. A
+        checkpointing train loop rewinds its sampler snapshot by this
+        count — the source's cursor ran ahead of what actually
+        trained. A pull in flight counts as one: the source may have
+        advanced for it already (if it hadn't yet, the over-rewind
+        repeats one batch, the safe direction)."""
+        with self._cond:
+            return (1 if self._pulling else 0) + sum(
+                1 for e in self._buf if e[0] in ("batch", "perr")
+            )
+
+    # -- elasticity ----------------------------------------------------
+    def reprime(
+        self, placement: Optional[Callable[[Any], Any]] = None
+    ) -> int:
+        """World changed: drop every buffered *device* copy and re-place
+        the kept host batches under ``placement`` (or the existing one).
+        No sample is lost — order is preserved. Returns the number of
+        batches re-placed."""
+        with self._cond:
+            if placement is not None:
+                self._place = placement
+            self._gen += 1
+            place = self._place
+            n = 0
+            rebuilt: deque = deque()
+            for entry in self._buf:
+                if entry[0] in ("batch", "perr"):
+                    rebuilt.append(self._entry(entry[1], place))
+                    n += 1
+                else:
+                    rebuilt.append(entry)
+            self._buf = rebuilt
+            self.stats.prefetch_reprimes += 1
+            self._cond.notify_all()
+        if n:
+            logger.info(
+                f"prefetcher reprimed: {n} buffered batches re-placed "
+                f"for the new world"
+            )
+        return n
+
+    def close(self):
+        """Stop the producer and drop the buffer. Safe to call twice.
+        The producer thread is a daemon, so a source blocked in a
+        network read cannot wedge interpreter exit."""
+        with self._cond:
+            self._closed = True
+            self._buf.clear()
+            self._cond.notify_all()
+        # short join: a producer wedged in a blocking source read is a
+        # daemon thread and must not stall the caller's teardown
+        self._thread.join(timeout=1.0)

@@ -116,9 +116,30 @@ def decode_batch(payload: bytes) -> Any:
     off = _LEN.size + hlen
     arrays = []
     for meta in header["arrays"]:
+        # the peer is untrusted (the whole wire format is pickle-free
+        # for that reason) — header fields get the same skepticism: a
+        # negative dim makes np.prod negative and frombuffer(count=-1)
+        # consume the rest of the payload, silently desyncing every
+        # later array into garbage instead of a loud error
         dt = np.dtype(meta["d"])
+        if dt.hasobject:
+            raise ValueError(
+                "batch header declares an object dtype (arbitrary-"
+                "object deserialization is exactly what this format "
+                "forbids)"
+            )
         shape = tuple(meta["s"])
+        if any(
+            not isinstance(d, int) or isinstance(d, bool) or d < 0
+            for d in shape
+        ):
+            raise ValueError(f"batch header has invalid dims {shape!r}")
         count = int(np.prod(shape))  # () -> 1, any 0-dim -> 0
+        if off + count * dt.itemsize > len(payload):
+            raise ValueError(
+                f"batch header declares {count * dt.itemsize} bytes at "
+                f"offset {off} but the payload holds {len(payload)}"
+            )
         arrays.append(
             np.frombuffer(payload, dt, count=count, offset=off)
             .reshape(shape)
@@ -241,7 +262,22 @@ class DataNodeServer:
                             f"data node {self.name}: bad request {req!r}"
                         )
                         return
-                    payload = self._next_payload()
+                    try:
+                        payload = self._next_payload()
+                    except TypeError as e:
+                        # encode_batch rejected an unsupported leaf: the
+                        # popped batch is unsendable (and lost), so log
+                        # the cause server-side and close the stream
+                        # with the 0-length EOF frame — the client sees
+                        # a deliberate protocol end, not an abrupt reset
+                        # it would misread as a network failure
+                        logger.error(
+                            f"data node {self.name}: batch not "
+                            f"encodable ({e}); ending this stream with "
+                            f"EOF"
+                        )
+                        conn.sendall(_LEN.pack(0))
+                        return
                     conn.sendall(_LEN.pack(len(payload)) + payload)
                     if not payload:
                         return

@@ -195,8 +195,16 @@ def build_train_step(
     grad_accum: int = 1,
     offload_opt_state: bool = False,
     opt_shardings=None,
+    donate_inputs: bool = False,
 ) -> Callable:
     """jitted (state, tokens, targets) → (state, metrics).
+
+    ``donate_inputs``: also donate the token/target buffers — they are
+    consumed by the first layer (and the microbatch reshape under
+    ``grad_accum``), so XLA reuses their HBM as scratch instead of
+    keeping a live copy across the step. Only for single-use batches
+    (a prefetched batch the caller never touches again); a caller that
+    feeds the same arrays every step must leave this off.
 
     ``grad_accum=K``: split the batch into K microbatches scanned
     sequentially, average their grads, apply ONE optimizer update — the
@@ -294,7 +302,9 @@ def build_train_step(
             metrics,
         )
 
-    donate_argnums = (0,) if donate else ()
+    donate_argnums = ((0,) if donate else ()) + (
+        (1, 2) if donate_inputs else ()
+    )
     return jax.jit(train_step, donate_argnums=donate_argnums)
 
 

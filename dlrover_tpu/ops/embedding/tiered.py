@@ -215,13 +215,25 @@ class TieredKvEmbedding:
         stream. One delta consumer per store is the supported shape.
         Cold rows come FIRST so that when a key transiently has copies
         in both tiers the fresher hot row wins the last-wins import.
+
+        The tier read lock is held across the cold+hot pair: it
+        excludes eviction (hot→cold) mid-export, which with any
+        ordering could move a row between the two snapshots so it lands
+        in neither. Fault-in (cold→hot) runs under the same read side
+        and stays legal because cold is exported BEFORE hot — a row
+        that moves mid-export was already captured cold (and the hot
+        copy, if also captured, wins the merge).
         """
-        state = self.hot.export_state(since_versions)
-        if since_versions:
-            cold = self._cold_rows(self._exported_seq)
-            self._exported_seq = self._evict_seq
-        else:
-            cold = self._cold_rows(0)
+        self._tier_lock.acquire_read()
+        try:
+            if since_versions:
+                cold = self._cold_rows(self._exported_seq)
+                self._exported_seq = self._evict_seq
+            else:
+                cold = self._cold_rows(0)
+            state = self.hot.export_state(since_versions)
+        finally:
+            self._tier_lock.release_read()
         if cold:
             state = {
                 "keys": np.concatenate(
@@ -534,12 +546,22 @@ class NativeTieredKvEmbedding:
     def export_state(
         self, since_versions: Optional[List[int]] = None
     ) -> Dict[str, np.ndarray]:
-        state = self.hot.export_state(since_versions)
-        if since_versions:
-            cold = self._cold_export(self._exported_seq)
-            self._exported_seq = self._evict_seq
-        else:
-            cold = self._cold_export(0)
+        # tier read lock across the cold+hot pair (same reasoning as
+        # TieredKvEmbedding.export_state): eviction is excluded, and a
+        # concurrent fault-in cannot drop a trained row from the
+        # checkpoint because cold is snapshotted FIRST — a row moving
+        # cold→hot mid-export was already captured, and the merged dict
+        # puts cold first so a fresher hot copy wins the import
+        self._tier_lock.acquire_read()
+        try:
+            if since_versions:
+                cold = self._cold_export(self._exported_seq)
+                self._exported_seq = self._evict_seq
+            else:
+                cold = self._cold_export(0)
+            state = self.hot.export_state(since_versions)
+        finally:
+            self._tier_lock.release_read()
         if cold:
             ck = np.concatenate([c[0] for c in cold])
             cr = np.concatenate([c[1] for c in cold])

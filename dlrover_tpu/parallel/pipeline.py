@@ -53,6 +53,8 @@ from __future__ import annotations
 from typing import Any, Optional, Tuple
 
 import jax
+
+from dlrover_tpu.common.jax_compat import pcast, shard_map
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -301,7 +303,7 @@ def pipeline_forward(
         stages_loc = jax.tree_util.tree_map(lambda a: a[0], stages)
         idx = lax.axis_index("pp")
         perm = [(i, (i + 1) % pp) for i in range(pp)]
-        x_loc = lax.pcast(x_mb, ("pp",), to="varying")
+        x_loc = pcast(x_mb, ("pp",), to="varying")
         state = jnp.zeros_like(x_loc[0])
         outputs = jnp.zeros_like(x_loc)
 
@@ -328,7 +330,7 @@ def pipeline_forward(
         # new leading axis concatenated over pp → global [pp, M, mb, T, D]
         return outputs[None]
 
-    outs = jax.shard_map(
+    outs = shard_map(
         pipelined,
         mesh=mesh,
         in_specs=(P("pp"), P()),
@@ -546,7 +548,7 @@ def pipeline_value_and_grad_1f1b(
         bwd_perm = [((i + 1) % pp, i) for i in range(pp)]
 
         def vary(a):
-            return lax.pcast(a, ("pp",), to="varying")
+            return pcast(a, ("pp",), to="varying")
 
         tok_loc = vary(tok_all)
         tgt_loc = vary(tgt_all)
@@ -716,7 +718,7 @@ def pipeline_value_and_grad_1f1b(
         gstage_out = jax.tree_util.tree_map(lambda g: g[None], gstage)
         return gstage_out, ghead_out, gemb_out, loss_out
 
-    gstage, ghead, gemb, loss = jax.shard_map(
+    gstage, ghead, gemb, loss = shard_map(
         pipelined,
         mesh=mesh,
         in_specs=(P("pp"), P(), P(), P(), P()),

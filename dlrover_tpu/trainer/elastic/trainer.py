@@ -66,6 +66,22 @@ class TrainerConfig:
     # phase evals improve every time, so persist at most this often
     save_best_min_interval_s: float = 60.0
     early_stopping_patience: int = 0
+    # -- overlapped host<->device pipeline -----------------------------
+    # device prefetch depth (0 disables): a producer thread pulls batch
+    # N+1 from the dataloader and places it on device while batch N
+    # computes; 2 = classic double buffering
+    prefetch: int = 2
+    # in-memory flash saves stage device->shm in fixed-size chunks
+    # interleaved between steps instead of one big drain (the commit
+    # barrier is the only blocking point)
+    chunked_staging: bool = True
+    stage_chunk_mb: int = 64
+    # critical-path budget per step for draining stage chunks
+    stage_budget_ms: float = 5.0
+    # run the state+input-donating train step whenever no checkpoint
+    # staging is reading the state buffers (HBM reuse; the safe
+    # non-donating twin runs while staging is in flight)
+    donation_aware: bool = True
 
 
 def build_optimizer(
@@ -207,7 +223,25 @@ class ElasticTrainer:
         self.cfg = self.accel.cfg
         self.mesh = self.accel.mesh
         self._step_fn = self.accel.step_fn
+        # donation-aware stepping: the donating twin runs whenever no
+        # async staging reads the state; flip back to the safe step for
+        # the staging window (a donated buffer mid-D2H is a crash)
+        self._donating_step_fn = (
+            self.accel.donating_step_fn
+            if self.tcfg.donation_aware
+            else None
+        )
+        from dlrover_tpu.accel.profiler import PipelineStats
+
+        self.pipeline_stats = PipelineStats()
+        self._prefetcher = None
+        self._stager = None
         self.state = self.accel.init_fn(jax.random.PRNGKey(0))
+        self._state_nbytes = sum(
+            x.size * x.dtype.itemsize
+            for x in jax.tree_util.tree_leaves(self.state)
+            if hasattr(x, "dtype")
+        )
         from dlrover_tpu.ops.quantized_optim import Adam8FlatState
 
         m = self.accel.strategy.mesh
@@ -258,7 +292,36 @@ class ElasticTrainer:
 
     # -- checkpoint ----------------------------------------------------
     def _ckpt_state(self):
-        return {"train": self.state, "sampler": self.sampler.state_dict()}
+        samp = self.sampler.state_dict()
+        buffered = (
+            self._prefetcher.buffered_batches()
+            if self._prefetcher is not None
+            else 0
+        )
+        if buffered:
+            # the prefetcher's source cursor ran ahead of what actually
+            # trained: rewind the SNAPSHOT (never the live sampler) so a
+            # restore replays the buffered batches instead of skipping
+            # them.
+            rewind = (
+                buffered
+                * self.dataloader.batch_size
+                * self.sampler.num_replicas
+            )
+            samp = dict(samp)
+            completed = samp["completed_num"] - rewind
+            if completed < 0 and samp["epoch"] > 0:
+                # the sampler already rolled over (its iterator
+                # exhausts depth batches before the consumer does) but
+                # the buffered epoch-tail has not trained: rewind
+                # ACROSS the rollover, or a restore would skip it
+                samp["epoch"] -= 1
+                completed += self.sampler._epoch_total()
+            # a short final batch makes the rewind an over-estimate;
+            # clamping repeats a few samples, which is the safe
+            # direction (never skip)
+            samp["completed_num"] = max(0, completed)
+        return {"train": self.state, "sampler": samp}
 
     def _maybe_restore(self):
         step, restored = self._ckptr.load_checkpoint(self._ckpt_state())
@@ -436,6 +499,110 @@ class ElasticTrainer:
             return lr
         return None
 
+    # -- pipelined transfers -------------------------------------------
+    def _epoch_batches(self, num_steps: int):
+        """One epoch's (x, y) device batches, prefetched when enabled.
+
+        The prefetcher's source is capped at the steps remaining so its
+        lookahead never pulls samples past the run's end from the
+        sampler; what it does buffer is rewound in ``_ckpt_state``."""
+        import itertools
+
+        src = iter(self.dataloader)
+        if self.tcfg.prefetch <= 0:
+            self._prefetcher = None
+            return (self._device_batch(b) for b in src)
+        from dlrover_tpu.data.prefetch import DevicePrefetcher
+
+        self._prefetcher = DevicePrefetcher(
+            itertools.islice(
+                src, max(num_steps - self.global_step, 0)
+            ),
+            placement=self._device_batch,
+            depth=self.tcfg.prefetch,
+            stats=self.pipeline_stats,
+        )
+        return self._prefetcher
+
+    def _close_prefetcher(self):
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+
+    def _run_step(self, x, y):
+        """One optimizer step, donation-aware: donate the state and the
+        batch whenever no checkpoint staging is reading the buffers."""
+        donate = (
+            self._donating_step_fn is not None
+            and self._stager is None
+            and (
+                self._ckptr is None
+                or not self._ckptr.staging_in_flight()
+            )
+            and (
+                self._best_ckptr is None
+                or not self._best_ckptr.staging_in_flight()
+            )
+        )
+        fn = self._donating_step_fn if donate else self._step_fn
+        stats = self.pipeline_stats
+        if donate:
+            stats.donated_steps += 1
+            stats.donated_bytes += self._state_nbytes + sum(
+                getattr(b, "nbytes", 0) for b in (x, y)
+            )
+        else:
+            stats.safe_steps += 1
+        self.state, metrics = fn(self.state, x, y)
+        return metrics
+
+    def _advance_stager(self):
+        """Drain one budget's worth of checkpoint chunks off the step
+        cadence; commit (cheap: metadata publish + agent notify) once
+        the backlog is empty."""
+        if self._stager is None:
+            return
+        self._stager.advance(
+            budget_s=self.tcfg.stage_budget_ms / 1e3,
+            stats=self.pipeline_stats,
+        )
+        if self._stager.done:
+            self._stager.commit(stats=self.pipeline_stats)
+            self._stager = None
+
+    def _finish_stager(self):
+        """The commit barrier: drain whatever is left and publish."""
+        if self._stager is not None:
+            self._stager.commit(stats=self.pipeline_stats)
+            self._stager = None
+
+    def _abort_stager(self):
+        if self._stager is not None:
+            self._stager.abort()
+            self._stager = None
+
+    def _maybe_save(self, step: int):
+        if self._ckptr is None:
+            return
+        if step % self.tcfg.save_storage_interval == 0:
+            # the disk save supersedes any half-staged older step:
+            # abort it (nobody saw it — metadata is still invalid) so
+            # the shard lock is free for the synchronous staging
+            self._abort_stager()
+            self.save(StorageType.DISK)
+        elif step % self.tcfg.save_memory_interval == 0:
+            if not self.tcfg.chunked_staging:
+                self.save(StorageType.MEMORY)
+            elif self._stager is None:
+                # a previous stage still draining keeps draining — skip
+                # this interval rather than stall on a forced commit
+                # (same skip-never-block contract as save_to_memory)
+                self._stager = self._ckptr.begin_chunked_save(
+                    step,
+                    self._ckpt_state(),
+                    chunk_bytes=self.tcfg.stage_chunk_mb << 20,
+                )
+
     def train(self, num_steps: int) -> Any:
         """Run up to ``num_steps`` optimizer steps (across epochs)."""
         import jax
@@ -448,6 +615,23 @@ class ElasticTrainer:
         # restarted run's first (worse) eval can't supersede it on disk
         self._run_best_eval_loss = float("inf")
         self._evals_since_best = 0
+        try:
+            return self._train_loop(num_steps, t0, start_step)
+        finally:
+            self._close_prefetcher()
+            try:
+                # a half-staged checkpoint must not die with the loop:
+                # the barrier drains and publishes it
+                self._finish_stager()
+            except Exception as e:
+                # never mask the loop's own exception with a commit
+                # failure; the stage is already aborted (lock released)
+                logger.error(f"final stage commit failed: {e!r}")
+            logger.info(f"pipeline: {self.pipeline_stats.summary()}")
+
+    def _train_loop(self, num_steps: int, t0, start_step) -> Any:
+        import jax
+
         while self.global_step < num_steps:
             self.dataloader.load_config()  # master-retuned batch size
             self._apply_lr_scale(self.dataloader.lr_scale)
@@ -455,10 +639,12 @@ class ElasticTrainer:
             # sampler (its iterator advances completed_num and bumps the
             # epoch on exhaustion) — the trainer never touches them, so a
             # num_steps stop mid-epoch checkpoints the exact position
-            for batch in self.dataloader:
-                x, y = self._device_batch(batch)
-                self.state, metrics = self._step_fn(self.state, x, y)
+            # (modulo the prefetch rewind in _ckpt_state)
+            for x, y in self._epoch_batches(num_steps):
+                metrics = self._run_step(x, y)
                 step = self.global_step
+                # interleave checkpoint chunks while the step computes
+                self._advance_stager()
                 if self._metrics_hook is not None:
                     self._metrics_hook(step, metrics)
                 if step % self.tcfg.log_interval == 0:
@@ -506,13 +692,10 @@ class ElasticTrainer:
                         )
                         jax.block_until_ready(self.state.params)
                         return self.state
-                if self._ckptr is not None:
-                    if step % self.tcfg.save_storage_interval == 0:
-                        self.save(StorageType.DISK)
-                    elif step % self.tcfg.save_memory_interval == 0:
-                        self.save(StorageType.MEMORY)
+                self._maybe_save(step)
                 if step >= num_steps:
                     break
+            self._close_prefetcher()  # fresh buffer per epoch
         jax.block_until_ready(self.state.params)
         return self.state
 
@@ -558,6 +741,8 @@ class ElasticTrainer:
         logger.info(f"learning rate rescaled x{scale} (linear scaling)")
 
     def close(self):
+        self._close_prefetcher()
+        self._abort_stager()
         if self._ckptr is not None:
             self._ckptr.engine.close()
         if self._best_ckptr is not None:

@@ -28,9 +28,16 @@ def configure_devices(spec: str = ""):
     import jax
 
     if spec.startswith("cpu"):
+        from dlrover_tpu.common.jax_compat import (
+            set_cpu_collectives,
+            set_cpu_device_count,
+        )
+
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", _cpu_spec_count(spec))
-        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        # version-portable: config option on modern jax, XLA flag on
+        # 0.4.x (this runs in freshly spawned workers, pre-backend)
+        set_cpu_device_count(_cpu_spec_count(spec))
+        set_cpu_collectives("gloo")
     elif spec.startswith("tpu"):
         # default backend; nothing to force
         pass

@@ -12,9 +12,19 @@ os.environ.setdefault("DLROVER_TPU_SOCKET_DIR", "/tmp/dlrover_tpu_test/sockets")
 
 import jax  # noqa: E402
 
+from dlrover_tpu.common.jax_compat import (  # noqa: E402
+    set_cpu_collectives,
+    set_cpu_device_count,
+)
+
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
+# version-portable (jax_num_cpu_devices on modern jax, the XLA flag on
+# 0.4.x — honored because backend creation is lazy even though
+# sitecustomize already imported jax); gloo degrades to plain when the
+# jaxlib wants a distributed client for it
+set_cpu_device_count(8)
+set_cpu_collectives("gloo")
+jax.devices()
 
 import pytest  # noqa: E402
 

@@ -560,7 +560,20 @@ class TestHybridDcnMesh:
         assert set(ids[0].ravel()) == {0, 1, 2, 3}
         assert set(ids[1].ravel()) == {4, 5, 6, 7}
 
-    def test_train_step_on_hybrid_mesh(self):
+    @pytest.fixture
+    def _sharding_invariant_rng(self):
+        """Modern jax defaults partitionable threefry, making random
+        values sharding-invariant; 0.4.x defaults it off, so the same
+        key inits DIFFERENT weights on the hybrid vs single-device mesh
+        and the loss-parity assertion below is vacuous noise. Flip it
+        locally (globally it would route RNG through partition-id
+        lowering the old XLA rejects inside manual shard_map regions)."""
+        old = jax.config.jax_threefry_partitionable
+        jax.config.update("jax_threefry_partitionable", True)
+        yield
+        jax.config.update("jax_threefry_partitionable", old)
+
+    def test_train_step_on_hybrid_mesh(self, _sharding_invariant_rng):
         """A real train step compiles and runs on the 2-slice hybrid
         mesh and matches the single-device result (layout, not math)."""
         cfg = tiny(num_experts=0)

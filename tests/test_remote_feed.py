@@ -168,3 +168,103 @@ def test_data_node_feeds_two_trainer_nodes(tmp_path):
         totals.append(int(t_))
     assert sum(counts) == n_batches, counts
     assert sum(totals) == sum(i * 4 * 8 for i in range(n_batches))
+
+
+class TestUntrustedHeaders:
+    """ADVICE r5: the peer is untrusted — header fields get the same
+    skepticism as the pickle-free format itself."""
+
+    def _tamper(self, batch, mutate):
+        import json
+        import struct
+
+        payload = bytearray(encode_batch(batch))
+        _LEN = struct.Struct("<Q")
+        (hlen,) = _LEN.unpack_from(payload, 0)
+        header = json.loads(bytes(payload[_LEN.size : _LEN.size + hlen]))
+        mutate(header)
+        new_header = json.dumps(header).encode()
+        return (
+            _LEN.pack(len(new_header))
+            + new_header
+            + bytes(payload[_LEN.size + hlen :])
+        )
+
+    def test_negative_dim_is_loud(self):
+        batch = {"x": np.arange(8, dtype=np.int32)}
+
+        def mutate(h):
+            h["arrays"][0]["s"] = [-1]
+
+        with pytest.raises(ValueError, match="invalid dims"):
+            decode_batch(self._tamper(batch, mutate))
+
+    def test_oversized_claim_is_loud(self):
+        batch = {"x": np.arange(8, dtype=np.int32)}
+
+        def mutate(h):
+            h["arrays"][0]["s"] = [1 << 20]
+
+        with pytest.raises(ValueError, match="payload holds"):
+            decode_batch(self._tamper(batch, mutate))
+
+    def test_object_dtype_is_loud(self):
+        batch = {"x": np.arange(8, dtype=np.int32)}
+
+        def mutate(h):
+            h["arrays"][0]["d"] = "|O"
+
+        with pytest.raises(ValueError, match="object dtype"):
+            decode_batch(self._tamper(batch, mutate))
+
+    def test_unencodable_batch_closes_stream_with_eof(self):
+        """A TypeError from encode_batch must end the stream with the
+        0-length EOF frame (protocol end), not an abrupt reset."""
+
+        class Evil:
+            pass
+
+        def gen():
+            yield {"x": np.ones(4, np.float32)}
+            yield {"x": Evil()}  # unencodable
+            yield {"x": np.zeros(4, np.float32)}  # never reached
+
+        server = None
+        try:
+            server = DataNodeServer(gen(), host="127.0.0.1")
+            import socket
+            import struct
+
+            _LEN = struct.Struct("<Q")
+            conn = socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            )
+            try:
+                conn.sendall(b"GET\n")
+                buf = b""
+                while len(buf) < _LEN.size:
+                    buf += conn.recv(4096)
+                (n,) = _LEN.unpack(buf[: _LEN.size])
+                while len(buf) < _LEN.size + n:
+                    buf += conn.recv(65536)
+                out = decode_batch(buf[_LEN.size : _LEN.size + n])
+                np.testing.assert_array_equal(
+                    out["x"], np.ones(4, np.float32)
+                )
+                # second GET hits the unencodable batch: a clean EOF
+                conn.sendall(b"GET\n")
+                buf = b""
+                while len(buf) < _LEN.size:
+                    chunk = conn.recv(4096)
+                    if not chunk:
+                        raise AssertionError(
+                            "abrupt close instead of EOF frame"
+                        )
+                    buf += chunk
+                (n,) = _LEN.unpack(buf[: _LEN.size])
+                assert n == 0
+            finally:
+                conn.close()
+        finally:
+            if server is not None:
+                server.close()

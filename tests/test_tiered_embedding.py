@@ -257,3 +257,49 @@ class TestNativeColdTier:
         for k in survivors:
             np.testing.assert_array_equal(back[k], trained[k])
         t2.close()
+
+
+class TestExportUnderConcurrentFaultIn:
+    def test_export_never_drops_rows_during_gathers(self, tiered):
+        """ADVICE r5: a concurrent fault-in (gather) between the hot
+        and cold export legs must not drop a trained row from the
+        checkpoint. Export now snapshots cold-then-hot under the tier
+        read lock; gathers hammer the same keys throughout."""
+        import threading
+
+        keys = np.arange(200, dtype=np.int64)
+        tiered.gather(keys)
+        tiered.sparse_adagrad(
+            keys, np.ones((200, DIM), np.float32), lr=0.1
+        )
+        assert tiered.evict_cold(ts_limit=2**62) == 200
+
+        stop = threading.Event()
+        errors = []
+
+        def hammer():
+            rng = np.random.default_rng(1)
+            try:
+                while not stop.is_set():
+                    sub = rng.choice(keys, size=32, replace=False)
+                    tiered.gather(
+                        np.asarray(sub, np.int64),
+                        insert_missing=False,
+                    )
+            except Exception as e:  # surfaced below
+                errors.append(e)
+
+        th = threading.Thread(target=hammer, daemon=True)
+        th.start()
+        try:
+            for _ in range(10):
+                state = tiered.export_state()
+                got = set(int(k) for k in state["keys"])
+                missing = set(int(k) for k in keys) - got
+                assert not missing, (
+                    f"export dropped {len(missing)} rows mid-fault-in"
+                )
+        finally:
+            stop.set()
+            th.join(timeout=10)
+        assert not errors, errors

@@ -298,11 +298,13 @@ def _child_jax(cache_dir: str):
     import jax
 
     if cache_dir:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update(
-            "jax_persistent_cache_min_compile_time_secs", 0.0
+        from dlrover_tpu.common.jax_compat import (
+            enable_persistent_compilation_cache,
         )
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+        enable_persistent_compilation_cache(
+            cache_dir, min_compile_secs=0.0, min_entry_bytes=0
+        )
     return jax
 
 
@@ -1338,17 +1340,136 @@ def run_pipeline_bench(jax, results: dict, smoke: bool = False):
         AsyncCheckpointSaver.reset()
 
 
+def run_resize_bench(jax, results: dict, smoke: bool = False):
+    """Elastic-resize fast path: cold vs warm resize downtime.
+
+    The scenario (CPU smoke runs it on fake devices, mesh 4→2→4): an
+    ``ElasticTrainer`` trains on 4 devices — its first step lands the
+    4-mesh executable in the AOT compile cache — then resizes to 2
+    (cold: that mesh was never compiled; the downtime window pays the
+    full XLA compile on top of the on-device reshard) and back to 4
+    (warm: cache hit — the window is reshard + bookkeeping only).
+    Keys:
+
+    - ``resize_downtime_cold_ms`` / ``resize_downtime_warm_ms`` — wall
+      time training is stopped per resize; the fast path's contract is
+      warm ≤ 50% of cold even at toy scale (at real scale compile is
+      minutes and the ratio collapses further);
+    - ``compile_cache_hit_pct`` — over all AOT lookups; the second
+      resize of the run MUST make this > 0 or the warm path regressed
+      (``--smoke`` exits nonzero on that);
+    - ``reshard_bytes_device`` vs ``reshard_bytes_host`` — state bytes
+      remapped on device vs fallen back to the host restore (all-device
+      here: every source survives an in-process resize).
+    """
+    import optax
+
+    from dlrover_tpu.accel.strategy import Strategy
+    from dlrover_tpu.models import tiny
+    from dlrover_tpu.parallel.mesh import MeshConfig
+    from dlrover_tpu.trainer.elastic.trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
+
+    devs = list(jax.devices())
+    if len(devs) < 4:
+        results["resize_error"] = (
+            f"resize bench needs >= 4 devices, have {len(devs)}"
+        )
+        return
+
+    class _Tokens:
+        def __init__(self, n=128, seq=32, vocab=256):
+            rng = np.random.default_rng(0)
+            self.data = rng.integers(
+                0, vocab, (n, seq + 1), dtype=np.int32
+            )
+
+        def __len__(self):
+            return len(self.data)
+
+        def __getitem__(self, i):
+            return {"x": self.data[i][:-1], "y": self.data[i][1:]}
+
+    trainer = ElasticTrainer(
+        # smoke: 1 layer — the scenario gates cache/reshard machinery,
+        # and a smaller program keeps the tier-1 gate cheap; the full
+        # bench pays for the complete test model
+        model_cfg=tiny(num_layers=1) if smoke else tiny(),
+        tx=optax.adamw(1e-2),
+        dataset=_Tokens(),
+        trainer_cfg=TrainerConfig(
+            batch_size=8,
+            seq_len=32,
+            report_metrics=False,
+            log_interval=1000,
+            prefetch=2,
+            # the warm window must not hide a lazy donating-twin
+            # compile inside the first post-resize step
+            donation_aware=False,
+            speculative_compile=False,
+        ),
+        strategy=Strategy(mesh=MeshConfig(dp=4), dtype="float32"),
+        devices=devs[:4],
+    )
+    try:
+        trainer.train(num_steps=2)
+        cold = trainer.resize(2)
+        trainer.train(num_steps=4)
+        warm = trainer.resize(4)
+        trainer.train(num_steps=6)
+        stats = trainer.pipeline_stats
+        results["resize_downtime_cold_ms"] = round(
+            cold["downtime_ms"], 2
+        )
+        results["resize_downtime_warm_ms"] = round(
+            warm["downtime_ms"], 2
+        )
+        results["compile_cache_hit_pct"] = stats.compile_cache_hit_pct
+        results["resize_second_cache_hit"] = bool(
+            warm["compile_cache_hit"]
+        )
+        results["reshard_bytes_device"] = stats.reshard_bytes_device
+        results["reshard_bytes_host"] = stats.reshard_bytes_host
+        results["reshard_bytes_device_vs_host"] = [
+            stats.reshard_bytes_device,
+            stats.reshard_bytes_host,
+        ]
+        results["resize_note"] = (
+            "mesh dp4 -> dp2 (cold compile) -> dp4 (AOT cache hit), "
+            "live state remapped on device, prefetcher closed+rewound "
+            "before each reshard"
+        )
+    finally:
+        trainer.close()
+
+
 def run_smoke() -> int:
-    """Fast CPU-only pass over the pipeline keys (CI wiring: overlap
-    regressions must fail loudly without a 30-minute accelerator run).
-    Prints the same JSON shape as the full bench, pipeline keys only."""
+    """Fast CPU-only pass over the pipeline + resize keys (CI wiring:
+    overlap and resize-fast-path regressions must fail loudly without a
+    30-minute accelerator run). Prints the same JSON shape as the full
+    bench, pipeline/resize keys only."""
     import jax
+
+    from dlrover_tpu.common.jax_compat import set_cpu_device_count
+
+    # the resize leg scales a mesh 4 -> 2 -> 4, so the smoke run needs
+    # fake devices: force an 8-device virtual CPU backend (works as
+    # long as the backend has not been created yet — this is the first
+    # device touch in a --smoke process)
+    jax.config.update("jax_platforms", "cpu")
+    set_cpu_device_count(8)
 
     results: dict = {"mode": "smoke", "platform": "cpu"}
     try:
         run_pipeline_bench(jax, results, smoke=True)
     except Exception as e:
         results["pipeline_error"] = repr(e)
+    try:
+        run_resize_bench(jax, results, smoke=True)
+    except Exception as e:
+        results["resize_error"] = repr(e)
     print(json.dumps(results))
     sys.stdout.flush()
     sys.stderr.flush()
@@ -1357,6 +1478,11 @@ def run_smoke() -> int:
         and "pipeline_stage_error" not in results
         and results.get("stage_amortized_block_ms") is not None
         and results.get("prefetch_overlap_pct") is not None
+        # the resize fast path's regression gate: the second resize of
+        # the run must find its executable in the compile cache
+        and "resize_error" not in results
+        and (results.get("compile_cache_hit_pct") or 0) > 0
+        and results.get("resize_second_cache_hit") is True
     )
     os._exit(0 if ok else 1)
 
@@ -1488,6 +1614,11 @@ def main() -> int:
         results["stage_amortized_block_ms"] = None
         results["prefetch_overlap_pct"] = None
         results["pipeline_error"] = repr(e)
+    try:
+        run_resize_bench(jax, results)
+    except Exception as e:
+        results["resize_downtime_cold_ms"] = None
+        results["resize_error"] = repr(e)
     try:
         run_mfu(jax, results)
     except Exception as e:

@@ -65,6 +65,13 @@ class PipelineStats:
     donated_steps: int = 0
     safe_steps: int = 0  # steps run without donation (staging in flight)
     donated_bytes: int = 0
+    # -- elastic-resize fast path (accel/compile_cache, ckpt/reshard) --
+    compile_cache_hits: int = 0
+    compile_cache_misses: int = 0
+    reshard_bytes_device: int = 0  # state remapped without a host trip
+    reshard_bytes_host: int = 0  # leaves that fell back to shm restore
+    resize_count: int = 0
+    resize_downtime_ms: float = 0.0  # last resize's wall downtime
 
     @property
     def prefetch_overlap_pct(self) -> Optional[float]:
@@ -72,6 +79,13 @@ class PipelineStats:
         if not n:
             return None
         return round(100.0 * self.prefetch_hits / n, 2)
+
+    @property
+    def compile_cache_hit_pct(self) -> Optional[float]:
+        n = self.compile_cache_hits + self.compile_cache_misses
+        if not n:
+            return None
+        return round(100.0 * self.compile_cache_hits / n, 2)
 
     def as_dict(self) -> Dict[str, Any]:
         d = {
@@ -88,11 +102,32 @@ class PipelineStats:
             "donated_steps": self.donated_steps,
             "safe_steps": self.safe_steps,
             "donated_bytes": self.donated_bytes,
+            "compile_cache_hits": self.compile_cache_hits,
+            "compile_cache_misses": self.compile_cache_misses,
+            "compile_cache_hit_pct": self.compile_cache_hit_pct,
+            "reshard_bytes_device": self.reshard_bytes_device,
+            "reshard_bytes_host": self.reshard_bytes_host,
+            "reshard_bytes_device_vs_host": [
+                self.reshard_bytes_device,
+                self.reshard_bytes_host,
+            ],
+            "resize_count": self.resize_count,
+            "resize_downtime_ms": round(self.resize_downtime_ms, 2),
         }
         return d
 
     def summary(self) -> str:
         ov = self.prefetch_overlap_pct
+        cc = self.compile_cache_hit_pct
+        resize = (
+            f", {self.resize_count} resizes (last "
+            f"{self.resize_downtime_ms:.0f} ms, compile cache "
+            f"{'-' if cc is None else cc}% hit, reshard "
+            f"{self.reshard_bytes_device >> 20} MiB device / "
+            f"{self.reshard_bytes_host >> 20} MiB host)"
+            if self.resize_count
+            else ""
+        )
         return (
             f"prefetch {self.prefetch_hits}h/{self.prefetch_misses}m"
             f" ({'-' if ov is None else ov}% overlap), "
@@ -100,7 +135,7 @@ class PipelineStats:
             f"chunks ({self.stage_block_s * 1e3:.1f} ms on critical "
             f"path, {self.stage_commits} commits), donated "
             f"{self.donated_bytes >> 20} MiB over {self.donated_steps} "
-            f"steps ({self.safe_steps} safe)"
+            f"steps ({self.safe_steps} safe){resize}"
         )
 
 

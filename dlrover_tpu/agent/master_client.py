@@ -302,6 +302,13 @@ class MasterClient:
         resp = self.get(comm.ParallelConfigRequest(node_id=self._node_id))
         return resp if resp else comm.ParallelConfig()
 
+    def get_candidate_worker_counts(self) -> List[int]:
+        """The auto-scaler's predicted next worker counts (most likely
+        first) — the feed for a worker's speculative train-step
+        compiles. Empty on masters predating the field."""
+        cfg = self.get_paral_config()
+        return list(getattr(cfg, "candidate_worker_counts", []) or [])
+
     def get_node_addrs(self, node_type: str = "worker") -> Dict[int, str]:
         resp = self.get(comm.NodeAddressRequest(node_type=node_type))
         return resp.addrs if resp else {}

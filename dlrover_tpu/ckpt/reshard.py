@@ -1,0 +1,278 @@
+"""On-device resharding of a live train state across a mesh change.
+
+The restart path a resize used to pay: dump every shard device→host→shm
+(``ckpt/engine.py``), rebuild the world, then move the same bytes
+host→device again — two trips over the host link for state that never
+left the surviving devices. When a resize keeps ≥1 surviving process,
+the old arrays are still resident: every target shard of the new mesh
+whose index is covered by locally-addressable source shards can be
+rebuilt with device-side slices + copies (``jax.device_put`` between
+devices), no host round-trip. Only leaves with *no* surviving source
+(a replacement worker's holes, a world split that moved rows off this
+host) fall back to the shm/storage restore.
+
+Bitwise contract: every operation here (slice, ``at[].set``, device
+transfer) is a pure copy — the resharded state is bitwise-identical to
+a shm save/restore round-trip of the same resize (tested in
+``tests/test_resize.py``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+# index of a shard in the global array: ((start, stop) per dim)
+Index = Tuple[Tuple[int, int], ...]
+
+
+@dataclass
+class ReshardReport:
+    """What the reshard moved and what it could not serve locally."""
+
+    device_bytes: int = 0  # bytes rebuilt from on-device sources
+    host_bytes: int = 0  # bytes of leaves that need the host fallback
+    reused_leaves: int = 0  # sharding unchanged: arrays passed through
+    moved_leaves: int = 0  # rebuilt on device under the new sharding
+    fallback_paths: List[str] = field(default_factory=list)
+    elapsed_s: float = 0.0
+
+
+def _keystr(kp) -> str:
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+        for k in kp
+    ) or "."
+
+
+def _slices_to_index(slices, shape) -> Index:
+    out = []
+    for s, dim in zip(slices, shape):
+        lo = 0 if s.start is None else s.start
+        hi = dim if s.stop is None else s.stop
+        out.append((int(lo), int(hi)))
+    return tuple(out)
+
+
+def _source_shards(leaf) -> Optional[List[Tuple[Index, Any]]]:
+    """Locally-addressable ``(index, device_array)`` sources of ``leaf``,
+    deduped by index (replicas carry identical bytes — one source per
+    region is enough). None when the leaf holds no device data (an
+    abstract spec hole on a replacement worker, or a host leaf)."""
+    import jax
+
+    if not isinstance(leaf, jax.Array):
+        return None
+    gshape = tuple(leaf.shape)
+    out: Dict[Index, Any] = {}
+    try:
+        for s in leaf.addressable_shards:
+            idx = _slices_to_index(s.index, gshape)
+            if idx not in out:
+                out[idx] = s.data
+    except Exception:
+        return None
+    return list(out.items())
+
+
+def _overlap(a: Index, b: Index):
+    """Intersection of two index blocks, or None."""
+    out = []
+    for (alo, ahi), (blo, bhi) in zip(a, b):
+        lo, hi = max(alo, blo), min(ahi, bhi)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return tuple(out)
+
+
+def _assemble_target_shard(
+    want: Index, dtype, sources: List[Tuple[Index, Any]], device
+):
+    """Build the ``want`` block on ``device`` from overlapping on-device
+    sources. Returns None when the sources don't cover ``want``.
+
+    Fast paths avoid the scratch-zeros allocation: an exact-index source
+    is a straight device transfer; a containing source is one on-device
+    slice then the transfer. The general (multi-source) path verifies
+    coverage with a host-side bool mask before touching the device —
+    the mask costs 1 byte/element of the *target shard* only, and only
+    on the already-rare stitching path."""
+    import jax
+    import jax.numpy as jnp
+
+    shape = tuple(hi - lo for lo, hi in want)
+    for idx, data in sources:
+        if idx == want:
+            return jax.device_put(data, device)
+    for idx, data in sources:
+        inter = _overlap(idx, want)
+        if inter == want:
+            sel = tuple(
+                slice(wlo - slo, whi - slo)
+                for (wlo, whi), (slo, _) in zip(want, idx)
+            )
+            piece = data[sel] if sel else data
+            return jax.device_put(piece, device)
+    covered = (
+        np.zeros(shape, dtype=bool) if shape else np.zeros((), bool)
+    )
+    pieces = []
+    for idx, data in sources:
+        inter = _overlap(idx, want)
+        if inter is None:
+            continue
+        src_sel = tuple(
+            slice(lo - slo, hi - slo)
+            for (lo, hi), (slo, _) in zip(inter, idx)
+        )
+        dst_sel = tuple(
+            slice(lo - wlo, hi - wlo)
+            for (lo, hi), (wlo, _) in zip(inter, want)
+        )
+        pieces.append((src_sel, dst_sel, data))
+        if dst_sel:
+            covered[dst_sel] = True
+        else:
+            covered[...] = True
+    if not bool(covered.all()):
+        return None
+    base = jax.device_put(jnp.zeros(shape, dtype), device)
+    for src_sel, dst_sel, data in pieces:
+        piece = jax.device_put(
+            data[src_sel] if src_sel else data, device
+        )
+        if dst_sel:
+            base = base.at[dst_sel].set(piece)
+        else:
+            base = piece
+    return base
+
+
+def reshard_state(
+    state: Any, target_spec: Any, stats=None
+) -> Tuple[Any, ReshardReport]:
+    """Remap a live pytree onto ``target_spec``'s shardings on device.
+
+    ``target_spec`` leaves are ``ShapeDtypeStruct``s carrying the NEW
+    mesh's shardings (``models.train.state_spec``). The returned tree
+    has a concrete ``jax.Array`` wherever local sources cover every
+    target shard, and the *spec leaf itself* (a hole) wherever they do
+    not — those paths are listed in ``report.fallback_paths`` and must
+    be filled through the shm/storage restore (``merge_fallback``).
+
+    Tree structures must match; a structure change is a model change,
+    not a resize."""
+    import jax
+
+    t0 = time.perf_counter()
+    report = ReshardReport()
+    s_leaves, s_def = jax.tree_util.tree_flatten_with_path(state)
+    t_leaves, t_def = jax.tree_util.tree_flatten_with_path(target_spec)
+    if s_def != t_def:
+        raise ValueError(
+            f"reshard requires identical tree structures; state has "
+            f"{s_def.num_leaves} leaves vs target {t_def.num_leaves}"
+        )
+    out = []
+    for (kp, old), (_, spec) in zip(s_leaves, t_leaves):
+        path = _keystr(kp)
+        sharding = getattr(spec, "sharding", None)
+        if sharding is None:
+            # host leaf (plain numpy/python): pass through
+            out.append(old)
+            continue
+        if tuple(getattr(old, "shape", ())) != tuple(spec.shape) or str(
+            getattr(old, "dtype", "")
+        ) != str(spec.dtype):
+            raise ValueError(
+                f"{path}: shape/dtype changed "
+                f"({getattr(old, 'shape', None)}/"
+                f"{getattr(old, 'dtype', None)} -> "
+                f"{spec.shape}/{spec.dtype}); that is a model change, "
+                f"not a resize"
+            )
+        try:
+            if old.sharding == sharding:
+                out.append(old)
+                report.reused_leaves += 1
+                continue
+        except Exception:
+            pass
+        sources = _source_shards(old)
+        nbytes = int(
+            np.prod(spec.shape, dtype=np.int64)
+            * np.dtype(spec.dtype).itemsize
+        ) if spec.shape else np.dtype(spec.dtype).itemsize
+        new_leaf = None
+        if sources:
+            new_leaf = _reshard_leaf(spec, sharding, sources)
+        if new_leaf is None:
+            report.fallback_paths.append(path)
+            report.host_bytes += nbytes
+            out.append(spec)
+            continue
+        report.moved_leaves += 1
+        report.device_bytes += nbytes
+        out.append(new_leaf)
+    report.elapsed_s = time.perf_counter() - t0
+    if stats is not None:
+        stats.reshard_bytes_device += report.device_bytes
+        stats.reshard_bytes_host += report.host_bytes
+    if report.fallback_paths:
+        logger.info(
+            f"reshard: {report.moved_leaves} leaves moved on device "
+            f"({report.device_bytes >> 20} MiB), "
+            f"{len(report.fallback_paths)} fall back to host restore "
+            f"({report.host_bytes >> 20} MiB)"
+        )
+    return jax.tree_util.tree_unflatten(s_def, out), report
+
+
+def _reshard_leaf(spec, sharding, sources):
+    """One leaf: build every addressable target shard from local
+    sources; None as soon as any shard cannot be covered."""
+    import jax
+
+    gshape = tuple(spec.shape)
+    try:
+        index_map = sharding.addressable_devices_indices_map(gshape)
+    except Exception:
+        return None
+    pieces = []
+    for device, slices in index_map.items():
+        want = _slices_to_index(slices, gshape)
+        block = _assemble_target_shard(
+            want, np.dtype(spec.dtype), sources, device
+        )
+        if block is None:
+            return None
+        pieces.append(block)
+    return jax.make_array_from_single_device_arrays(
+        gshape, sharding, pieces
+    )
+
+
+def merge_fallback(resharded: Any, restored: Any, fallback_paths) -> Any:
+    """Fill the holes ``reshard_state`` left (spec leaves at
+    ``fallback_paths``) with the corresponding leaves of a full restore.
+    Non-hole leaves keep the on-device resharded arrays — the restore's
+    copies for those paths are discarded."""
+    import jax
+
+    wanted = set(fallback_paths)
+    r_leaves, r_def = jax.tree_util.tree_flatten_with_path(resharded)
+    f_leaves = jax.tree_util.tree_flatten(restored)[0]
+    if len(r_leaves) != len(f_leaves):
+        raise ValueError(
+            "fallback restore tree does not match the resharded tree"
+        )
+    out = []
+    for (kp, leaf), filled in zip(r_leaves, f_leaves):
+        out.append(filled if _keystr(kp) in wanted else leaf)
+    return jax.tree_util.tree_unflatten(r_def, out)

@@ -523,6 +523,10 @@ class ParallelConfig(Message):
     mesh_shape: Dict[str, int] = field(default_factory=dict)
     remat_policy: str = ""
     restart: bool = False
+    # the auto-scaler's top-k predicted next worker counts, most likely
+    # first — workers pre-lower the train step for these meshes in the
+    # background (the speculative leg of the elastic-resize fast path)
+    candidate_worker_counts: List[int] = field(default_factory=list)
 
 
 @dataclass

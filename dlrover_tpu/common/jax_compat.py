@@ -127,3 +127,59 @@ def pallas_tpu_compiler_params(**kwargs):
         pltpu, "TPUCompilerParams"
     )
     return cls(**kwargs)
+
+
+def enable_persistent_compilation_cache(
+    cache_dir: str,
+    min_compile_secs: float = 0.5,
+    min_entry_bytes: int = 0,
+) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir`` on any
+    version that has one. Returns False on jaxlibs without the cache
+    (the caller falls back to in-process caching only). The two
+    threshold knobs arrived later than the cache itself, so each is
+    guarded independently."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except AttributeError:
+        return False
+    for opt, val in (
+        ("jax_persistent_cache_min_compile_time_secs", min_compile_secs),
+        ("jax_persistent_cache_min_entry_size_bytes", min_entry_bytes),
+    ):
+        try:
+            jax.config.update(opt, val)
+        except AttributeError:
+            pass
+    return True
+
+
+def serialize_compiled(compiled) -> "bytes | None":
+    """Pickle an AOT ``jax.stages.Compiled`` for the on-disk executable
+    cache. None when this jaxlib cannot serialize executables or the
+    program contains something unpicklable (custom pytree nodes in the
+    in/out trees) — callers degrade to memory-only caching."""
+    try:
+        import pickle
+
+        from jax.experimental import serialize_executable as se
+
+        return pickle.dumps(se.serialize(compiled))
+    except Exception:
+        return None
+
+
+def deserialize_compiled(blob: bytes):
+    """Inverse of ``serialize_compiled``; None on any failure (version
+    skew, device-assignment mismatch, truncated file) — a stale disk
+    entry must read as a miss, never an error."""
+    try:
+        import pickle
+
+        from jax.experimental import serialize_executable as se
+
+        return se.deserialize_and_load(*pickle.loads(blob))
+    except Exception:
+        return None

@@ -44,6 +44,8 @@ class JobAutoScaler(PollingDaemon):
         interval: float = 15.0,
         resource_optimizer=None,
         optimize_every_ticks: int = 20,
+        paral_config_service=None,
+        candidate_k: int = 3,
     ):
         super().__init__("job-auto-scaler", interval)
         self._job_manager = job_manager
@@ -58,6 +60,12 @@ class JobAutoScaler(PollingDaemon):
         self._optimize_every = max(1, optimize_every_ticks)
         self._ticks = 0
         self._opt_thread: Optional[threading.Thread] = None
+        # speculative-compile feed: predicted next worker counts are
+        # published through the paral-config channel so workers can
+        # pre-lower the train step for the likely next mesh
+        self._paral_config_service = paral_config_service
+        self._candidate_k = max(1, candidate_k)
+        self._last_recommendation: Optional[int] = None
 
     @property
     def has_scaler(self) -> bool:
@@ -113,6 +121,11 @@ class JobAutoScaler(PollingDaemon):
             want = plan.worker_count
             if want % self._node_unit:
                 want += self._node_unit - want % self._node_unit
+            # even a not-yet-acted-on recommendation is the strongest
+            # scale signal there is: surface it to the workers'
+            # speculative compilers before any plan executes
+            self._last_recommendation = want
+            self.publish_scale_candidates()
             if want != self._target:
                 self.scale_to(want)
         if plan.worker_memory_mb:
@@ -131,6 +144,42 @@ class JobAutoScaler(PollingDaemon):
         components off the private _scaler)."""
         if self._scaler is not None:
             self._scaler.scale(plan)
+
+    # -- speculative-compile feed ---------------------------------------
+    def predicted_scale_candidates(self) -> list:
+        """Top-k worker counts the next resize is likely to land on,
+        most likely first: the optimizer's standing recommendation (a
+        plan that WILL execute), then one node-unit in each direction
+        of the current target (failure shrink / headroom growth — the
+        unit-quantized moves ``scale_to`` can actually make). The
+        current target itself is excluded: workers already hold its
+        executable."""
+        out = []
+        for want in (
+            self._last_recommendation,
+            self._target + self._node_unit,
+            self._target - self._node_unit,
+        ):
+            if (
+                want
+                and want > 0
+                and want != self._target
+                and want not in out
+            ):
+                out.append(want)
+        return out[: self._candidate_k]
+
+    def publish_scale_candidates(self):
+        """Push the current prediction through the paral-config channel
+        (agents mirror it to the file workers poll)."""
+        if self._paral_config_service is None:
+            return
+        cands = self.predicted_scale_candidates()
+        if self._paral_config_service.set_candidate_worker_counts(cands):
+            logger.info(
+                f"published scale candidates {cands} "
+                f"(target {self._target})"
+            )
 
     # -- core -----------------------------------------------------------
     def alive_nodes(self):
@@ -244,6 +293,8 @@ class JobAutoScaler(PollingDaemon):
                     node.exit_reason = NodeExitReason.SCALED_DOWN
                     plan.remove_nodes.append(node)
             self._target = count
+        # the target moved: the likely-next-counts move with it
+        self.publish_scale_candidates()
         if not plan.empty() and self._scaler is not None:
             self._scaler.scale(plan)
         if count > len(alive):

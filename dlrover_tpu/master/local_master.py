@@ -90,6 +90,7 @@ class LocalJobMaster:
                 else None
             ),
         )
+        self.paral_config_service = ParalConfigService()
         self.auto_scaler = JobAutoScaler(
             self.job_manager,
             speed_monitor=self.speed_monitor,
@@ -97,6 +98,9 @@ class LocalJobMaster:
             target_nodes=node_num,
             node_unit=node_unit,
             resource_optimizer=self.resource_optimizer,
+            # predicted next worker counts flow to the workers'
+            # speculative compilers through the paral-config channel
+            paral_config_service=self.paral_config_service,
         )
         self.task_manager = TaskManager(self.speed_monitor)
         self.rdzv_managers = {
@@ -106,7 +110,6 @@ class LocalJobMaster:
         self.kv_store = KVStoreService()
         self.sync_service = SyncService(self.job_manager)
         self.elastic_ps_service = ElasticPsService()
-        self.paral_config_service = ParalConfigService()
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             job_manager=self.job_manager,

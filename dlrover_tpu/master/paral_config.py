@@ -31,7 +31,27 @@ class ParalConfigService:
             config.dataloader.version = (
                 self._global_config.dataloader.version + 1
             )
+            # the scale prediction rides every config: a retune must not
+            # wipe the standing candidates (they come from the scaler's
+            # own channel, set_candidate_worker_counts)
+            if not config.candidate_worker_counts:
+                config.candidate_worker_counts = list(
+                    self._global_config.candidate_worker_counts
+                )
             self._global_config = config
+
+    def set_candidate_worker_counts(self, counts) -> bool:
+        """Publish the auto-scaler's top-k predicted next worker counts
+        (most likely first). Bumps the config version only on change so
+        the agents' ParalConfigTuner rewrites its file exactly when the
+        prediction moves. Returns True when the prediction changed."""
+        counts = [int(c) for c in counts if c > 0]
+        with self._lock:
+            if counts == self._global_config.candidate_worker_counts:
+                return False
+            self._global_config.candidate_worker_counts = counts
+            self._global_config.dataloader.version += 1
+        return True
 
     def suggest_initial_config(
         self,

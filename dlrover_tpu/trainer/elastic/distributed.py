@@ -75,14 +75,18 @@ def enable_compile_cache(cache_dir: str = "") -> str:
     if env == "off":
         return ""
     cache_dir = env or cache_dir or "/tmp/dlrover_tpu/compile_cache"
-    import jax
+    from dlrover_tpu.common.jax_compat import (
+        enable_persistent_compilation_cache,
+    )
 
     os.makedirs(cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
     # cache everything that took meaningful compile time, not only the
-    # multi-minute programs (defaults skip sub-second compiles)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # multi-minute programs (defaults skip sub-second compiles); the
+    # knobs are version-guarded in jax_compat
+    if not enable_persistent_compilation_cache(
+        cache_dir, min_compile_secs=0.5, min_entry_bytes=0
+    ):
+        return ""
     return cache_dir
 
 

@@ -82,6 +82,15 @@ class TrainerConfig:
     # staging is reading the state buffers (HBM reuse; the safe
     # non-donating twin runs while staging is in flight)
     donation_aware: bool = True
+    # -- elastic-resize fast path --------------------------------------
+    # pre-lower the train step for the master's predicted next world
+    # sizes (candidate_worker_counts in the paral config) on a
+    # background thread, so the resize that lands finds its executable
+    # already in the compile cache
+    speculative_compile: bool = True
+    # wall-clock cap per candidate batch for that background thread
+    # (docs/elastic-resize.md: the speculative-compile budget knob)
+    spec_compile_budget_s: float = 120.0
 
 
 def build_optimizer(
@@ -191,6 +200,17 @@ def build_optimizer(
     )
 
 
+def _dense_eval_loss(params, x, y, cfg, mesh):
+    """PURE NLL — no MoE aux regularizers, so eval_loss/ppl are
+    comparable across parallelism modes and configs. One definition for
+    every mesh the trainer ever evaluates on (the pp path wraps the
+    pipeline's own loss instead)."""
+    from dlrover_tpu.models.transformer import forward, token_nll
+
+    logits, _ = forward(params, x, cfg, mesh)
+    return token_nll(logits, y)
+
+
 class ElasticTrainer:
     def __init__(
         self,
@@ -208,6 +228,10 @@ class ElasticTrainer:
 
         self.tcfg = trainer_cfg or TrainerConfig()
         self._metrics_hook = metrics_hook
+        # kept for the resize path: a new mesh rebuilds the accel
+        # artifacts from the SAME model config and optimizer
+        self._model_cfg = model_cfg
+        self._tx = tx
         # async flash staging reads state buffers after the step returns,
         # so the production step must NOT donate them
         self.accel: AccelerateResult = auto_accelerate(
@@ -231,9 +255,23 @@ class ElasticTrainer:
             if self.tcfg.donation_aware
             else None
         )
+        from dlrover_tpu.accel.compile_cache import CompileCache
         from dlrover_tpu.accel.profiler import PipelineStats
 
         self.pipeline_stats = PipelineStats()
+        # AOT executables keyed by (mesh, shapes, donation, strategy):
+        # the first step on any mesh lands here, so a later resize back
+        # to that mesh skips the XLA compile entirely
+        self._compile_cache = CompileCache(stats=self.pipeline_stats)
+        self._spec_compiler = None
+        self._batch_avals = None  # ((shape, dtype), ...) of (x, y)
+        self._aot_primed = False
+        # the AOT executable + the exact batch shapes it was lowered
+        # for; other shapes (short final batch, master-retuned batch
+        # size) fall through to the retracing jit wrapper
+        self._aot_exec = None
+        self._aot_shapes = None
+        self._last_candidates = None
         self._prefetcher = None
         self._stager = None
         self.state = self.accel.init_fn(jax.random.PRNGKey(0))
@@ -291,6 +329,31 @@ class ElasticTrainer:
                 self._best_eval_loss = self._load_best_sidecar()
 
     # -- checkpoint ----------------------------------------------------
+    def _rewound_sampler_state(self, samp: Dict, buffered: int) -> Dict:
+        """Sampler state rewound by ``buffered`` prefetched batches: the
+        prefetcher's source cursor ran ahead of what actually trained,
+        so a restore (or a resize that drops the buffer) must replay
+        those batches instead of skipping them."""
+        rewind = (
+            buffered
+            * self.dataloader.batch_size
+            * self.sampler.num_replicas
+        )
+        samp = dict(samp)
+        completed = samp["completed_num"] - rewind
+        if completed < 0 and samp["epoch"] > 0:
+            # the sampler already rolled over (its iterator exhausts
+            # depth batches before the consumer does) but the buffered
+            # epoch-tail has not trained: rewind ACROSS the rollover,
+            # or a restore would skip it
+            samp["epoch"] -= 1
+            completed += self.sampler._epoch_total()
+        # a short final batch makes the rewind an over-estimate;
+        # clamping repeats a few samples, which is the safe direction
+        # (never skip)
+        samp["completed_num"] = max(0, completed)
+        return samp
+
     def _ckpt_state(self):
         samp = self.sampler.state_dict()
         buffered = (
@@ -299,28 +362,8 @@ class ElasticTrainer:
             else 0
         )
         if buffered:
-            # the prefetcher's source cursor ran ahead of what actually
-            # trained: rewind the SNAPSHOT (never the live sampler) so a
-            # restore replays the buffered batches instead of skipping
-            # them.
-            rewind = (
-                buffered
-                * self.dataloader.batch_size
-                * self.sampler.num_replicas
-            )
-            samp = dict(samp)
-            completed = samp["completed_num"] - rewind
-            if completed < 0 and samp["epoch"] > 0:
-                # the sampler already rolled over (its iterator
-                # exhausts depth batches before the consumer does) but
-                # the buffered epoch-tail has not trained: rewind
-                # ACROSS the rollover, or a restore would skip it
-                samp["epoch"] -= 1
-                completed += self.sampler._epoch_total()
-            # a short final batch makes the rewind an over-estimate;
-            # clamping repeats a few samples, which is the safe
-            # direction (never skip)
-            samp["completed_num"] = max(0, completed)
+            # rewind the SNAPSHOT (never the live sampler)
+            samp = self._rewound_sampler_state(samp, buffered)
         return {"train": self.state, "sampler": samp}
 
     def _maybe_restore(self):
@@ -354,34 +397,54 @@ class ElasticTrainer:
 
     # -- eval ----------------------------------------------------------
     def _build_eval_step(self):
+        """Eval loss step, memoized per mesh through the compile cache:
+        a resize invalidates the stale wrapper, but resizing back to a
+        previously-seen mesh reuses the jitted step instead of
+        re-tracing (the old behavior re-``jax.jit``-ed after every
+        mesh change)."""
         import jax
 
-        cfg, mesh = self.cfg, self.mesh
-        if self.accel.strategy.mesh.pp > 1:
-            from dlrover_tpu.parallel.pipeline import pipeline_loss_fn
+        from dlrover_tpu.accel.compile_cache import (
+            fingerprint,
+            mesh_signature,
+        )
 
-            mb = self.accel.strategy.num_microbatches
-            # the state layout is [pp, v, lc] iff the TRAINING schedule
-            # is interleaved — eval must read the same layout. The
-            # schedule may live in pp_schedule OR (pre-apply) only in
-            # opts; resolved_virtual() honors both sources
-            virtual = self.accel.strategy.resolved_virtual()
+        cfg, mesh, strategy = self.cfg, self.mesh, self.accel.strategy
+        key = fingerprint(
+            "eval_step",
+            strategy.to_json(),
+            mesh_signature(mesh),
+            repr(cfg),
+        )
 
-            def eval_loss(params, x, y):
-                return pipeline_loss_fn(
-                    params, x, y, cfg, mesh, mb, virtual=virtual
+        def build():
+            if strategy.mesh.pp > 1:
+                from dlrover_tpu.parallel.pipeline import (
+                    pipeline_loss_fn,
                 )
 
-        else:
-            from dlrover_tpu.models.transformer import forward, token_nll
+                mb = strategy.num_microbatches
+                # the state layout is [pp, v, lc] iff the TRAINING
+                # schedule is interleaved — eval must read the same
+                # layout. The schedule may live in pp_schedule OR
+                # (pre-apply) only in opts; resolved_virtual() honors
+                # both sources
+                virtual = strategy.resolved_virtual()
 
-            def eval_loss(params, x, y):
-                # PURE NLL — no MoE aux regularizers, so eval_loss/ppl
-                # are comparable across parallelism modes and configs
-                logits, _ = forward(params, x, cfg, mesh)
-                return token_nll(logits, y)
+                def eval_loss(params, x, y):
+                    return pipeline_loss_fn(
+                        params, x, y, cfg, mesh, mb, virtual=virtual
+                    )
 
-        return jax.jit(eval_loss)
+            else:
+
+                def eval_loss(params, x, y):
+                    return _dense_eval_loss(params, x, y, cfg, mesh)
+
+            return jax.jit(eval_loss)
+
+        fn, _ = self._compile_cache.get_or_build(key, build)
+        return fn
 
     def _eval_batches(self, max_batches: int):
         """Sequential fixed-size batches over the eval set (no sampler
@@ -529,9 +592,108 @@ class ElasticTrainer:
             self._prefetcher.close()
             self._prefetcher = None
 
+    def _step_cache_key(self, strategy, mesh, state_like, batch_like):
+        """Compile-cache key of the SAFE train step for one world:
+        (strategy fingerprint, mesh shape + device assignment, abstract
+        state/batch shapes, donation signature). ``state_like`` and
+        ``batch_like`` may be concrete arrays or ShapeDtypeStructs —
+        both produce the same key (``tree_signature`` drops
+        weak_type), so a speculative pre-lower from specs collides
+        with the resize that consumes it. The job-name salt keeps two
+        jobs sharing one on-disk cache apart (a key assumes tx was
+        constructed identically, which holds within one SPMD job)."""
+        from dlrover_tpu.accel.compile_cache import (
+            fingerprint,
+            mesh_signature,
+            tree_signature,
+        )
+        from dlrover_tpu.common.constants import NodeEnv
+
+        return fingerprint(
+            "train_step",
+            strategy.to_json(),
+            mesh_signature(mesh),
+            tree_signature(state_like),
+            tree_signature(batch_like),
+            "donate=0",
+            os.getenv(NodeEnv.JOB_NAME, ""),
+        )
+
+    def _batch_specs(self, mesh):
+        """Abstract (x, y) for AOT lowering on ``mesh``, from the batch
+        avals recorded at the first real step."""
+        import jax
+
+        from dlrover_tpu.parallel.mesh import batch_sharding
+
+        sh = batch_sharding(mesh)
+        return tuple(
+            jax.ShapeDtypeStruct(shape, np.dtype(dt), sharding=sh)
+            for shape, dt in self._batch_avals
+        )
+
+    def _aot_supported(self, strategy) -> bool:
+        # the pipeline step takes host arrays (different signature) and
+        # the offload step's mixed host/device shardings defeat the
+        # spec-keyed cache — both keep their lazy jit path
+        return strategy.mesh.pp == 1 and not strategy.offload_opt
+
+    def _record_batch_avals(self, x, y):
+        """Shapes/dtypes of the live batch — speculative compiles for
+        other meshes lower against these."""
+        try:
+            self._batch_avals = tuple(
+                (tuple(b.shape), str(b.dtype)) for b in (x, y)
+            )
+        except (AttributeError, TypeError):
+            pass
+
+    def _prime_step_cache(self, x, y):
+        """First SAFE step on a mesh: route it through the AOT compile
+        cache. This replaces (not adds to) the lazy jit compile that
+        would happen at this exact moment, but the executable lands in
+        a cache that outlives the wrapper a resize throws away — the
+        entry is what makes resizing BACK to this mesh warm. Donating
+        steps never prime: their twin is a different program, and a
+        donation-only run pays no extra compile for a cache it may
+        never need (the resize itself populates it then)."""
+        self._aot_primed = True
+        strategy = self.accel.strategy
+        if not self._aot_supported(strategy):
+            return
+        step_fn, state = self._step_fn, self.state
+        key = self._step_cache_key(strategy, self.mesh, state, (x, y))
+        try:
+            fn, _ = self._compile_cache.get_or_compile(
+                key, lambda: step_fn.lower(state, x, y).compile()
+            )
+            self._install_aot(fn, (x.shape, y.shape))
+        except Exception as e:
+            # AOT is an optimization: a lowering quirk must not take
+            # down training — the lazy jit path still works
+            logger.warning(f"AOT step-cache priming failed: {e!r}")
+
+    def _install_aot(self, exec_fn, shapes):
+        self._aot_exec = exec_fn
+        self._aot_shapes = tuple(tuple(s) for s in shapes)
+
+    def _safe_step_for(self, x, y):
+        """The non-donating step for THIS batch: the AOT executable when
+        the shapes match what it was lowered for, else the jit wrapper —
+        a Compiled rejects differing avals where jit retraces, and both
+        the dataloader's short final batch and a master-retuned batch
+        size legitimately change the shape mid-run."""
+        if self._aot_exec is not None and self._aot_shapes == (
+            tuple(x.shape), tuple(y.shape)
+        ):
+            return self._aot_exec
+        return self._step_fn
+
     def _run_step(self, x, y):
         """One optimizer step, donation-aware: donate the state and the
         batch whenever no checkpoint staging is reading the buffers."""
+        if self._batch_avals is None:
+            self._record_batch_avals(x, y)
         donate = (
             self._donating_step_fn is not None
             and self._stager is None
@@ -544,7 +706,13 @@ class ElasticTrainer:
                 or not self._best_ckptr.staging_in_flight()
             )
         )
-        fn = self._donating_step_fn if donate else self._step_fn
+        if not donate and not self._aot_primed:
+            self._prime_step_cache(x, y)
+        fn = (
+            self._donating_step_fn
+            if donate
+            else self._safe_step_for(x, y)
+        )
         stats = self.pipeline_stats
         if donate:
             stats.donated_steps += 1
@@ -603,6 +771,389 @@ class ElasticTrainer:
                     chunk_bytes=self.tcfg.stage_chunk_mb << 20,
                 )
 
+    # -- elastic resize (fast path) ------------------------------------
+    def _strategy_for(self, n_devices: int) -> Strategy:
+        """Strategy for a resized world. Model-parallel axes (tp/sp/ep/
+        pp) are divisibility choices of the MODEL and keep their sizes;
+        the data axes (dp, fsdp) absorb the device delta. When the
+        current shape cannot scale to ``n_devices`` (non-divisible
+        counts — e.g. 6 of 8 hosts), falls back to full candidate
+        enumeration, and raises a clear ValueError when no valid mesh
+        exists at all (never a crash deep inside ``build_mesh``)."""
+        from dataclasses import replace as dc_replace
+
+        s = self.accel.strategy
+        m = s.mesh
+        fixed = m.tp * m.sp * m.ep * m.pp
+        if n_devices > 0 and n_devices % fixed == 0:
+            rem = n_devices // fixed
+            if m.fsdp == 1:
+                dp, fsdp = rem, 1
+            elif m.dp == 1:
+                dp, fsdp = 1, rem
+            else:
+                # mixed split: keep as much fsdp (the memory win) as
+                # divides the remainder
+                fsdp = min(m.fsdp, rem)
+                while rem % fsdp:
+                    fsdp -= 1
+                dp = rem // fsdp
+            unit = self.tcfg.batch_size // max(self.tcfg.grad_accum, 1)
+            if unit % (dp * fsdp) == 0:
+                return dc_replace(
+                    s, mesh=dc_replace(m, dp=dp, fsdp=fsdp)
+                )
+        from dlrover_tpu.accel.candidates import candidate_strategies
+
+        cands = [
+            c
+            for c in candidate_strategies(
+                self._model_cfg,
+                n_devices,
+                self.tcfg.batch_size,
+                self.tcfg.seq_len,
+                grad_accum=self.tcfg.grad_accum,
+            )
+            if c.mesh.pp == 1
+        ]
+        if not cands:
+            raise ValueError(
+                f"no valid mesh factorization for {n_devices} devices "
+                f"at batch={self.tcfg.batch_size}, "
+                f"seq={self.tcfg.seq_len}: the resize target must let "
+                f"dp*fsdp divide the batch or satisfy the model's "
+                f"axis-divisibility rules"
+            )
+        return dc_replace(
+            cands[0],
+            dtype=s.dtype,
+            remat=s.remat,
+            opts=s.opts,
+            offload_opt=s.offload_opt,
+        )
+
+    def resize(
+        self, n_devices: Optional[int] = None, devices=None,
+        strategy: Optional[Strategy] = None,
+    ) -> Dict[str, Any]:
+        """Live reconfiguration to a new device world WITHOUT a restart.
+
+        The fast path: (1) the prefetcher is closed FIRST — its
+        buffered device copies pin old-mesh arrays and its producer
+        thread could keep placing onto the dying mesh mid-reshard —
+        and the live sampler is rewound by the dropped lookahead so no
+        sample is skipped; (2) any in-flight chunked checkpoint stage
+        is committed (its barrier) so nothing reads old buffers; (3)
+        the accel artifacts are rebuilt for the new mesh (explicit
+        strategy — no search) and the safe step comes out of the AOT
+        compile cache, which a speculative pre-lower or an earlier
+        visit to this mesh makes a HIT (no XLA compile in the downtime
+        window); (4) live state is remapped shard-by-shard on device
+        (``ckpt/reshard.py``) — only leaves with no surviving local
+        source fall back to the shm/storage restore.
+
+        Single-process scope: the sampler's replica split is
+        per-process and unchanged here; multi-process resizes
+        re-rendezvous through the agent and land in ``__init__``'s
+        restore path instead. Returns a dict of timings/counters (the
+        bench's ``resize_downtime_*`` keys)."""
+        import jax
+
+        t0 = time.perf_counter()
+        if devices is None:
+            devices = (
+                list(jax.devices())[:n_devices]
+                if n_devices
+                else list(jax.devices())
+            )
+        devices = list(devices)
+        if self.accel.strategy.mesh.pp > 1:
+            raise ValueError(
+                "resize fast path requires a pp=1 current strategy "
+                "(pipeline state has its own layout); restart instead"
+            )
+        if strategy is None:
+            strategy = self._strategy_for(len(devices))
+        if strategy.mesh.num_devices != len(devices):
+            raise ValueError(
+                f"strategy mesh needs {strategy.mesh.num_devices} "
+                f"devices, resize got {len(devices)}"
+            )
+        if not self._aot_supported(strategy):
+            raise ValueError(
+                "resize fast path supports pp=1, non-offload "
+                "strategies; restart for pipeline/offload changes"
+            )
+        # stale scale predictions are worthless now — and the resize
+        # owns the compile budget
+        if self._spec_compiler is not None:
+            self._spec_compiler.submit(())
+        # (1) prefetcher down BEFORE any reshard: see docstring
+        buffered = (
+            self._prefetcher.buffered_batches()
+            if self._prefetcher is not None
+            else 0
+        )
+        self._close_prefetcher()
+        if buffered:
+            self.sampler.load_state_dict(
+                self._rewound_sampler_state(
+                    self.sampler.state_dict(), buffered
+                )
+            )
+        # (2) a half-staged checkpoint reads old-mesh buffers
+        self._finish_stager()
+        # (3) new-world artifacts; explicit strategy skips the search
+        accel = auto_accelerate(
+            self._model_cfg,
+            self._tx,
+            batch=self.tcfg.batch_size,
+            seq=self.tcfg.seq_len,
+            devices=devices,
+            strategy=strategy,
+            donate=False,
+            grad_accum=self.tcfg.grad_accum,
+        )
+        from dlrover_tpu.ckpt import reshard as reshard_mod
+        from dlrover_tpu.models.train import state_spec
+
+        spec = state_spec(accel.cfg, accel.mesh, self._tx)
+        # (4) on-device remap; host restore only for uncovered leaves
+        new_state, report = reshard_mod.reshard_state(
+            self.state, spec, stats=self.pipeline_stats
+        )
+        if report.fallback_paths:
+            if self._ckptr is None:
+                raise RuntimeError(
+                    f"resize: {len(report.fallback_paths)} leaves have "
+                    f"no surviving on-device source and no ckpt_dir is "
+                    f"configured for the host fallback (first: "
+                    f"{report.fallback_paths[:3]})"
+                )
+            step0, restored = self._ckptr.load_checkpoint(
+                {"train": spec, "sampler": self.sampler.state_dict()}
+            )
+            if restored is None or step0 < 0:
+                raise RuntimeError(
+                    "resize: host fallback restore found no usable "
+                    "checkpoint"
+                )
+            live_step = int(self.state.step)
+            if step0 == live_step:
+                # same step: fill only the holes, keep the on-device
+                # arrays for everything that survived
+                new_state = reshard_mod.merge_fallback(
+                    new_state, restored["train"], report.fallback_paths
+                )
+            else:
+                # mixing leaves from different optimizer steps would be
+                # silently inconsistent state — roll the WHOLE state
+                # back to the checkpoint (every leaf from one step)
+                logger.warning(
+                    f"resize: fallback checkpoint is step {step0} but "
+                    f"live state is step {live_step}; restoring the "
+                    f"full checkpoint instead of mixing steps "
+                    f"({live_step - step0} steps of progress replayed)"
+                )
+                new_state = restored["train"]
+                self.sampler.load_state_dict(restored["sampler"])
+        # swap the world
+        self.accel = accel
+        self.cfg = accel.cfg
+        self.mesh = accel.mesh
+        self.state = new_state
+        self._donating_step_fn = (
+            accel.donating_step_fn if self.tcfg.donation_aware else None
+        )
+        self._step_fn = accel.step_fn
+        self._eval_step_fn = None  # per-mesh memo re-resolves lazily
+        # candidates already seen were filtered against the OLD world;
+        # the next poll must re-evaluate them for this one
+        self._last_candidates = None
+        cache_hit = None
+        self._aot_exec = self._aot_shapes = None
+        if self._batch_avals is not None:
+            xy = self._batch_specs(accel.mesh)
+            key = self._step_cache_key(
+                strategy, accel.mesh, new_state, xy
+            )
+            if (
+                self._spec_compiler is not None
+                and self._spec_compiler.in_flight_key == key
+            ):
+                # this exact executable is mid-compile on the
+                # background thread: waiting converts a duplicate
+                # multi-minute compile into a cache hit
+                self._spec_compiler.wait_idle(600.0)
+            step_fn, state = accel.step_fn, new_state
+            fn, cache_hit = self._compile_cache.get_or_compile(
+                key, lambda: step_fn.lower(state, *xy).compile()
+            )
+            self._install_aot(
+                fn, tuple(shape for shape, _ in self._batch_avals)
+            )
+            self._aot_primed = True
+        else:
+            self._aot_primed = False
+        downtime_ms = (time.perf_counter() - t0) * 1e3
+        self.pipeline_stats.resize_count += 1
+        self.pipeline_stats.resize_downtime_ms = downtime_ms
+        logger.info(
+            f"resized to {strategy.describe()} on {len(devices)} "
+            f"devices in {downtime_ms:.0f} ms (compile cache "
+            f"{'hit' if cache_hit else 'miss' if cache_hit is not None else 'n/a'}, "
+            f"{report.moved_leaves} leaves resharded on device, "
+            f"{len(report.fallback_paths)} via host)"
+        )
+        return {
+            "downtime_ms": downtime_ms,
+            "compile_cache_hit": cache_hit,
+            "reshard_bytes_device": report.device_bytes,
+            "reshard_bytes_host": report.host_bytes,
+            "fallback_paths": list(report.fallback_paths),
+            "mesh": strategy.mesh.axis_sizes(),
+        }
+
+    # -- speculative compilation ---------------------------------------
+    def _staging_active(self) -> bool:
+        return (
+            self._stager is not None
+            or (
+                self._ckptr is not None
+                and self._ckptr.staging_in_flight()
+            )
+            or (
+                self._best_ckptr is not None
+                and self._best_ckptr.staging_in_flight()
+            )
+        )
+
+    def update_scale_candidates(self, device_counts) -> int:
+        """Pre-lower the train step for likely next world sizes on a
+        background thread (the speculative leg of the resize fast
+        path). Candidates that cannot form a valid mesh are skipped
+        with a log — a bad prediction must never hurt the current
+        world. Returns the number of candidates submitted."""
+        if not self.tcfg.speculative_compile:
+            return 0
+        if self._batch_avals is None or not self._aot_supported(
+            self.accel.strategy
+        ):
+            return 0
+        import jax
+
+        all_devices = list(jax.devices())
+        tasks, seen = [], set()
+        for n in device_counts:
+            n = int(n)
+            if (
+                n <= 0
+                or n in seen
+                or n == self.accel.strategy.mesh.num_devices
+                or n > len(all_devices)
+            ):
+                continue
+            seen.add(n)
+            try:
+                cand = self._strategy_for(n)
+            except ValueError as e:
+                logger.info(
+                    f"speculative compile: skipping {n}-device "
+                    f"candidate ({e})"
+                )
+                continue
+            task = self._speculative_task(cand, all_devices[:n])
+            if task is not None:
+                tasks.append(task)
+        if not tasks:
+            return 0
+        if self._spec_compiler is None:
+            from dlrover_tpu.accel.compile_cache import (
+                SpeculativeCompiler,
+            )
+
+            self._spec_compiler = SpeculativeCompiler(
+                self._compile_cache,
+                pause_fn=self._staging_active,
+                budget_s=self.tcfg.spec_compile_budget_s,
+            )
+        self._spec_compiler.submit(tasks)
+        logger.info(
+            f"speculative compile: {len(tasks)} candidate meshes "
+            f"queued ({sorted(seen)})"
+        )
+        return len(tasks)
+
+    def _speculative_task(self, cand: Strategy, devices):
+        """One pre-lower unit: key computed now (cheap eval_shape
+        traces), the expensive lower+compile deferred to the
+        background thread."""
+        from dlrover_tpu.accel.dry_runner import _build
+        from dlrover_tpu.models.train import state_spec
+        from dlrover_tpu.accel.compile_cache import CompileTask
+        from dlrover_tpu.parallel.mesh import build_mesh
+
+        model_cfg, tx = self._model_cfg, self._tx
+        try:
+            mesh = build_mesh(cand.mesh, devices=devices)
+        except ValueError as e:
+            logger.info(f"speculative compile: {e}")
+            return None
+        # specs must match what the resize will lower against, so the
+        # cfg/mesh derivation mirrors auto_accelerate's _build
+        from dlrover_tpu.accel.opt_lib import apply_optimizations
+        from dataclasses import replace as dc_replace
+
+        cfg2, cand2 = apply_optimizations(model_cfg, cand, cand.opts)
+        cfg2 = dc_replace(cfg2, dtype=cand2.dtype, remat=cand2.remat)
+        spec = state_spec(cfg2, mesh, tx)
+        xy = self._batch_specs(mesh)
+        key = self._step_cache_key(cand, mesh, spec, xy)
+
+        def build():
+            _, mesh2, step_fn, _, _, _ = _build(
+                cand, model_cfg, tx, devices, donate=False
+            )
+            return step_fn.lower(spec, *xy).compile()
+
+        return CompileTask(
+            label=f"mesh{cand.mesh.axis_sizes()}", key=key, build=build
+        )
+
+    def _poll_scale_candidates(self):
+        """Pick up the master's predicted next worker counts from the
+        paral-config file (the agent's ParalConfigTuner mirrors the
+        master's ``candidate_worker_counts`` there) and queue
+        speculative compiles for them."""
+        if not self.tcfg.speculative_compile:
+            return
+        from dlrover_tpu.trainer.elastic.dataloader import (
+            read_paral_config,
+        )
+
+        counts = read_paral_config().get("candidate_worker_counts") or []
+        counts = [
+            int(c) for c in counts if isinstance(c, (int, float)) and c > 0
+        ]
+        if not counts or counts == self._last_candidates:
+            return
+        if self._batch_avals is None:
+            # too early: the first step hasn't recorded the batch avals
+            # the pre-lower needs — leave the candidates unconsumed so
+            # the next poll picks them up
+            return
+        self._last_candidates = counts
+        import jax
+
+        from dlrover_tpu.common.constants import NodeEnv
+
+        num_procs = max(
+            1, int(os.getenv(NodeEnv.NUM_PROCESSES, "1") or "1")
+        )
+        # worker counts → device counts at this job's density
+        per_worker = max(1, len(jax.devices()) // num_procs)
+        self.update_scale_candidates([c * per_worker for c in counts])
+
     def train(self, num_steps: int) -> Any:
         """Run up to ``num_steps`` optimizer steps (across epochs)."""
         import jax
@@ -635,6 +1186,8 @@ class ElasticTrainer:
         while self.global_step < num_steps:
             self.dataloader.load_config()  # master-retuned batch size
             self._apply_lr_scale(self.dataloader.lr_scale)
+            # master-predicted next world sizes → background pre-lower
+            self._poll_scale_candidates()
             # epoch rollover and mid-epoch position both live in the
             # sampler (its iterator advances completed_num and bumps the
             # epoch on exhaustion) — the trainer never touches them, so a
@@ -743,6 +1296,9 @@ class ElasticTrainer:
     def close(self):
         self._close_prefetcher()
         self._abort_stager()
+        if self._spec_compiler is not None:
+            self._spec_compiler.close()
+            self._spec_compiler = None
         if self._ckptr is not None:
             self._ckptr.engine.close()
         if self._best_ckptr is not None:

@@ -177,11 +177,17 @@ class TestNativeColdTier:
         assert t2._evict_seq == seq + 1
         t2.close()
 
+    @pytest.mark.slow
     def test_native_faulting_gather_beats_sqlite(self, tmp_path):
         """The reason the tier manager is native: gather-with-fault
         throughput. Evict a zipfian table, then time faulting gathers.
-        Asserts >= parity (the native path is typically several x
-        faster; CI boxes are noisy, so the bar is conservative)."""
+
+        Marked slow (out of tier-1): it compares two wall-clock timings
+        on a shared CI box, and env-speed jitter (noisy neighbors, cold
+        page cache on the sqlite leg's first run) flips the 1.5x bar a
+        few percent of runs even with best-of-N — a comparative perf
+        assertion needs a quiet machine, which the slow tier gets.
+        Best-of-3 per backend keeps the signal honest when it does run."""
         import time
 
         n, batch = 20000, 512
@@ -190,13 +196,16 @@ class TestNativeColdTier:
         for kind in ("sqlite", "native"):
             t = _make_tiered(kind, tmp_path / f"perf.{kind}")
             keys = np.arange(n, dtype=np.int64)
-            t.gather(keys)
-            t.evict_cold(ts_limit=2**62)
-            t0 = time.perf_counter()
-            for i in range(0, n, batch):
-                t.gather(keys[i : i + batch], insert_missing=False)
-            times[kind] = time.perf_counter() - t0
-            assert t.cold_rows() == 0
+            best = float("inf")
+            for rep in range(3):
+                t.gather(keys)
+                t.evict_cold(ts_limit=2**62)
+                t0 = time.perf_counter()
+                for i in range(0, n, batch):
+                    t.gather(keys[i : i + batch], insert_missing=False)
+                best = min(best, time.perf_counter() - t0)
+                assert t.cold_rows() == 0
+            times[kind] = best
             t.close()
         assert times["native"] <= times["sqlite"] * 1.5, times
 

@@ -1445,6 +1445,129 @@ def run_resize_bench(jax, results: dict, smoke: bool = False):
         trainer.close()
 
 
+# compressed training must land within this of the fp32 baseline's
+# final loss on the grad-sync scenario (24 adamw steps, tiny model):
+# the documented convergence gate for int8 + error feedback. Measured
+# headroom: the CPU smoke run lands ~0.005-0.02 apart; 0.05 fails
+# loudly when error feedback breaks (EF-less int8 drifts ~0.1+ here)
+GRAD_SYNC_LOSS_GATE = 0.05
+# int8 wire bytes must be <= this fraction of the raw fp32 sync bytes
+# (1B payload + per-bucket scale vs 4B/elem => ~0.25 + padding)
+GRAD_SYNC_WIRE_GATE = 0.30
+
+
+def run_grad_sync_bench(jax, results: dict, smoke: bool = False):
+    """Overlap-scheduled gradient sync: bucketed shard_map collectives
+    + int8 compression with error feedback (parallel/grad_sync.py).
+
+    Scenario (2-device DP, tiny model, fixed data, identical init):
+    train the same run three ways —
+
+    - **fp32 baseline**: GSPMD's default monolithic sync;
+    - **comm_overlap**: explicit bucketed reduce-scatter — must match
+      the baseline numerically (same math, different schedule);
+    - **comm_overlap + int8**: quantized wire payloads with error
+      feedback — final loss must land within ``GRAD_SYNC_LOSS_GATE``
+      of the baseline and wire bytes within ``GRAD_SYNC_WIRE_GATE``
+      of raw, or ``--smoke`` exits nonzero (the compression path
+      cannot silently rot).
+
+    Keys: ``grad_sync_ms`` (standalone bucketed-sync wall time — its
+    roofline; the in-step cost is lower by whatever the scheduler
+    overlaps), ``comm_overlap_pct`` (measured-on-accelerator /
+    analytic-on-CPU hidden fraction, labeled), and
+    ``grad_bytes_wire_vs_raw`` ([wire, raw] per sync).
+    """
+    import optax
+
+    from dlrover_tpu.models import tiny
+    from dlrover_tpu.models.train import (
+        build_train_step,
+        init_sharded_state,
+        shard_batch,
+    )
+    from dlrover_tpu.accel.strategy import Strategy
+    from dlrover_tpu.parallel.grad_sync import (
+        ensure_residual,
+        estimate_overlap_pct,
+        measure_sync_ms,
+        resolve_plan,
+    )
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    devs = list(jax.devices())[:2]
+    if len(devs) < 2:
+        results["grad_sync_error"] = "needs >= 2 devices"
+        return
+    cfg = tiny(num_layers=1) if smoke else tiny()
+    cfg = replace(cfg, dtype="float32", param_dtype="float32")
+    mesh = build_mesh(MeshConfig(dp=2), devices=devs)
+    tx = optax.adamw(1e-2)
+    # ONE plan source for the residual AND the reporting, resolved the
+    # same way build_train_step resolves it (same gate, same bucket
+    # target) — a hand-built twin plan could drift in padding/shape
+    strategy = Strategy(
+        mesh=MeshConfig(dp=2), dtype="float32",
+        comm_overlap=True, grad_compress="int8", grad_bucket_mb=1,
+    )
+    plan = resolve_plan(cfg, strategy)
+    steps = 24
+    batch, seq = 8, 32
+    rng = np.random.default_rng(0)
+    data = [
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        for _ in range(4)
+    ]
+
+    def run(comm_overlap: bool, compress: str) -> float:
+        state, _ = init_sharded_state(
+            jax.random.PRNGKey(0), cfg, mesh, tx
+        )
+        step = build_train_step(
+            cfg, mesh, tx, donate=False,
+            comm_overlap=comm_overlap, grad_compress=compress,
+            grad_bucket_mb=strategy.grad_bucket_mb,
+        )
+        if compress == "int8":
+            state = ensure_residual(state, plan, mesh)
+        for i in range(steps):
+            x = data[i % len(data)]
+            b = shard_batch({"x": x, "y": x}, mesh)
+            state, metrics = step(state, b["x"], b["y"])
+        return float(metrics["loss"])
+
+    loss_fp32 = run(False, "none")
+    loss_overlap = run(True, "none")
+    loss_int8 = run(True, "int8")
+
+    results["grad_sync_ms"] = round(measure_sync_ms(plan, mesh), 3)
+    # real overlap needs an accelerator profile to measure; until a
+    # profile-reader lands this is the documented model constant on
+    # every backend (grad_sync.OVERLAP_HIDDEN_FRACTION), labeled so
+    results["comm_overlap_pct"] = estimate_overlap_pct(strategy)
+    results["comm_overlap_pct_source"] = "analytic"
+    results["grad_bytes_wire_vs_raw"] = [
+        plan.wire_bytes, plan.raw_bytes
+    ]
+    results["grad_sync_wire_ratio"] = round(
+        plan.wire_bytes / plan.raw_bytes, 4
+    )
+    results["grad_sync_buckets"] = plan.num_buckets
+    results["grad_sync_loss_fp32"] = round(loss_fp32, 5)
+    results["grad_sync_loss_overlap"] = round(loss_overlap, 5)
+    results["grad_sync_loss_int8"] = round(loss_int8, 5)
+    results["grad_sync_loss_gap"] = round(
+        abs(loss_int8 - loss_fp32), 5
+    )
+    results["grad_sync_loss_gate"] = GRAD_SYNC_LOSS_GATE
+    results["grad_sync_note"] = (
+        "2-device DP, identical init/data: fp32 GSPMD baseline vs "
+        "explicit bucketed sync vs int8+error-feedback; gates: "
+        f"int8 final loss within {GRAD_SYNC_LOSS_GATE} of fp32, wire "
+        f"bytes <= {GRAD_SYNC_WIRE_GATE:.0%} of raw"
+    )
+
+
 def run_smoke() -> int:
     """Fast CPU-only pass over the pipeline + resize keys (CI wiring:
     overlap and resize-fast-path regressions must fail loudly without a
@@ -1470,6 +1593,10 @@ def run_smoke() -> int:
         run_resize_bench(jax, results, smoke=True)
     except Exception as e:
         results["resize_error"] = repr(e)
+    try:
+        run_grad_sync_bench(jax, results, smoke=True)
+    except Exception as e:
+        results["grad_sync_error"] = repr(e)
     print(json.dumps(results))
     sys.stdout.flush()
     sys.stderr.flush()
@@ -1483,6 +1610,18 @@ def run_smoke() -> int:
         and "resize_error" not in results
         and (results.get("compile_cache_hit_pct") or 0) > 0
         and results.get("resize_second_cache_hit") is True
+        # the compressed-collective gates: int8 + error feedback must
+        # track the fp32 baseline and actually shrink wire traffic,
+        # or the compression path has silently rotted
+        and "grad_sync_error" not in results
+        and results.get("grad_sync_ms") is not None
+        and results.get("comm_overlap_pct") is not None
+        # explicit None checks: a gap of exactly 0.0 is a PASS (falsy
+        # `or`-defaulting would flip perfect parity into a failure)
+        and results.get("grad_sync_loss_gap") is not None
+        and results["grad_sync_loss_gap"] <= GRAD_SYNC_LOSS_GATE
+        and results.get("grad_sync_wire_ratio") is not None
+        and results["grad_sync_wire_ratio"] <= GRAD_SYNC_WIRE_GATE
     )
     os._exit(0 if ok else 1)
 
@@ -1619,6 +1758,11 @@ def main() -> int:
     except Exception as e:
         results["resize_downtime_cold_ms"] = None
         results["resize_error"] = repr(e)
+    try:
+        run_grad_sync_bench(jax, results)
+    except Exception as e:
+        results["grad_sync_ms"] = None
+        results["grad_sync_error"] = repr(e)
     try:
         run_mfu(jax, results)
     except Exception as e:

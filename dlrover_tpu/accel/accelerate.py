@@ -56,6 +56,7 @@ def auto_accelerate(
     search: str = "combination",
     optimizations: Sequence[str] = (),
     grad_accum: int = 1,
+    grad_bucket_mb: Optional[int] = None,
 ) -> AccelerateResult:
     """Pick (or apply) a strategy and return the compiled artifacts.
 
@@ -83,6 +84,11 @@ def auto_accelerate(
             strategy,
             opts=tuple(dict.fromkeys(tuple(strategy.opts) + opt_names)),
         )
+    # the sync bucket-size target is an integer the (name-only) opt
+    # registry cannot carry — stamp it onto the explicit strategy or
+    # every candidate (same shape as grad_accum below)
+    if strategy is not None and grad_bucket_mb is not None:
+        strategy = dc_replace(strategy, grad_bucket_mb=grad_bucket_mb)
     if grad_accum > 1 and batch % grad_accum:
         raise ValueError(
             f"batch {batch} must divide into grad_accum={grad_accum}"
@@ -117,6 +123,11 @@ def auto_accelerate(
             )
         if opt_names:
             cands = [dc_replace(s, opts=opt_names) for s in cands]
+        if grad_bucket_mb is not None:
+            cands = [
+                dc_replace(s, grad_bucket_mb=grad_bucket_mb)
+                for s in cands
+            ]
 
         def run_search(cands):
             if search == "bayes":
@@ -193,10 +204,18 @@ def auto_accelerate(
         # same program, full donation (state + inputs) — the trainer's
         # donation-aware stepping flips between the two per step based
         # on whether checkpoint staging is reading the state buffers
+        # resolved accessors, NOT the raw fields: the strategy here may
+        # carry the grad-sync knobs only as un-applied opt names (the
+        # trainer's optimizations= path) — a twin built from the raw
+        # fields would silently run the GSPMD sync (and skip the
+        # error-feedback residual update) on every donated step
         donating_step_fn = build_train_step(
             cfg2, mesh, tx, donate=True,
             grad_accum=strategy.grad_accum,
             donate_inputs=True,
+            comm_overlap=strategy.resolved_comm_overlap(),
+            grad_compress=strategy.resolved_grad_compress(),
+            grad_bucket_mb=strategy.grad_bucket_mb,
         )
     return AccelerateResult(
         strategy=strategy,

@@ -107,6 +107,29 @@ register_optimization(
     "offload_opt",
     lambda cfg, s: (cfg, dc_replace(s, offload_opt=True)),
 )
+# overlap-scheduled gradient sync (parallel/grad_sync.py): bucketed
+# per-bucket reduce-scatter under shard_map on pure-DP meshes — XLA
+# gets independent collectives it can overlap with backward compute,
+# and grad_accum syncs once per optimizer step instead of per
+# microbatch. Tunable: auto_accelerate's candidate stamping may apply
+# it across the whole candidate list; non-qualifying meshes fall back
+# to the GSPMD default schedule inside build_train_step.
+register_optimization(
+    "comm_overlap",
+    lambda cfg, s: (cfg, dc_replace(s, comm_overlap=True)),
+    tunable=True,
+)
+# int8-compressed gradient collectives with error feedback; implies
+# the explicit sync path (comm_overlap) — quantization needs the
+# bucket walk to exist
+register_optimization(
+    "grad_compress",
+    lambda cfg, s: (
+        cfg,
+        dc_replace(s, comm_overlap=True, grad_compress="int8"),
+    ),
+    tunable=True,
+)
 register_optimization(
     "1f1b", lambda cfg, s: (cfg, dc_replace(s, pp_schedule="1f1b"))
 )

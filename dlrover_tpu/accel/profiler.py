@@ -72,6 +72,19 @@ class PipelineStats:
     reshard_bytes_host: int = 0  # leaves that fell back to shm restore
     resize_count: int = 0
     resize_downtime_ms: float = 0.0  # last resize's wall downtime
+    # -- overlap-scheduled gradient sync (parallel/grad_sync.py) -------
+    # standalone wall time of one bucketed sync (its roofline: the
+    # in-step cost is this minus whatever the scheduler overlaps)
+    grad_sync_ms: float = 0.0
+    # fraction of sync wire time hidden behind backward compute; the
+    # analytic model constant on backends where overlap cannot be
+    # profiled (None until a grad-sync plan is active)
+    comm_overlap_pct: Optional[float] = None
+    # wire bytes one sync moves vs what the uncompressed monolithic
+    # sync would move (per optimizer step, per device ring traffic
+    # aside — the ratio is the compression win)
+    grad_bytes_wire: int = 0
+    grad_bytes_raw: int = 0
 
     @property
     def prefetch_overlap_pct(self) -> Optional[float]:
@@ -86,6 +99,12 @@ class PipelineStats:
         if not n:
             return None
         return round(100.0 * self.compile_cache_hits / n, 2)
+
+    @property
+    def grad_bytes_wire_vs_raw(self) -> Optional[list]:
+        if not self.grad_bytes_raw:
+            return None
+        return [self.grad_bytes_wire, self.grad_bytes_raw]
 
     def as_dict(self) -> Dict[str, Any]:
         d = {
@@ -113,6 +132,11 @@ class PipelineStats:
             ],
             "resize_count": self.resize_count,
             "resize_downtime_ms": round(self.resize_downtime_ms, 2),
+            "grad_sync_ms": round(self.grad_sync_ms, 3),
+            "comm_overlap_pct": self.comm_overlap_pct,
+            "grad_bytes_wire": self.grad_bytes_wire,
+            "grad_bytes_raw": self.grad_bytes_raw,
+            "grad_bytes_wire_vs_raw": self.grad_bytes_wire_vs_raw,
         }
         return d
 
@@ -128,6 +152,14 @@ class PipelineStats:
             if self.resize_count
             else ""
         )
+        gsync = (
+            f", grad sync {self.grad_sync_ms:.1f} ms standalone "
+            f"({'-' if self.comm_overlap_pct is None else self.comm_overlap_pct}"
+            f"% overlapped, {self.grad_bytes_wire >> 10} KiB wire vs "
+            f"{self.grad_bytes_raw >> 10} KiB raw per sync)"
+            if self.grad_bytes_raw
+            else ""
+        )
         return (
             f"prefetch {self.prefetch_hits}h/{self.prefetch_misses}m"
             f" ({'-' if ov is None else ov}% overlap), "
@@ -135,7 +167,7 @@ class PipelineStats:
             f"chunks ({self.stage_block_s * 1e3:.1f} ms on critical "
             f"path, {self.stage_commits} commits), donated "
             f"{self.donated_bytes >> 20} MiB over {self.donated_steps} "
-            f"steps ({self.safe_steps} safe){resize}"
+            f"steps ({self.safe_steps} safe){resize}{gsync}"
         )
 
 

@@ -36,6 +36,18 @@ class Strategy:
     # CPU-offload Adam analog — ops/host_offload.py); single-mesh path
     # only (pp>1 keeps its own state layout on device)
     offload_opt: bool = False
+    # explicit overlap-scheduled gradient sync (parallel/grad_sync.py):
+    # bucketed reduce-scatter under shard_map on pure-DP meshes, one
+    # sync per optimizer step under grad_accum. Engages only where the
+    # mesh qualifies (dp>1, other axes 1) — elsewhere the step builder
+    # falls back to the GSPMD default schedule with a log.
+    comm_overlap: bool = False
+    # "none" | "int8": int8-quantized collective payloads with
+    # per-bucket shared scales, int32 accumulation and error feedback
+    # (implies the explicit sync path)
+    grad_compress: str = "none"
+    # target bucket size for the sync scheduler, MiB
+    grad_bucket_mb: int = 4
     # named optimization-library entries applied to this strategy
     # (accel/opt_lib.py re-derives the config from these on every host)
     opts: Tuple[str, ...] = ()
@@ -53,6 +65,23 @@ class Strategy:
         if "1f1b" in self.opts:
             return "1f1b"
         return self.pp_schedule
+
+    def resolved_comm_overlap(self) -> bool:
+        """Whether the explicit gradient-sync scheduler is requested —
+        from the field OR the opt names (same dual-source contract as
+        ``resolved_pp_schedule``: candidates and the strategy returned
+        by ``auto_accelerate`` carry un-applied opt names)."""
+        return (
+            self.comm_overlap
+            or "comm_overlap" in self.opts
+            or "grad_compress" in self.opts
+        )
+
+    def resolved_grad_compress(self) -> str:
+        """Effective gradient-compression mode (field or opt name)."""
+        if self.grad_compress != "none":
+            return self.grad_compress
+        return "int8" if "grad_compress" in self.opts else "none"
 
     def resolved_virtual(self) -> int:
         """Chunks per device of the TRAINING state layout: ``pp_virtual``
@@ -84,6 +113,13 @@ class Strategy:
             bits.append("remat")
         if self.offload_opt and "offload_opt" not in self.opts:
             bits.append("offload_opt")
+        if self.comm_overlap and "comm_overlap" not in self.opts:
+            bits.append("comm_overlap")
+        if (
+            self.grad_compress != "none"
+            and "grad_compress" not in self.opts
+        ):
+            bits.append(f"{self.grad_compress}grad")
         bits.append(self.dtype)
         bits.extend(
             o

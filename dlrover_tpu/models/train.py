@@ -40,6 +40,14 @@ class TrainState:
     step: Any
     params: Any
     opt_state: Any
+    # error-feedback residual of the int8-compressed gradient sync
+    # (parallel/grad_sync.py): per-bucket (dp, padded) fp32, carried
+    # across steps so quantization noise cancels instead of biasing
+    # the trajectory. None (the default) contributes NO pytree leaves,
+    # so every pre-existing checkpoint/spec/reshard tree is unchanged;
+    # it is attached opt-in via ``grad_sync.ensure_residual`` and
+    # stripped before checkpoints/reshards (``strip_residual``).
+    grad_residual: Any = None
 
 
 def param_shardings(cfg: TransformerConfig, mesh, rules=None):
@@ -186,6 +194,32 @@ def init_sharded_state(
     return TrainState(step=step, params=params, opt_state=opt_state), sh
 
 
+def _grad_sync_plan(cfg, mesh, grad_compress: str, grad_bucket_mb: int):
+    """BucketPlan for the explicit sync path, or None when this mesh
+    keeps GSPMD's native schedule — the gate lives in ONE place
+    (``grad_sync.plan_for_mesh``, shared with the Strategy-level
+    ``resolve_plan`` the trainer/cost model consult). Non-pure-DP
+    meshes fall back silently with a log: the strategy search stamps
+    the opt names onto every candidate and an fsdp candidate must
+    still build."""
+    from dlrover_tpu.common.log import default_logger as logger
+    from dlrover_tpu.parallel.grad_sync import plan_for_mesh
+
+    plan = plan_for_mesh(
+        cfg, mesh,
+        grad_compress=grad_compress,
+        grad_bucket_mb=grad_bucket_mb,
+    )
+    if plan is None:
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        logger.info(
+            f"grad_sync: explicit scheduler needs a pure-DP mesh "
+            f"(dp>1, others 1), have {sizes}; keeping the GSPMD "
+            f"default schedule"
+        )
+    return plan
+
+
 def build_train_step(
     cfg: TransformerConfig,
     mesh,
@@ -196,6 +230,9 @@ def build_train_step(
     offload_opt_state: bool = False,
     opt_shardings=None,
     donate_inputs: bool = False,
+    comm_overlap: bool = False,
+    grad_compress: str = "none",
+    grad_bucket_mb: int = 4,
 ) -> Callable:
     """jitted (state, tokens, targets) → (state, metrics).
 
@@ -217,7 +254,18 @@ def build_train_step(
     memory between steps (ops/host_offload.py — the CPU-offload Adam
     analog); the step streams it in before ``tx.update`` and back out
     after, a cost ``grad_accum`` amortizes like the reference amortizes
-    PCIe."""
+    PCIe.
+
+    ``comm_overlap`` / ``grad_compress="int8"``: route gradient sync
+    through the explicit bucketed scheduler (parallel/grad_sync.py) on
+    pure-DP meshes — per-bucket reduce-scatter + all-gather under
+    ``shard_map`` (independent collectives XLA's latency-hiding
+    scheduler can overlap with backward compute), local fp32
+    accumulation under ``grad_accum`` so only the final microbatch
+    syncs (wire traffic cut K×), and optionally int8-quantized wire
+    payloads with error feedback when the state carries a residual
+    (``grad_sync.ensure_residual``). Non-pure-DP meshes fall back to
+    the GSPMD default schedule with a log."""
     opt_sh = None
     if offload_opt_state:
         # the MIXED tree from offload_shardings: host-kind tensors,
@@ -233,6 +281,12 @@ def build_train_step(
             ).opt_state
         )
 
+    plan = (
+        _grad_sync_plan(cfg, mesh, grad_compress, grad_bucket_mb)
+        if (comm_overlap or grad_compress == "int8")
+        else None
+    )
+
     def grads_and_loss(params, tokens, targets):
         def lf(p):
             return loss_fn(
@@ -241,35 +295,149 @@ def build_train_step(
 
         return jax.value_and_grad(lf, has_aux=True)(params)
 
-    def train_step(state: TrainState, tokens, targets):
+    def local_grads_and_loss(params, tokens, targets):
+        """Per-device UNsynchronized grads under a full-manual
+        ``shard_map``: each device differentiates the loss of its own
+        batch shard (mesh=None inside — no sharding constraints in a
+        manual region), and every output gains a leading dp axis of
+        per-device size 1 so 'different value on every device' has a
+        GSPMD-legal sharded representation (``P(('dp',))``)."""
+        from jax.sharding import PartitionSpec as P
+
+        from dlrover_tpu.common.jax_compat import shard_map
+
+        batch_spec = P(("dp", "fsdp"), "sp")  # others are size 1 here
+
+        def body(p, x, y):
+            def lf(pp):
+                return loss_fn(pp, x, y, cfg, None, return_aux=True)
+
+            (loss, aux), g = jax.value_and_grad(lf, has_aux=True)(p)
+            lead = lambda a: a[None]  # noqa: E731
+            return (
+                lead(loss),
+                jax.tree_util.tree_map(lead, aux),
+                jax.tree_util.tree_map(lead, g),
+            )
+
+        stacked = P(("dp",))
+        return shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), batch_spec, batch_spec),
+            out_specs=(stacked, stacked, stacked),
+            check_vma=False,
+        )(params, tokens, targets)
+
+    def _microbatches(tokens, targets):
+        B = tokens.shape[0]
+        if B % grad_accum:
+            raise ValueError(
+                f"batch {B} must divide into grad_accum={grad_accum}"
+            )
+        mb = B // grad_accum
+        return (
+            tokens.reshape(grad_accum, mb, *tokens.shape[1:]),
+            targets.reshape(grad_accum, mb, *targets.shape[1:]),
+        )
+
+    def synced_grads(state, tokens, targets):
+        """The explicit scheduler: local grads (accumulated in fp32
+        across microbatches WITHOUT collectives), then ONE bucketed
+        sync per optimizer step — with grad_accum=K the wire traffic
+        is K× below the per-microbatch GSPMD sync, and the grad norm
+        falls out of the bucket walk instead of a second tree pass."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dlrover_tpu.models.transformer import _zero_aux
+        from dlrover_tpu.parallel.grad_sync import sync_grads
+
         if grad_accum > 1:
-            B = tokens.shape[0]
-            if B % grad_accum:
-                raise ValueError(
-                    f"batch {B} must divide into grad_accum={grad_accum}"
+            xs, ys = _microbatches(tokens, targets)
+            stacked_sh = NamedSharding(mesh, P(("dp",)))
+
+            def body(carry, xy):
+                g_acc, loss_acc, aux_acc = carry
+                loss_s, aux_s, g_s = local_grads_and_loss(
+                    state.params, *xy
                 )
-            mb = B // grad_accum
-            xs = tokens.reshape(grad_accum, mb, *tokens.shape[1:])
-            ys = targets.reshape(grad_accum, mb, *targets.shape[1:])
+                g_acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), g_acc, g_s
+                )
+                aux_acc = jax.tree_util.tree_map(
+                    lambda a, b: a + jnp.mean(b), aux_acc, aux_s
+                )
+                return (g_acc, loss_acc + jnp.mean(loss_s), aux_acc), None
+
+            zeros_g = jax.tree_util.tree_map(
+                lambda p: jax.lax.with_sharding_constraint(
+                    jnp.zeros((plan.dp,) + p.shape, jnp.float32),
+                    stacked_sh,
+                ),
+                state.params,
+            )
+            (g_sum, loss_sum, aux_sum), _ = jax.lax.scan(
+                body, (zeros_g, jnp.float32(0.0), _zero_aux()), (xs, ys)
+            )
+            k = jnp.float32(grad_accum)
+            g_stacked = jax.tree_util.tree_map(
+                lambda g: g / k, g_sum
+            )
+            loss = loss_sum / k
+            aux = jax.tree_util.tree_map(lambda a: a / k, aux_sum)
+        else:
+            loss_s, aux_s, g_stacked = local_grads_and_loss(
+                state.params, tokens, targets
+            )
+            loss = jnp.mean(loss_s)
+            aux = jax.tree_util.tree_map(jnp.mean, aux_s)
+        # residual present => error feedback; absent => EF-less int8
+        # (structure-preserving: the step never conjures state leaves,
+        # so AOT executables and donation stay valid — the trainer
+        # opts into EF via grad_sync.ensure_residual)
+        residual = (
+            state.grad_residual
+            if grad_compress == "int8"
+            else None
+        )
+        grads, new_residual, gnorm = sync_grads(
+            g_stacked, mesh, plan, residual=residual
+        )
+        if residual is None:
+            new_residual = state.grad_residual
+        return loss, aux, grads, gnorm, new_residual
+
+    def gspmd_grads(state, tokens, targets):
+        """The default path: XLA's implicit sync. Microbatch grads
+        accumulate in fp32 regardless of param dtype (bf16 params
+        used to lose low-order bits microbatch by microbatch), cast
+        back to the param dtype ONCE after averaging."""
+        if grad_accum > 1:
+            xs, ys = _microbatches(tokens, targets)
 
             def body(carry, xy):
                 g_acc, loss_acc, aux_acc = carry
                 (loss, aux), g = grads_and_loss(state.params, *xy)
-                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                g_acc = jax.tree_util.tree_map(
+                    lambda a, gg: a + gg.astype(jnp.float32), g_acc, g
+                )
                 aux_acc = jax.tree_util.tree_map(jnp.add, aux_acc, aux)
                 return (g_acc, loss_acc + loss, aux_acc), None
 
             from dlrover_tpu.models.transformer import _zero_aux
 
             zeros_g = jax.tree_util.tree_map(
-                jnp.zeros_like, state.params
+                lambda p: jnp.zeros(p.shape, jnp.float32),
+                state.params,
             )
             (g_sum, loss_sum, aux_sum), _ = jax.lax.scan(
                 body, (zeros_g, jnp.float32(0.0), _zero_aux()), (xs, ys)
             )
             k = jnp.float32(grad_accum)
             grads = jax.tree_util.tree_map(
-                lambda g: (g / k.astype(g.dtype)), g_sum
+                lambda g, p: (g / k).astype(p.dtype),
+                g_sum,
+                state.params,
             )
             loss = loss_sum / k
             aux = jax.tree_util.tree_map(lambda a: a / k, aux_sum)
@@ -277,6 +445,18 @@ def build_train_step(
             (loss, aux), grads = grads_and_loss(
                 state.params, tokens, targets
             )
+        return loss, aux, grads, optax.global_norm(grads), None
+
+    def train_step(state: TrainState, tokens, targets):
+        if plan is not None:
+            loss, aux, grads, gnorm, new_residual = synced_grads(
+                state, tokens, targets
+            )
+        else:
+            loss, aux, grads, gnorm, _ = gspmd_grads(
+                state, tokens, targets
+            )
+            new_residual = state.grad_residual
         opt_state = state.opt_state
         if offload_opt_state:
             from dlrover_tpu.ops.host_offload import fetch_tree
@@ -288,7 +468,6 @@ def build_train_step(
 
             new_opt = offload_tree(new_opt, opt_sh)
         new_params = optax.apply_updates(state.params, updates)
-        gnorm = optax.global_norm(grads)
         metrics = {"loss": loss, "grad_norm": gnorm}
         if cfg.num_experts:
             metrics["moe_balance_loss"] = aux["balance"]
@@ -298,6 +477,7 @@ def build_train_step(
                 step=state.step + 1,
                 params=new_params,
                 opt_state=new_opt,
+                grad_residual=new_residual,
             ),
             metrics,
         )

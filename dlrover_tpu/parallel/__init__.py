@@ -10,6 +10,10 @@ where the algorithm requires them (ring attention ``ppermute``, MoE
 ``all_to_all``) inside ``shard_map``.
 """
 
+from dlrover_tpu.parallel.grad_sync import (  # noqa: F401
+    BucketPlan,
+    plan_buckets,
+)
 from dlrover_tpu.parallel.mesh import (  # noqa: F401
     MeshConfig,
     build_mesh,

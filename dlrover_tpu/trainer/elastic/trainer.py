@@ -91,6 +91,16 @@ class TrainerConfig:
     # wall-clock cap per candidate batch for that background thread
     # (docs/elastic-resize.md: the speculative-compile budget knob)
     spec_compile_budget_s: float = 120.0
+    # -- overlap-scheduled gradient sync (parallel/grad_sync.py) -------
+    # bucketed per-bucket reduce-scatter under shard_map on pure-DP
+    # meshes: independent collectives XLA can overlap with backward
+    # compute, and grad_accum syncs once per optimizer step
+    comm_overlap: bool = False
+    # "none" | "int8": int8 collective payloads with error feedback
+    # (implies comm_overlap's explicit sync path)
+    grad_compress: str = "none"
+    # target sync bucket size, MiB
+    grad_bucket_mb: int = 4
 
 
 def build_optimizer(
@@ -243,6 +253,15 @@ class ElasticTrainer:
             strategy=strategy,
             donate=False,
             grad_accum=self.tcfg.grad_accum,
+            optimizations=self._grad_sync_opt_names(),
+            # bucket size only when the trainer's knobs own the sync
+            # config — an explicit Strategy's own grad_bucket_mb wins
+            # otherwise
+            grad_bucket_mb=(
+                self.tcfg.grad_bucket_mb
+                if self._grad_sync_opt_names()
+                else None
+            ),
         )
         self.cfg = self.accel.cfg
         self.mesh = self.accel.mesh
@@ -275,6 +294,8 @@ class ElasticTrainer:
         self._prefetcher = None
         self._stager = None
         self.state = self.accel.init_fn(jax.random.PRNGKey(0))
+        self._grad_sync_plan = None
+        self._setup_grad_sync()
         self._state_nbytes = sum(
             x.size * x.dtype.itemsize
             for x in jax.tree_util.tree_leaves(self.state)
@@ -328,6 +349,58 @@ class ElasticTrainer:
                 self._best_ckptr = FlashCheckpointer(self._best_dir)
                 self._best_eval_loss = self._load_best_sidecar()
 
+    # -- overlap-scheduled gradient sync -------------------------------
+    def _grad_sync_opt_names(self) -> tuple:
+        """Named optimizations the trainer's grad-sync knobs translate
+        to (accel/opt_lib.py) — stamped onto the explicit strategy or
+        every search candidate by ``auto_accelerate``."""
+        names = ()
+        if self.tcfg.comm_overlap:
+            names += ("comm_overlap",)
+        if self.tcfg.grad_compress == "int8":
+            names += ("grad_compress",)
+        return names
+
+    def _setup_grad_sync(self, measure: bool = True):
+        """(Re)plan the bucketed sync for the CURRENT mesh: resolve the
+        plan, attach the error-feedback residual when compressing, and
+        surface the plan's wire accounting through PipelineStats. A
+        resize re-runs this — bucket padding and the residual's shapes
+        depend on the dp degree, so the plan is per-world —
+        with ``measure=False``: the timing probe compiles a standalone
+        sync program, which must not ride the resize downtime window."""
+        from dlrover_tpu.parallel.grad_sync import (
+            ensure_residual,
+            estimate_overlap_pct,
+            measure_sync_ms,
+            resolve_plan,
+        )
+
+        plan = resolve_plan(self.cfg, self.accel.strategy)
+        self._grad_sync_plan = plan
+        if plan is None:
+            return
+        self.state = ensure_residual(self.state, plan, self.mesh)
+        stats = self.pipeline_stats
+        stats.grad_bytes_raw = plan.raw_bytes
+        stats.grad_bytes_wire = plan.wire_bytes
+        stats.comm_overlap_pct = estimate_overlap_pct(
+            self.accel.strategy
+        )
+        if measure:
+            try:
+                # the sync's standalone roofline (one small compile;
+                # the in-step cost is this minus what the scheduler
+                # overlaps)
+                stats.grad_sync_ms = measure_sync_ms(
+                    plan, self.mesh, iters=3
+                )
+            except Exception as e:
+                logger.warning(
+                    f"grad-sync timing probe failed: {e!r}"
+                )
+        logger.info(f"grad sync: {plan.describe()}")
+
     # -- checkpoint ----------------------------------------------------
     def _rewound_sampler_state(self, samp: Dict, buffered: int) -> Dict:
         """Sampler state rewound by ``buffered`` prefetched batches: the
@@ -355,6 +428,8 @@ class ElasticTrainer:
         return samp
 
     def _ckpt_state(self):
+        from dlrover_tpu.parallel.grad_sync import strip_residual
+
         samp = self.sampler.state_dict()
         buffered = (
             self._prefetcher.buffered_batches()
@@ -364,12 +439,21 @@ class ElasticTrainer:
         if buffered:
             # rewind the SNAPSHOT (never the live sampler)
             samp = self._rewound_sampler_state(samp, buffered)
-        return {"train": self.state, "sampler": samp}
+        # the error-feedback residual never enters checkpoints: it is
+        # per-device noise state tied to the current bucket plan, and
+        # dropping it costs one EF-less step after restore, not
+        # correctness — while keeping every checkpoint readable by
+        # runs with different (or no) grad-sync settings
+        return {"train": strip_residual(self.state), "sampler": samp}
 
     def _maybe_restore(self):
+        from dlrover_tpu.parallel.grad_sync import ensure_residual
+
         step, restored = self._ckptr.load_checkpoint(self._ckpt_state())
         if restored is not None and step >= 0:
-            self.state = restored["train"]
+            self.state = ensure_residual(
+                restored["train"], self._grad_sync_plan, self.mesh
+            )
             self.sampler.load_state_dict(restored["sampler"])
             logger.info(f"resumed from flash checkpoint step {step}")
 
@@ -830,6 +914,12 @@ class ElasticTrainer:
             remat=s.remat,
             opts=s.opts,
             offload_opt=s.offload_opt,
+            # field-carried grad-sync knobs survive the fallback too
+            # (opts cover the trainer-knob path; an explicit Strategy
+            # may carry them ONLY as fields)
+            comm_overlap=s.comm_overlap,
+            grad_compress=s.grad_compress,
+            grad_bucket_mb=s.grad_bucket_mb,
         )
 
     def resize(
@@ -917,10 +1007,16 @@ class ElasticTrainer:
         from dlrover_tpu.ckpt import reshard as reshard_mod
         from dlrover_tpu.models.train import state_spec
 
+        from dlrover_tpu.parallel.grad_sync import strip_residual
+
         spec = state_spec(accel.cfg, accel.mesh, self._tx)
-        # (4) on-device remap; host restore only for uncovered leaves
+        # (4) on-device remap; host restore only for uncovered leaves.
+        # The error-feedback residual is stripped first: reshard trees
+        # must match the spec (which never carries it), its shapes are
+        # tied to the OLD world's bucket plan anyway, and
+        # _setup_grad_sync re-attaches a fresh one for the new plan
         new_state, report = reshard_mod.reshard_state(
-            self.state, spec, stats=self.pipeline_stats
+            strip_residual(self.state), spec, stats=self.pipeline_stats
         )
         if report.fallback_paths:
             if self._ckptr is None:
@@ -967,6 +1063,11 @@ class ElasticTrainer:
         )
         self._step_fn = accel.step_fn
         self._eval_step_fn = None  # per-mesh memo re-resolves lazily
+        # buckets are re-planned for the new dp degree and a fresh
+        # error-feedback residual attached (shapes changed with dp);
+        # the timing probe is skipped — downtime window
+        self._setup_grad_sync(measure=False)
+        new_state = self.state
         # candidates already seen were filtered against the OLD world;
         # the next poll must re-evaluate them for this one
         self._last_candidates = None
@@ -1107,6 +1208,17 @@ class ElasticTrainer:
         cfg2, cand2 = apply_optimizations(model_cfg, cand, cand.opts)
         cfg2 = dc_replace(cfg2, dtype=cand2.dtype, remat=cand2.remat)
         spec = state_spec(cfg2, mesh, tx)
+        from dlrover_tpu.parallel.grad_sync import (
+            residual_spec,
+            resolve_plan,
+        )
+
+        plan = resolve_plan(cfg2, cand2)
+        if plan is not None and plan.compress == "int8":
+            # a compressed run steps with the residual in its state
+            # tree — the pre-lowered executable (and its cache key)
+            # must see the same tree or the resize can never hit it
+            spec = dc_replace(spec, grad_residual=residual_spec(plan, mesh))
         xy = self._batch_specs(mesh)
         key = self._step_cache_key(cand, mesh, spec, xy)
 

@@ -1568,6 +1568,184 @@ def run_grad_sync_bench(jax, results: dict, smoke: bool = False):
     )
 
 
+# tracer overhead gate (docs/observability.md): with tracing enabled the
+# measured step time may exceed the disabled baseline by at most this —
+# the span tracer's contract is "cheap enough to leave on in production"
+TRACER_OVERHEAD_GATE_PCT = 2.0
+# absolute noise floor: back-to-back CPU step timings jitter by more
+# than a tracer costs; a delta under this per step is below what the
+# A/B can resolve and passes regardless of the ratio
+TRACER_OVERHEAD_FLOOR_MS = 0.25
+# the dumped trace's step spans must be explained by their phase
+# children to at least this fraction (the "where did the wall time go"
+# contract)
+TRACE_COVERAGE_GATE_PCT = 95.0
+
+
+def run_trace_bench(jax, results: dict, smoke: bool = False):
+    """Span-tracer overhead gate + Chrome-trace artifact.
+
+    Scenario: one ElasticTrainer (tiny model, single device), stepped
+    in short alternating segments with tracing enabled vs disabled
+    (same compiled step, same data). The A/B is drift-hardened — a
+    settling run burns off the decaying background load earlier bench
+    legs leave behind (thread teardown, GC, page cache), each pair
+    flips which arm runs first, and the overhead is the MEDIAN of the
+    per-pair deltas, so both monotone drift and one-off stalls (epoch
+    rollover, GC pause) cancel instead of landing on one arm. Then one
+    traced segment is dumped as a Chrome trace-event JSON
+    (``trace_smoke.json`` under ``--smoke``) and validated: loadable,
+    well-formed, and the ``step`` spans' phase children (data_wait /
+    compute / host_sync / ckpt / report) must cover ≥
+    ``TRACE_COVERAGE_GATE_PCT`` of step wall time.
+
+    Keys: ``trace_step_ms_on`` / ``trace_step_ms_off`` /
+    ``trace_overhead_pct`` (gated ≤ ``TRACER_OVERHEAD_GATE_PCT`` with
+    the ``TRACER_OVERHEAD_FLOOR_MS`` absolute noise floor),
+    ``trace_step_coverage_pct``, ``trace_valid``, ``trace_artifact``.
+    """
+    import optax
+
+    from dlrover_tpu.accel.strategy import Strategy
+    from dlrover_tpu.models import tiny
+    from dlrover_tpu.obs import trace as obs_trace
+    from dlrover_tpu.parallel.mesh import MeshConfig
+    from dlrover_tpu.trainer.elastic.trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
+
+    class _Tokens:
+        # big enough that the measured window never crosses an epoch
+        # rollover (prefetcher rebuild would land in one arm)
+        def __init__(self, n=2048, seq=32, vocab=256):
+            rng = np.random.default_rng(7)
+            self.data = rng.integers(
+                0, vocab, (n, seq + 1), dtype=np.int32
+            )
+
+        def __len__(self):
+            return len(self.data)
+
+        def __getitem__(self, i):
+            return {"x": self.data[i][:-1], "y": self.data[i][1:]}
+
+    tracer = obs_trace.get_tracer()
+    was_enabled = tracer.enabled
+    trainer = ElasticTrainer(
+        model_cfg=tiny(num_layers=1) if smoke else tiny(),
+        tx=optax.adamw(1e-2),
+        dataset=_Tokens(),
+        trainer_cfg=TrainerConfig(
+            batch_size=8,
+            seq_len=32,
+            report_metrics=False,
+            log_interval=4,
+            prefetch=2,
+            donation_aware=False,
+            speculative_compile=False,
+        ),
+        strategy=Strategy(mesh=MeshConfig(dp=1), dtype="float32"),
+        devices=list(jax.devices())[:1],
+    )
+    try:
+        def seg(n: int) -> float:
+            """Per-step seconds over the next n optimizer steps."""
+            target = trainer.global_step + n
+            t0 = time.perf_counter()
+            trainer.train(num_steps=target)
+            return (time.perf_counter() - t0) / n
+
+        trainer.train(num_steps=3)  # compile + warmup outside timing
+        settle, steps, pairs = (16, 4, 8) if smoke else (32, 8, 10)
+        # settle: earlier legs' teardown decays over seconds; burn it
+        # off untimed so it doesn't masquerade as tracer cost
+        trainer.train(num_steps=trainer.global_step + settle)
+        deltas, offs = [], []
+        for i in range(pairs):
+            first_on = bool(i % 2)  # flip order every pair
+            tracer.enabled = first_on
+            a = seg(steps)
+            tracer.enabled = not first_on
+            b = seg(steps)
+            t_on_i, t_off_i = (a, b) if first_on else (b, a)
+            deltas.append(t_on_i - t_off_i)
+            offs.append(t_off_i)
+        t_off = float(np.median(offs))
+        delta = float(np.median(deltas))
+        t_on = t_off + delta
+        overhead_pct = max(0.0, delta / t_off * 100.0)
+
+        # deterministic per-span cost bound: on shared/noisy hosts the
+        # wall A/B's per-segment jitter (± ms) swamps a µs-scale
+        # effect, so the gate falls back to (measured span cost) ×
+        # (spans per step) — a tracer that actually got expensive
+        # (say 50µs/span) fails this bound loudly, while scheduler
+        # noise cannot fake a failure
+        tracer.enabled = True
+        probe_n = 20_000
+        pt0 = time.perf_counter()
+        for _ in range(probe_n):
+            with obs_trace.span("overhead_probe"):
+                pass
+        span_cost_s = (time.perf_counter() - pt0) / probe_n
+        overhead_ok = (
+            overhead_pct <= TRACER_OVERHEAD_GATE_PCT
+            or delta * 1e3 <= TRACER_OVERHEAD_FLOOR_MS
+        )
+
+        # the artifact: one freshly-traced segment, dumped + validated
+        tracer.reset()  # drop the probe spans before the artifact
+        trainer.train(num_steps=trainer.global_step + 2 * steps)
+        path = os.getenv(
+            "DLROVER_TPU_TRACE_OUT",
+            "trace_smoke.json" if smoke else "trace_bench.json",
+        )
+        tracer.dump(path)
+        with open(path) as f:
+            loaded = json.load(f)
+        valid, reason = obs_trace.validate_chrome_trace(loaded)
+        coverage = obs_trace.step_coverage(loaded)
+        xs = [
+            e for e in loaded.get("traceEvents", [])
+            if e.get("ph") == "X"
+        ]
+        n_steps = sum(1 for e in xs if e["name"] == "step") or 1
+        spans_per_step = len(xs) / n_steps
+        bound_pct = span_cost_s * spans_per_step / t_off * 100.0
+        overhead_ok = (
+            overhead_ok or bound_pct <= TRACER_OVERHEAD_GATE_PCT
+        )
+
+        results["trace_step_ms_on"] = round(t_on * 1e3, 3)
+        results["trace_step_ms_off"] = round(t_off * 1e3, 3)
+        results["trace_overhead_pct"] = round(overhead_pct, 3)
+        results["trace_overhead_gate_pct"] = TRACER_OVERHEAD_GATE_PCT
+        results["trace_span_cost_us"] = round(span_cost_s * 1e6, 3)
+        results["trace_spans_per_step"] = round(spans_per_step, 2)
+        results["trace_overhead_bound_pct"] = round(bound_pct, 4)
+        results["trace_overhead_ok"] = bool(overhead_ok)
+        results["trace_valid"] = bool(valid)
+        results["trace_valid_reason"] = reason
+        results["trace_step_coverage_pct"] = (
+            round(coverage * 100.0, 2) if coverage is not None else None
+        )
+        results["trace_artifact"] = path
+        results["trace_events"] = len(loaded.get("traceEvents", []))
+        results["trace_note"] = (
+            "order-balanced on/off segment pairs after a settling run, "
+            "median of per-pair deltas; overhead gate: wall A/B <= "
+            f"{TRACER_OVERHEAD_GATE_PCT}% or <= "
+            f"{TRACER_OVERHEAD_FLOOR_MS} ms/step absolute, with a "
+            "deterministic (span cost x spans/step) bound as the "
+            "noisy-host fallback; step-span child coverage >= "
+            f"{TRACE_COVERAGE_GATE_PCT}%"
+        )
+    finally:
+        tracer.enabled = was_enabled
+        trainer.close()
+
+
 def run_smoke() -> int:
     """Fast CPU-only pass over the pipeline + resize keys (CI wiring:
     overlap and resize-fast-path regressions must fail loudly without a
@@ -1597,6 +1775,10 @@ def run_smoke() -> int:
         run_grad_sync_bench(jax, results, smoke=True)
     except Exception as e:
         results["grad_sync_error"] = repr(e)
+    try:
+        run_trace_bench(jax, results, smoke=True)
+    except Exception as e:
+        results["trace_error"] = repr(e)
     print(json.dumps(results))
     sys.stdout.flush()
     sys.stderr.flush()
@@ -1622,6 +1804,14 @@ def run_smoke() -> int:
         and results["grad_sync_loss_gap"] <= GRAD_SYNC_LOSS_GATE
         and results.get("grad_sync_wire_ratio") is not None
         and results["grad_sync_wire_ratio"] <= GRAD_SYNC_WIRE_GATE
+        # the telemetry gates: the dumped trace must be valid Chrome-
+        # trace JSON whose step spans are explained by their phase
+        # children, and tracing must stay cheap enough to leave on
+        and "trace_error" not in results
+        and results.get("trace_valid") is True
+        and results.get("trace_step_coverage_pct") is not None
+        and results["trace_step_coverage_pct"] >= TRACE_COVERAGE_GATE_PCT
+        and results.get("trace_overhead_ok") is True
     )
     os._exit(0 if ok else 1)
 
@@ -1763,6 +1953,11 @@ def main() -> int:
     except Exception as e:
         results["grad_sync_ms"] = None
         results["grad_sync_error"] = repr(e)
+    try:
+        run_trace_bench(jax, results)
+    except Exception as e:
+        results["trace_overhead_pct"] = None
+        results["trace_error"] = repr(e)
     try:
         run_mfu(jax, results)
     except Exception as e:

@@ -239,12 +239,24 @@ class MasterClient:
             )
         )
 
-    def report_train_metrics(self, step: int, metrics: dict):
+    def report_train_metrics(
+        self,
+        step: int,
+        metrics: dict,
+        open_span: str = "",
+        open_span_elapsed_s: float = 0.0,
+    ):
         """Scalar training metrics (loss/eval_loss/lr …) → the master's
-        collector (the trainer's periodic metric-logging leg)."""
+        collector (the trainer's periodic metric-logging leg), plus the
+        hang-attribution open-span snapshot for the telemetry
+        aggregator."""
         return self.report(
             comm.TrainMetricsReport(
-                node_id=self._node_id, step=step, metrics=dict(metrics)
+                node_id=self._node_id,
+                step=step,
+                metrics=dict(metrics),
+                open_span=open_span,
+                open_span_elapsed_s=open_span_elapsed_s,
             )
         )
 

@@ -38,17 +38,29 @@ def _metrics_path() -> str:
     )
 
 
+def atomic_write_json(path: str, payload) -> None:
+    """Write-tmp-then-rename publish of a JSON payload, creating parent
+    directories when the path has any (a bare filename has no directory
+    component and ``makedirs("")`` raises). One definition for every
+    metrics/config file writer — the monitors, the paral-config tuner
+    and the span heartbeat all publish through this."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
 def report_runtime_metrics(step: int, path: str = "", **extra) -> None:
     """Train-proc side: atomically publish the latest global step (plus
     optional metrics like loss/tpu stats) for the agent's
     TrainingMonitor."""
     path = path or _metrics_path()
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    payload = {"global_step": int(step), "timestamp": time.time(), **extra}
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(payload, f)
-    os.replace(tmp, path)
+    atomic_write_json(
+        path, {"global_step": int(step), "timestamp": time.time(), **extra}
+    )
 
 
 def read_runtime_metrics(path: str = "") -> dict:
@@ -98,12 +110,26 @@ class ResourceMonitor(PollingDaemon):
 
 class TrainingMonitor(PollingDaemon):
     """Forward the training procs' global step to the master
-    (parity: training.py:77)."""
+    (parity: training.py:77).
+
+    Two independent advance signals gate forwarding:
+
+    - the global step advancing → ``report_global_step`` (the hang /
+      auto-scale signal);
+    - the PAYLOAD advancing (the trainer's ``timestamp`` or the span
+      heartbeat's ``span_heartbeat_ts``) → ``report_train_metrics``.
+      Gating scalars on step alone dropped updated values at an
+      unchanged step (a fresh loss right after restore, a post-eval
+      refresh) and — worse — silenced the open-span channel exactly
+      when a wedged step stopped advancing, which is when hang
+      attribution matters.
+    """
 
     def __init__(self, client, interval: float = 10.0):
         super().__init__("training-monitor", interval)
         self._client = client
         self._last_step = -1
+        self._last_payload_ts = 0.0
 
     def _tick(self):
         metrics = read_runtime_metrics()
@@ -111,11 +137,18 @@ class TrainingMonitor(PollingDaemon):
         if step > self._last_step:
             self._last_step = step
             self._client.report_global_step(step)
+        payload_ts = max(
+            float(metrics.get("timestamp", 0.0) or 0.0),
+            float(metrics.get("span_heartbeat_ts", 0.0) or 0.0),
+        )
+        if step >= 0 and payload_ts > self._last_payload_ts:
+            self._last_payload_ts = payload_ts
             # forward TRAINING scalars (loss / eval_loss / lr …) to the
             # master's collector — not bools, and not the resource stats
             # the ResourceMonitor already reports through its own channel
             skip = (
-                "global_step", "timestamp", "tpu_duty_cycle",
+                "global_step", "timestamp", "span_heartbeat_ts",
+                "open_span_elapsed_s", "tpu_duty_cycle",
                 "tpu_hbm_used_mb", "cpu_percent", "used_memory_mb",
             )
             scalars = {
@@ -125,8 +158,16 @@ class TrainingMonitor(PollingDaemon):
                 and isinstance(v, (int, float))
                 and not isinstance(v, bool)
             }
-            if scalars:
-                self._client.report_train_metrics(step, scalars)
+            open_span = str(metrics.get("open_span", "") or "")
+            if scalars or open_span:
+                self._client.report_train_metrics(
+                    step,
+                    scalars,
+                    open_span=open_span,
+                    open_span_elapsed_s=float(
+                        metrics.get("open_span_elapsed_s", 0.0) or 0.0
+                    ),
+                )
 
 
 class ParalConfigTuner(PollingDaemon):
@@ -147,11 +188,7 @@ class ParalConfigTuner(PollingDaemon):
         if version == self._last_version:
             return
         self._last_version = version
-        os.makedirs(os.path.dirname(self._path), exist_ok=True)
-        tmp = f"{self._path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(dataclasses.asdict(config), f)
-        os.replace(tmp, self._path)
+        atomic_write_json(self._path, dataclasses.asdict(config))
         logger.info(
             f"paral config v{version} written to {self._path} "
             f"(batch_size={config.dataloader.batch_size})"

@@ -34,13 +34,67 @@ overrides per cluster.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.daemon import WatchingDaemon
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.k8s.client import K8sApi
 from dlrover_tpu.k8s.scaler import JOB_LABEL, NODE_ID_LABEL
+
+
+def straggler_sink(
+    servicer, job_name: str
+) -> Callable[[int, float, float], None]:
+    """Brain-ingestion leg of straggler detection: a reporter callable
+    for ``obs.aggregate.TelemetryAggregator(brain_reporter=...)`` that
+    persists each newly-flagged straggler as a ``node_events`` row
+    (event ``"straggler"``) in the Brain datastore — same table the pod
+    watcher's oom/failed incidents land in, so cluster-level algorithms
+    (bad-node exclusion and future straggler-aware placement) see
+    chronic slowness next to hard failures. ``servicer`` is a
+    ``BrainServicer`` (in-process) — masters talking to a remote Brain
+    wire ``BrainClient.report_node_event`` instead; both write the same
+    row."""
+
+    def report(worker_id: int, p50_s: float, fleet_median_s: float):
+        # the row's numeric fields are memory/cpu-typed; the magnitude
+        # of the slowness goes to the log, algorithms key on
+        # (job, node, event) incidence counts
+        servicer.record_node_event(
+            comm.BrainNodeEventReport(
+                job_name=job_name,
+                node_id=worker_id,
+                event="straggler",
+            )
+        )
+        logger.info(
+            f"brain ingested straggler: job {job_name} worker "
+            f"{worker_id} (p50 {p50_s * 1e3:.0f} ms vs fleet median "
+            f"{fleet_median_s * 1e3:.0f} ms)"
+        )
+
+    return report
+
+
+def straggler_client_sink(
+    brain_client,
+) -> Callable[[int, float, float], None]:
+    """The remote-Brain leg of ``straggler_sink``: same reporter
+    contract, writing the same ``node_events`` row through a
+    ``BrainClient`` RPC instead of an in-process servicer — masters
+    wired to a cluster Brain (``DLROVER_TPU_BRAIN_ADDR``) plug this
+    into the aggregator."""
+
+    def report(worker_id: int, p50_s: float, fleet_median_s: float):
+        brain_client.report_node_event(worker_id, "", "straggler")
+        logger.info(
+            f"straggler reported to brain: worker {worker_id} "
+            f"(p50 {p50_s * 1e3:.0f} ms vs fleet median "
+            f"{fleet_median_s * 1e3:.0f} ms)"
+        )
+
+    return report
 
 
 def _pod_incident(pod: dict) -> Optional[str]:

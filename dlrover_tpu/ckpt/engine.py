@@ -38,6 +38,7 @@ from dlrover_tpu.ckpt.sharding import (
     restore_state,
 )
 from dlrover_tpu.ckpt.shm_handler import ShmHandler
+from dlrover_tpu.obs.trace import span
 
 
 def _env_int(name: str, default: int) -> int:
@@ -246,21 +247,22 @@ class ChunkedStager:
         copied = 0
         chunks0 = self.chunks_written
         try:
-            while not self.done:
-                if self._inflight is None:
-                    self._inflight = self._start_next()
+            with span("ckpt_stage", step=self.step):
+                while not self.done:
                     if self._inflight is None:
+                        self._inflight = self._start_next()
+                        if self._inflight is None:
+                            break
+                    if budget_s is not None and self._may_defer(
+                        self._inflight
+                    ):
+                        break  # transfer still riding the async stream
+                    copied += self._write_one()
+                    if (
+                        budget_s is not None
+                        and time.perf_counter() - t0 >= budget_s
+                    ):
                         break
-                if budget_s is not None and self._may_defer(
-                    self._inflight
-                ):
-                    break  # transfer still riding the async stream
-                copied += self._write_one()
-                if (
-                    budget_s is not None
-                    and time.perf_counter() - t0 >= budget_s
-                ):
-                    break
         except BaseException:
             self.abort()
             raise
@@ -279,16 +281,17 @@ class ChunkedStager:
         if self._finished:
             return not self._failed
         try:
-            self.advance(budget_s=None, stats=stats)
-            self._engine._shm.commit_save(
-                self.step,
-                self._metas,
-                {
-                    "checkpoint_dir": self.checkpoint_dir,
-                    "global_shard_id": self._engine.global_shard_id,
-                    "global_shard_num": self._engine.global_shard_num,
-                },
-            )
+            with span("ckpt_commit", step=self.step):
+                self.advance(budget_s=None, stats=stats)
+                self._engine._shm.commit_save(
+                    self.step,
+                    self._metas,
+                    {
+                        "checkpoint_dir": self.checkpoint_dir,
+                        "global_shard_id": self._engine.global_shard_id,
+                        "global_shard_num": self._engine.global_shard_num,
+                    },
+                )
         except BaseException as e:
             self.abort()
             logger.error(
@@ -541,13 +544,14 @@ class CheckpointEngine:
     ):
         try:
             t0 = time.time()
-            records = host_shard_records(state)
-            extra = {
-                "checkpoint_dir": checkpoint_dir,
-                "global_shard_id": self.global_shard_id,
-                "global_shard_num": self.global_shard_num,
-            }
-            self._shm.save_records(step, records, extra)
+            with span("ckpt_stage", step=step):
+                records = host_shard_records(state)
+                extra = {
+                    "checkpoint_dir": checkpoint_dir,
+                    "global_shard_id": self.global_shard_id,
+                    "global_shard_num": self.global_shard_num,
+                }
+                self._shm.save_records(step, records, extra)
             logger.info(
                 f"step {step}: staged {len(records)} shard records to shm "
                 f"in {time.time() - t0:.3f}s"
@@ -597,23 +601,27 @@ class CheckpointEngine:
         """No agent: write this process's shard directly to storage through
         the same payload/done/commit helpers the saver uses, so files stay
         interchangeable."""
-        records = host_shard_records(state)
-        self.storage.safe_makedirs(
-            os.path.join(
-                saver_mod.step_dir(checkpoint_dir, step), saver_mod.DONE_DIR
+        with span("ckpt_persist", step=step):
+            records = host_shard_records(state)
+            self.storage.safe_makedirs(
+                os.path.join(
+                    saver_mod.step_dir(checkpoint_dir, step),
+                    saver_mod.DONE_DIR,
+                )
             )
-        )
-        payload = saver_mod.build_shard_payload(
-            step, self.global_shard_id, self.global_shard_num, records, {}
-        )
-        saver_mod.write_shard_and_done(
-            self.storage, checkpoint_dir, step, payload
-        )
-        if self.global_shard_id == 0:
-            return saver_mod.commit_checkpoint(
-                self.storage, checkpoint_dir, step, self.global_shard_num
+            payload = saver_mod.build_shard_payload(
+                step, self.global_shard_id, self.global_shard_num,
+                records, {},
             )
-        return True
+            saver_mod.write_shard_and_done(
+                self.storage, checkpoint_dir, step, payload
+            )
+            if self.global_shard_id == 0:
+                return saver_mod.commit_checkpoint(
+                    self.storage, checkpoint_dir, step,
+                    self.global_shard_num,
+                )
+            return True
 
     # ------------------------------------------------------------------
     # load

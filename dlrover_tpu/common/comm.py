@@ -391,11 +391,19 @@ class JobMetrics(Message):
 class TrainMetricsReport(Message):
     """Periodic scalar training metrics (loss / eval_loss / lr / ...)
     from a worker to the master's collector — the AtorchTrainer
-    metric-logging hook's master leg (ref atorch_trainer.py:127)."""
+    metric-logging hook's master leg (ref atorch_trainer.py:127).
+
+    ``open_span`` / ``open_span_elapsed_s`` carry the worker's current
+    open trace span (obs/trace.SpanHeartbeat via the runtime-metrics
+    file): the hang-attribution channel that lets the master say
+    "worker 3 stuck in ckpt_commit for 42s" instead of "no step
+    progress". Empty string = nothing open at last report."""
 
     node_id: int = 0
     step: int = 0
     metrics: Dict[str, float] = field(default_factory=dict)
+    open_span: str = ""
+    open_span_elapsed_s: float = 0.0
 
 
 @dataclass

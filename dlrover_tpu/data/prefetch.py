@@ -34,6 +34,7 @@ from typing import Any, Callable, Iterator, Optional
 
 from dlrover_tpu.accel.profiler import PipelineStats
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.obs.trace import span
 
 # buffer entry kinds: ("batch", host, device) | ("perr", host, exc)
 # (placement failed; host kept so reprime can retry) | ("err", exc)
@@ -104,7 +105,10 @@ class DevicePrefetcher:
     # -- producer ------------------------------------------------------
     def _entry(self, host: Any, place: Callable[[Any], Any]):
         try:
-            return ("batch", host, place(host))
+            # the h2d span rides the producer thread: a trace shows the
+            # placement overlapping the consumer's compute span
+            with span("h2d"):
+                return ("batch", host, place(host))
         except Exception as e:  # placement failure: host batch survives
             return ("perr", host, e)
 
@@ -119,13 +123,17 @@ class DevicePrefetcher:
                 self._pulling = True
             # the slow legs (source pull + device placement dispatch)
             # run OUTSIDE the lock so the consumer never blocks on them
+            pull_sp = span("prefetch_pull")
             try:
                 host = next(self._src)
             except StopIteration:
+                pull_sp.end()
                 entry = ("end",)
             except BaseException as e:  # noqa: BLE001 — must propagate
+                pull_sp.end()
                 entry = ("err", e)
             else:
+                pull_sp.end()
                 entry = self._entry(host, place)
             with self._cond:
                 self._pulling = False

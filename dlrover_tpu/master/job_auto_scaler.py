@@ -46,6 +46,7 @@ class JobAutoScaler(PollingDaemon):
         optimize_every_ticks: int = 20,
         paral_config_service=None,
         candidate_k: int = 3,
+        telemetry=None,
     ):
         super().__init__("job-auto-scaler", interval)
         self._job_manager = job_manager
@@ -66,13 +67,39 @@ class JobAutoScaler(PollingDaemon):
         self._paral_config_service = paral_config_service
         self._candidate_k = max(1, candidate_k)
         self._last_recommendation: Optional[int] = None
+        # obs/aggregate.TelemetryAggregator: the scaler runs the
+        # straggler detection pass on its cadence and keeps the verdict
+        # on `stragglers` — the signal a future straggler-aware scale
+        # policy (and today's operators, via the log) act on
+        self._telemetry = telemetry
+        self._straggler_ranks: list = []
 
     @property
     def has_scaler(self) -> bool:
         return self._scaler is not None
 
+    @property
+    def stragglers(self) -> list:
+        """Worker ids flagged by the last straggler-detection pass."""
+        return list(self._straggler_ranks)
+
+    def check_stragglers(self) -> list:
+        """One detection pass over the telemetry aggregator (newly
+        flagged workers reach the Brain inside detect_stragglers)."""
+        if self._telemetry is None:
+            return []
+        flagged = self._telemetry.detect_stragglers()
+        if flagged != self._straggler_ranks:
+            logger.warning(
+                f"straggler set changed: {self._straggler_ranks} -> "
+                f"{flagged}"
+            )
+            self._straggler_ranks = flagged
+        return flagged
+
     def _tick(self):
         self.check_and_scale()
+        self.check_stragglers()
         self._ticks += 1
         if self._optimizer and self._ticks % self._optimize_every == 0:
             # off-tick thread: the Brain optimize RPC retries with

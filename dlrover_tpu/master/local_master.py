@@ -60,7 +60,20 @@ class LocalJobMaster:
             self._brain_client = BrainClient(
                 brain_addr, os.getenv(NodeEnv.JOB_NAME, "local-job")
             )
-        self.speed_monitor = SpeedMonitor()
+        # unified telemetry (obs/): per-worker step times, straggler
+        # detection (newly-flagged workers persist to the Brain as
+        # node_events rows, event="straggler"), hang attribution
+        from dlrover_tpu.brain.ingestion import straggler_client_sink
+        from dlrover_tpu.obs.aggregate import TelemetryAggregator
+
+        self.telemetry = TelemetryAggregator(
+            brain_reporter=(
+                straggler_client_sink(self._brain_client)
+                if self._brain_client
+                else None
+            ),
+        )
+        self.speed_monitor = SpeedMonitor(telemetry=self.telemetry)
         self.job_manager = LocalJobManager(
             speed_monitor=self.speed_monitor,
             scaler=scaler,
@@ -101,6 +114,8 @@ class LocalJobMaster:
             # predicted next worker counts flow to the workers'
             # speculative compilers through the paral-config channel
             paral_config_service=self.paral_config_service,
+            # straggler flags surface to the scaler's periodic pass
+            telemetry=self.telemetry,
         )
         self.task_manager = TaskManager(self.speed_monitor)
         self.rdzv_managers = {
@@ -120,6 +135,7 @@ class LocalJobMaster:
             elastic_ps_service=self.elastic_ps_service,
             paral_config_service=self.paral_config_service,
             metric_collector=self.metric_collector,
+            telemetry=self.telemetry,
         )
         self._server = None
         self._brain_end_thread: Optional[threading.Thread] = None
@@ -203,8 +219,12 @@ class LocalJobMaster:
                     self._report_job_end("failed")
                     return JobExitReason.HANG_ERROR
                 hang_recoveries += 1
+                # hang ATTRIBUTION: each worker's last open span (the
+                # SpanHeartbeat channel) turns "no step progress" into
+                # "worker 3 stuck in ckpt_commit for 42s"
                 logger.error(
-                    f"job hanged; restarting workers (recovery "
+                    f"job hanged ({self.telemetry.describe_hang()}); "
+                    f"restarting workers (recovery "
                     f"{hang_recoveries}/{max_hang_recoveries})"
                 )
                 self.job_manager.restart_all_workers()

@@ -17,7 +17,7 @@ _ctx = Context.singleton_instance()
 
 
 class SpeedMonitor:
-    def __init__(self, window: int = 0):
+    def __init__(self, window: int = 0, telemetry=None):
         self._window = window or _ctx.train_speed_record_num
         self._samples: Deque[Tuple[float, int]] = deque(maxlen=self._window)
         self._global_step = 0
@@ -27,19 +27,37 @@ class SpeedMonitor:
         self._init_time = time.time()
         self._last_reset_time = 0.0
         self.first_step_time: Optional[float] = None
+        # per-worker step-time aggregation / straggler detection
+        # (obs/aggregate.TelemetryAggregator) — every step report is
+        # forwarded with its worker identity so the master can localize
+        # slowness, not just see the fleet max
+        self.telemetry = telemetry
 
     # -- reporting -----------------------------------------------------
     def set_start_timestamp(self):
         if self._start_training_time is None:
             self._start_training_time = time.time()
 
-    def collect_global_step(self, step: int, timestamp: Optional[float] = None):
-        timestamp = timestamp or time.time()
+    def collect_global_step(
+        self,
+        step: int,
+        timestamp: Optional[float] = None,
+        node_id: int = -1,
+    ):
+        # `is None`, NOT truthiness: an explicit timestamp of 0.0 is a
+        # caller-provided value (epoch zero) and must be honored — the
+        # old `timestamp or time.time()` silently replaced it with now.
+        # (Falsy-vs-None audit of this path: the wire default 0.0 in
+        # GlobalStepReport is mapped to None at the servicer boundary,
+        # where 0.0 IS the documented "unset" sentinel.)
+        timestamp = time.time() if timestamp is None else timestamp
         if self.first_step_time is None:
             self.first_step_time = timestamp
         if step >= self._global_step:
             self._global_step = step
             self._samples.append((timestamp, step))
+        if self.telemetry is not None and node_id >= 0:
+            self.telemetry.observe_step_report(node_id, step, timestamp)
 
     def set_completed_step_baseline(self, step: int):
         """Failover restore: a relaunched master must not read the next
@@ -52,6 +70,10 @@ class SpeedMonitor:
 
     def remove_running_worker(self, node_id: int):
         self._running_workers.discard(node_id)
+        if self.telemetry is not None:
+            # a departed worker's history must not haunt the fleet
+            # median the straggler detector compares against
+            self.telemetry.remove_worker(node_id)
 
     @property
     def running_workers(self) -> Set[int]:

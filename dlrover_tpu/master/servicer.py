@@ -11,7 +11,6 @@ codegen step.
 from __future__ import annotations
 
 import threading
-import time
 from concurrent import futures
 from typing import Optional
 
@@ -46,6 +45,7 @@ class MasterServicer:
         elastic_ps_service=None,
         paral_config_service=None,
         metric_collector=None,
+        telemetry=None,
     ):
         self._task_manager = task_manager
         self._job_manager = job_manager
@@ -56,6 +56,9 @@ class MasterServicer:
         self._elastic_ps_service = elastic_ps_service
         self._paral_config_service = paral_config_service
         self._metric_collector = metric_collector
+        # obs/aggregate.TelemetryAggregator: per-worker step times,
+        # straggler detection, hang attribution
+        self._telemetry = telemetry
         self._lock = threading.Lock()
         self._node_addrs: dict = {}  # node_type -> {rank: addr}
         self._ckpt_steps: dict = {}  # node_id -> latest in-memory ckpt step
@@ -294,14 +297,30 @@ class MasterServicer:
             return True
         if isinstance(message, comm.GlobalStepReport):
             if self._speed_monitor:
+                # the wire default 0.0 means "sender did not stamp";
+                # it maps to None HERE (the one boundary where 0.0 is
+                # the documented unset sentinel) so SpeedMonitor's
+                # `is None` contract stays honest for direct callers
                 self._speed_monitor.collect_global_step(
-                    message.step, message.timestamp or time.time()
+                    message.step,
+                    message.timestamp if message.timestamp else None,
+                    node_id=message.node_id,
                 )
             return True
         if isinstance(message, comm.TrainMetricsReport):
             if self._metric_collector is not None:
                 self._metric_collector.report_train_metrics(
                     message.node_id, message.step, message.metrics
+                )
+            if self._telemetry is not None:
+                self._telemetry.observe_metrics(
+                    message.node_id,
+                    message.step,
+                    message.metrics,
+                    open_span=getattr(message, "open_span", ""),
+                    open_span_elapsed_s=getattr(
+                        message, "open_span_elapsed_s", 0.0
+                    ),
                 )
             return True
         if isinstance(message, comm.TrainingStatusReport):

@@ -1,0 +1,17 @@
+"""Unified telemetry for dlrover-tpu: span tracing, metrics, attribution.
+
+Three layers, one spine (docs/observability.md):
+
+- ``obs.trace`` — a low-overhead, thread-safe span tracer the trainer,
+  prefetcher, checkpoint engine and grad-sync paths write the real step
+  timeline into; exports Chrome trace-event JSON (Perfetto-loadable)
+  and answers "what is this process doing RIGHT NOW" (hang
+  attribution);
+- ``obs.metrics`` — a counters/gauges/histograms registry with
+  Prometheus text exposition; the existing ``PipelineStats`` record
+  folds into it so there is exactly one export path for every number
+  the fast-path subsystems produce;
+- ``obs.aggregate`` — the master's side: per-worker step-time
+  aggregation, straggler detection against the fleet median, and hang
+  reports enriched with each worker's last open span.
+"""

@@ -1,0 +1,286 @@
+"""Master-side telemetry aggregation: per-worker step times, straggler
+detection, hang attribution.
+
+The master already knows the FLEET's speed (SpeedMonitor's sliding
+window over the max global step) — what it cannot answer is *which
+worker* is slow or *what* a stuck worker is doing. This module holds
+the per-worker view:
+
+- **step-time histograms** — one bounded window per worker, fed two
+  ways: an explicit ``step_time_ms`` scalar when the worker reports it
+  (the ElasticTrainer does, at log cadence), else derived from
+  consecutive ``GlobalStepReport`` (Δtimestamp / Δstep). Explicit wins:
+  once a worker has sent a real measurement the coarse derivation for
+  that worker is ignored.
+- **straggler detection** — a worker whose p50 step time exceeds
+  ``ratio`` × the fleet median p50 (``ratio`` defaults to the
+  ``straggler_time_ratio`` context knob) is flagged; newly-flagged
+  workers are pushed to the Brain datastore through ``brain_reporter``
+  (event ``"straggler"``, see brain/ingestion.straggler_sink) so the
+  evidence survives this master, and the auto-scaler reads the flags
+  off ``stragglers``.
+- **hang attribution** — each worker's last reported open span (the
+  SpanHeartbeat channel through the runtime-metrics file →
+  TrainingMonitor → ``TrainMetricsReport``) is kept with its receipt
+  time, so a hang report can say "worker 3 stuck in ckpt_commit for
+  42s" instead of "no step progress".
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.global_context import Context
+from dlrover_tpu.common.log import default_logger as logger
+
+_ctx = Context.singleton_instance()
+
+# a (derived or explicit) step-time sample longer than this is a stall
+# artifact (restart, resize, rendezvous), not a speed signal
+_MAX_SAMPLE_S = 3600.0
+
+
+class TelemetryAggregator:
+    def __init__(
+        self,
+        straggler_ratio: Optional[float] = None,
+        window: int = 64,
+        min_samples: int = 4,
+        brain_reporter: Optional[Callable[[int, float, float], None]] = None,
+    ):
+        # > 1.0 multiple of the fleet median p50; the context knob is
+        # the job-wide default, per-master override via the ctor
+        self.straggler_ratio = float(
+            straggler_ratio
+            if straggler_ratio is not None
+            else _ctx.straggler_time_ratio
+        )
+        self._window = max(int(window), 4)
+        self._min_samples = max(int(min_samples), 1)
+        self._brain_reporter = brain_reporter
+        self._lock = threading.Lock()
+        self._samples: Dict[int, Deque[float]] = {}
+        self._explicit: set = set()  # workers with real step_time_ms
+        self._last_report: Dict[int, Tuple[int, float]] = {}
+        # worker -> (span name, elapsed_s at receipt, monotonic receipt)
+        self._open_spans: Dict[int, Tuple[str, float, float]] = {}
+        self._last_metrics: Dict[int, dict] = {}
+        self._flagged: set = set()
+
+    # -- ingestion (servicer / speed-monitor hooks) --------------------
+    def observe_step_report(
+        self, worker_id: int, step: int, timestamp: float
+    ):
+        """Per-worker step-time derivation from the global-step channel
+        (every worker reports; no trainer changes needed)."""
+        if worker_id < 0:
+            return
+        with self._lock:
+            prev = self._last_report.get(worker_id)
+            self._last_report[worker_id] = (step, timestamp)
+            if (
+                prev is None
+                or step <= prev[0]
+                or timestamp <= prev[1]
+                or worker_id in self._explicit
+            ):
+                return
+            per_step = (timestamp - prev[1]) / (step - prev[0])
+            if 0.0 < per_step <= _MAX_SAMPLE_S:
+                self._bucket(worker_id).append(per_step)
+
+    def observe_metrics(
+        self,
+        worker_id: int,
+        step: int,
+        metrics: Optional[dict] = None,
+        open_span: str = "",
+        open_span_elapsed_s: float = 0.0,
+    ):
+        """The TrainMetricsReport hook: explicit step-time samples plus
+        the hang-attribution span snapshot."""
+        if worker_id < 0:
+            return
+        metrics = metrics or {}
+        with self._lock:
+            if metrics:
+                self._last_metrics[worker_id] = dict(metrics)
+            st_ms = metrics.get("step_time_ms")
+            if st_ms is not None and st_ms > 0:
+                if worker_id not in self._explicit:
+                    # switch sources: coarse derived samples would skew
+                    # the percentile the explicit channel now owns
+                    self._explicit.add(worker_id)
+                    self._samples.pop(worker_id, None)
+                s = float(st_ms) / 1e3
+                if s <= _MAX_SAMPLE_S:
+                    self._bucket(worker_id).append(s)
+            if open_span:
+                self._open_spans[worker_id] = (
+                    str(open_span),
+                    float(open_span_elapsed_s),
+                    time.monotonic(),
+                )
+            elif worker_id in self._open_spans:
+                # the worker reported "nothing open": clear stale frames
+                self._open_spans.pop(worker_id, None)
+
+    def remove_worker(self, worker_id: int):
+        """A departed worker's history must not haunt the fleet median."""
+        with self._lock:
+            self._samples.pop(worker_id, None)
+            self._explicit.discard(worker_id)
+            self._last_report.pop(worker_id, None)
+            self._open_spans.pop(worker_id, None)
+            self._last_metrics.pop(worker_id, None)
+            self._flagged.discard(worker_id)
+
+    def _bucket(self, worker_id: int) -> Deque[float]:
+        b = self._samples.get(worker_id)
+        if b is None:
+            b = self._samples[worker_id] = deque(maxlen=self._window)
+        return b
+
+    # -- queries -------------------------------------------------------
+    def worker_p50(self, worker_id: int) -> Optional[float]:
+        with self._lock:
+            samples = list(self._samples.get(worker_id, ()))
+        if len(samples) < self._min_samples:
+            return None
+        return float(statistics.median(samples))
+
+    def worker_step_times(self, worker_id: int) -> List[float]:
+        with self._lock:
+            return list(self._samples.get(worker_id, ()))
+
+    def fleet_median(self) -> Optional[float]:
+        """Median of the per-worker p50s (each worker one vote — a
+        straggler's own slow samples must not drag the baseline up the
+        way a pooled median would on small fleets)."""
+        p50s = [
+            p
+            for p in (
+                self.worker_p50(w) for w in self.workers()
+            )
+            if p is not None
+        ]
+        if not p50s:
+            return None
+        return float(statistics.median(p50s))
+
+    def workers(self) -> List[int]:
+        with self._lock:
+            return sorted(self._samples.keys())
+
+    # -- straggler detection -------------------------------------------
+    def detect_stragglers(self) -> List[int]:
+        """Workers whose p50 step time exceeds ``straggler_ratio`` × the
+        fleet median p50. Newly flagged workers are reported to the
+        Brain once per flagging episode (recovery clears the flag, so a
+        relapse reports again)."""
+        med = self.fleet_median()
+        flagged: List[int] = []
+        details: Dict[int, float] = {}
+        if med is not None and med > 0 and len(self.workers()) >= 2:
+            for w in self.workers():
+                p50 = self.worker_p50(w)
+                if p50 is not None and p50 > self.straggler_ratio * med:
+                    flagged.append(w)
+                    details[w] = p50
+        with self._lock:
+            new = [w for w in flagged if w not in self._flagged]
+            self._flagged = set(flagged)
+        for w in new:
+            logger.warning(
+                f"straggler: worker {w} p50 step time "
+                f"{details[w] * 1e3:.0f} ms > {self.straggler_ratio}x "
+                f"fleet median {med * 1e3:.0f} ms"
+            )
+            if self._brain_reporter is not None:
+                try:
+                    self._brain_reporter(w, details[w], med)
+                except Exception as e:
+                    logger.warning(
+                        f"straggler brain report failed: {e!r}"
+                    )
+        return sorted(flagged)
+
+    @property
+    def stragglers(self) -> List[int]:
+        """Last detection pass's verdict (the auto-scaler's read side —
+        call ``detect_stragglers`` to recompute)."""
+        with self._lock:
+            return sorted(self._flagged)
+
+    # -- hang attribution ----------------------------------------------
+    def last_open_span(
+        self, worker_id: int
+    ) -> Optional[Tuple[str, float]]:
+        """(span name, elapsed_s advanced to NOW) of the worker's last
+        reported open span."""
+        with self._lock:
+            rec = self._open_spans.get(worker_id)
+        if rec is None:
+            return None
+        name, elapsed, received = rec
+        return name, elapsed + (time.monotonic() - received)
+
+    def hang_attribution(self) -> Dict[int, str]:
+        """Per-worker one-liners for the hang report."""
+        out: Dict[int, str] = {}
+        with self._lock:
+            workers = set(self._last_report) | set(self._open_spans)
+        for w in sorted(workers):
+            span = self.last_open_span(w)
+            if span is not None:
+                out[w] = f"stuck in {span[0]} for {span[1]:.0f}s"
+            else:
+                out[w] = "no open span reported"
+        return out
+
+    def describe_hang(self) -> str:
+        """The enrichment line for 'job hanged' logs: every worker's
+        last open span, stragglers called out."""
+        attribution = self.hang_attribution()
+        if not attribution:
+            return "no per-worker telemetry"
+        parts = [
+            f"worker {w} {desc}" for w, desc in attribution.items()
+        ]
+        if self.stragglers:
+            parts.append(f"stragglers={self.stragglers}")
+        return "; ".join(parts)
+
+    # -- registry export ------------------------------------------------
+    def export(self, registry) -> None:
+        """Per-worker p50s + fleet median into a MetricsRegistry (the
+        master's Prometheus surface)."""
+        g = registry.gauge(
+            "dlrover_worker_step_time_p50_seconds",
+            "per-worker median step time",
+            labelnames=("worker",),
+        )
+        live = set()
+        for w in self.workers():
+            p50 = self.worker_p50(w)
+            if p50 is not None:
+                g.labels(str(w)).set(p50)
+                live.add((str(w),))
+        # prune departed workers' label children: a scaled-away worker
+        # must not keep exposing its last p50 as a frozen ghost series
+        with g._lock:
+            for key in [k for k in g._children if k not in live]:
+                del g._children[key]
+        med = self.fleet_median()
+        if med is not None:
+            registry.gauge(
+                "dlrover_fleet_step_time_median_seconds",
+                "median of per-worker p50 step times",
+            ).set(med)
+        registry.gauge(
+            "dlrover_straggler_count", "currently flagged stragglers"
+        ).set(len(self.stragglers))

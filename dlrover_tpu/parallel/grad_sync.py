@@ -519,24 +519,29 @@ def measure_sync_ms(
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    sh = NamedSharding(mesh, P(("dp",)))
-    stacked = [
-        jax.device_put(
-            jnp.zeros((plan.dp,) + shape, jnp.dtype(dt)), sh
+    from dlrover_tpu.obs.trace import span
+
+    with span("grad_sync_probe", buckets=plan.num_buckets):
+        sh = NamedSharding(mesh, P(("dp",)))
+        stacked = [
+            jax.device_put(
+                jnp.zeros((plan.dp,) + shape, jnp.dtype(dt)), sh
+            )
+            for shape, dt in zip(plan.leaf_shapes, plan.leaf_dtypes)
+        ]
+        res = (
+            zero_residual(plan, mesh) if plan.compress == "int8" else None
         )
-        for shape, dt in zip(plan.leaf_shapes, plan.leaf_dtypes)
-    ]
-    res = zero_residual(plan, mesh) if plan.compress == "int8" else None
 
-    def run(tree, r):
-        g, _, gn = sync_grads(tree, mesh, plan, residual=r)
-        return gn
+        def run(tree, r):
+            g, _, gn = sync_grads(tree, mesh, plan, residual=r)
+            return gn
 
-    fn = jax.jit(run)
-    jax.block_until_ready(fn(stacked, res))  # compile + warmup
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(stacked, res))
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times) * 1e3)
+        fn = jax.jit(run)
+        jax.block_until_ready(fn(stacked, res))  # compile + warmup
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(stacked, res))
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times) * 1e3)

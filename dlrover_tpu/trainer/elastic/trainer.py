@@ -21,6 +21,7 @@ facade owns the full elastic story so a user train script collapses to
 from __future__ import annotations
 
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
@@ -34,6 +35,8 @@ from dlrover_tpu.ckpt.checkpointer import FlashCheckpointer, StorageType
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.models.config import TransformerConfig
 from dlrover_tpu.models.train import shard_batch
+from dlrover_tpu.obs.metrics import default_registry, fold_pipeline_stats
+from dlrover_tpu.obs.trace import SpanHeartbeat, span
 from dlrover_tpu.trainer.elastic.dataloader import ElasticDataLoader
 from dlrover_tpu.trainer.elastic.sampler import ElasticDistributedSampler
 
@@ -293,6 +296,25 @@ class ElasticTrainer:
         self._last_candidates = None
         self._prefetcher = None
         self._stager = None
+        # -- unified telemetry (obs/): spans + metrics registry --------
+        self._registry = default_registry()
+        self._step_time_hist = self._registry.histogram(
+            "dlrover_step_time_seconds", "optimizer-step wall time"
+        )
+        self._step_time_sum = 0.0
+        self._step_time_n = 0
+        self._train_tid: Optional[int] = None
+        # hang attribution: a background heartbeat publishes the train
+        # thread's current open span into the runtime-metrics file even
+        # while the loop is wedged inside one (obs/trace.SpanHeartbeat →
+        # agent TrainingMonitor → master hang report)
+        self._span_heartbeat = (
+            SpanHeartbeat(tid_fn=lambda: self._train_tid)
+            if self.tcfg.report_metrics
+            else None
+        )
+        if self._span_heartbeat is not None:
+            self._span_heartbeat.start()
         self.state = self.accel.init_fn(jax.random.PRNGKey(0))
         self._grad_sync_plan = None
         self._setup_grad_sync()
@@ -748,9 +770,10 @@ class ElasticTrainer:
         step_fn, state = self._step_fn, self.state
         key = self._step_cache_key(strategy, self.mesh, state, (x, y))
         try:
-            fn, _ = self._compile_cache.get_or_compile(
-                key, lambda: step_fn.lower(state, x, y).compile()
-            )
+            with span("compile_prime"):
+                fn, _ = self._compile_cache.get_or_compile(
+                    key, lambda: step_fn.lower(state, x, y).compile()
+                )
             self._install_aot(fn, (x.shape, y.shape))
         except Exception as e:
             # AOT is an optimization: a lowering quirk must not take
@@ -979,31 +1002,33 @@ class ElasticTrainer:
         if self._spec_compiler is not None:
             self._spec_compiler.submit(())
         # (1) prefetcher down BEFORE any reshard: see docstring
-        buffered = (
-            self._prefetcher.buffered_batches()
-            if self._prefetcher is not None
-            else 0
-        )
-        self._close_prefetcher()
-        if buffered:
-            self.sampler.load_state_dict(
-                self._rewound_sampler_state(
-                    self.sampler.state_dict(), buffered
-                )
+        with span("resize_drain"):
+            buffered = (
+                self._prefetcher.buffered_batches()
+                if self._prefetcher is not None
+                else 0
             )
-        # (2) a half-staged checkpoint reads old-mesh buffers
-        self._finish_stager()
+            self._close_prefetcher()
+            if buffered:
+                self.sampler.load_state_dict(
+                    self._rewound_sampler_state(
+                        self.sampler.state_dict(), buffered
+                    )
+                )
+            # (2) a half-staged checkpoint reads old-mesh buffers
+            self._finish_stager()
         # (3) new-world artifacts; explicit strategy skips the search
-        accel = auto_accelerate(
-            self._model_cfg,
-            self._tx,
-            batch=self.tcfg.batch_size,
-            seq=self.tcfg.seq_len,
-            devices=devices,
-            strategy=strategy,
-            donate=False,
-            grad_accum=self.tcfg.grad_accum,
-        )
+        with span("resize_build"):
+            accel = auto_accelerate(
+                self._model_cfg,
+                self._tx,
+                batch=self.tcfg.batch_size,
+                seq=self.tcfg.seq_len,
+                devices=devices,
+                strategy=strategy,
+                donate=False,
+                grad_accum=self.tcfg.grad_accum,
+            )
         from dlrover_tpu.ckpt import reshard as reshard_mod
         from dlrover_tpu.models.train import state_spec
 
@@ -1015,44 +1040,56 @@ class ElasticTrainer:
         # must match the spec (which never carries it), its shapes are
         # tied to the OLD world's bucket plan anyway, and
         # _setup_grad_sync re-attaches a fresh one for the new plan
-        new_state, report = reshard_mod.reshard_state(
-            strip_residual(self.state), spec, stats=self.pipeline_stats
-        )
-        if report.fallback_paths:
-            if self._ckptr is None:
-                raise RuntimeError(
-                    f"resize: {len(report.fallback_paths)} leaves have "
-                    f"no surviving on-device source and no ckpt_dir is "
-                    f"configured for the host fallback (first: "
-                    f"{report.fallback_paths[:3]})"
-                )
-            step0, restored = self._ckptr.load_checkpoint(
-                {"train": spec, "sampler": self.sampler.state_dict()}
+        # the with-block (not a manual handle) guarantees the span
+        # closes on the raise paths below — a leaked open span would
+        # poison hang attribution for the rest of the process
+        with span("resize_reshard") as reshard_sp:
+            new_state, report = reshard_mod.reshard_state(
+                strip_residual(self.state), spec,
+                stats=self.pipeline_stats,
             )
-            if restored is None or step0 < 0:
-                raise RuntimeError(
-                    "resize: host fallback restore found no usable "
-                    "checkpoint"
+            reshard_sp.set(
+                fallback_leaves=len(report.fallback_paths),
+                device_bytes=report.device_bytes,
+            )
+            if report.fallback_paths:
+                if self._ckptr is None:
+                    raise RuntimeError(
+                        f"resize: {len(report.fallback_paths)} leaves "
+                        f"have no surviving on-device source and no "
+                        f"ckpt_dir is configured for the host fallback "
+                        f"(first: {report.fallback_paths[:3]})"
+                    )
+                step0, restored = self._ckptr.load_checkpoint(
+                    {"train": spec, "sampler": self.sampler.state_dict()}
                 )
-            live_step = int(self.state.step)
-            if step0 == live_step:
-                # same step: fill only the holes, keep the on-device
-                # arrays for everything that survived
-                new_state = reshard_mod.merge_fallback(
-                    new_state, restored["train"], report.fallback_paths
-                )
-            else:
-                # mixing leaves from different optimizer steps would be
-                # silently inconsistent state — roll the WHOLE state
-                # back to the checkpoint (every leaf from one step)
-                logger.warning(
-                    f"resize: fallback checkpoint is step {step0} but "
-                    f"live state is step {live_step}; restoring the "
-                    f"full checkpoint instead of mixing steps "
-                    f"({live_step - step0} steps of progress replayed)"
-                )
-                new_state = restored["train"]
-                self.sampler.load_state_dict(restored["sampler"])
+                if restored is None or step0 < 0:
+                    raise RuntimeError(
+                        "resize: host fallback restore found no usable "
+                        "checkpoint"
+                    )
+                live_step = int(self.state.step)
+                if step0 == live_step:
+                    # same step: fill only the holes, keep the
+                    # on-device arrays for everything that survived
+                    new_state = reshard_mod.merge_fallback(
+                        new_state, restored["train"],
+                        report.fallback_paths,
+                    )
+                else:
+                    # mixing leaves from different optimizer steps
+                    # would be silently inconsistent state — roll the
+                    # WHOLE state back to the checkpoint (every leaf
+                    # from one step)
+                    logger.warning(
+                        f"resize: fallback checkpoint is step {step0} "
+                        f"but live state is step {live_step}; "
+                        f"restoring the full checkpoint instead of "
+                        f"mixing steps ({live_step - step0} steps of "
+                        f"progress replayed)"
+                    )
+                    new_state = restored["train"]
+                    self.sampler.load_state_dict(restored["sampler"])
         # swap the world
         self.accel = accel
         self.cfg = accel.cfg
@@ -1074,26 +1111,28 @@ class ElasticTrainer:
         cache_hit = None
         self._aot_exec = self._aot_shapes = None
         if self._batch_avals is not None:
-            xy = self._batch_specs(accel.mesh)
-            key = self._step_cache_key(
-                strategy, accel.mesh, new_state, xy
-            )
-            if (
-                self._spec_compiler is not None
-                and self._spec_compiler.in_flight_key == key
-            ):
-                # this exact executable is mid-compile on the
-                # background thread: waiting converts a duplicate
-                # multi-minute compile into a cache hit
-                self._spec_compiler.wait_idle(600.0)
-            step_fn, state = accel.step_fn, new_state
-            fn, cache_hit = self._compile_cache.get_or_compile(
-                key, lambda: step_fn.lower(state, *xy).compile()
-            )
-            self._install_aot(
-                fn, tuple(shape for shape, _ in self._batch_avals)
-            )
-            self._aot_primed = True
+            with span("resize_compile") as compile_sp:
+                xy = self._batch_specs(accel.mesh)
+                key = self._step_cache_key(
+                    strategy, accel.mesh, new_state, xy
+                )
+                if (
+                    self._spec_compiler is not None
+                    and self._spec_compiler.in_flight_key == key
+                ):
+                    # this exact executable is mid-compile on the
+                    # background thread: waiting converts a duplicate
+                    # multi-minute compile into a cache hit
+                    self._spec_compiler.wait_idle(600.0)
+                step_fn, state = accel.step_fn, new_state
+                fn, cache_hit = self._compile_cache.get_or_compile(
+                    key, lambda: step_fn.lower(state, *xy).compile()
+                )
+                compile_sp.set(cache_hit=bool(cache_hit))
+                self._install_aot(
+                    fn, tuple(shape for shape, _ in self._batch_avals)
+                )
+                self._aot_primed = True
         else:
             self._aot_primed = False
         downtime_ms = (time.perf_counter() - t0) * 1e3
@@ -1272,6 +1311,10 @@ class ElasticTrainer:
 
         t0 = time.time()
         start_step = self.global_step
+        # hang attribution reads THIS thread's open spans (the prefetch
+        # producer parks in a read by design and must not masquerade as
+        # the stuck frame)
+        self._train_tid = threading.get_ident()
         self._last_eval: Dict[str, float] = {}
         # run-local best for the patience counter; the PERSISTED best
         # (_best_eval_loss, sidecar-loaded) deliberately survives so a
@@ -1292,6 +1335,34 @@ class ElasticTrainer:
                 logger.error(f"final stage commit failed: {e!r}")
             logger.info(f"pipeline: {self.pipeline_stats.summary()}")
 
+    def _observe_step_time(self, dt_s: float):
+        self._step_time_hist.observe(dt_s)
+        self._step_time_sum += dt_s
+        self._step_time_n += 1
+
+    def _report_metrics(self, step: int, scalars: Dict[str, float]):
+        """Publish at log cadence: training scalars + the whole metrics
+        registry through ONE file (the agent's TrainingMonitor forwards
+        every float in it to the master's collector). PipelineStats
+        folds into the registry here so its counters ride the same
+        export path as everything else."""
+        if self._step_time_n:
+            scalars["step_time_ms"] = round(
+                1e3 * self._step_time_sum / self._step_time_n, 3
+            )
+            self._step_time_sum = 0.0
+            self._step_time_n = 0
+        for k, v in scalars.items():
+            self._registry.gauge(
+                f"dlrover_train_{k}", "training scalar"
+            ).set(v)
+        fold_pipeline_stats(self.pipeline_stats, self._registry)
+        if self.tcfg.report_metrics:
+            report_runtime_metrics(
+                step, **{**scalars, **self._registry.scalars()}
+            )
+        return scalars
+
     def _train_loop(self, num_steps: int, t0, start_step) -> Any:
         import jax
 
@@ -1305,59 +1376,99 @@ class ElasticTrainer:
             # epoch on exhaustion) — the trainer never touches them, so a
             # num_steps stop mid-epoch checkpoints the exact position
             # (modulo the prefetch rewind in _ckpt_state)
-            for x, y in self._epoch_batches(num_steps):
-                metrics = self._run_step(x, y)
-                step = self.global_step
-                # interleave checkpoint chunks while the step computes
-                self._advance_stager()
-                if self._metrics_hook is not None:
-                    self._metrics_hook(step, metrics)
-                if step % self.tcfg.log_interval == 0:
-                    # the only host sync in the loop: loss is materialized
-                    # at log cadence, not every step (async dispatch stays
-                    # ahead of the host otherwise)
-                    loss = float(metrics["loss"])
-                    scalars = {"loss": loss}
-                    lr = self.current_lr()
-                    if lr is not None:
-                        scalars["lr"] = lr
-                    if self._last_eval:
-                        scalars.update(self._last_eval)
-                    if self.tcfg.report_metrics:
-                        # the agent's TrainingMonitor forwards these to
-                        # the master's collector (TrainMetricsReport)
-                        report_runtime_metrics(step, **scalars)
-                    rate = (step - start_step) / max(
-                        time.time() - t0, 1e-9
-                    )
-                    lr_s = f" lr={lr:.2e}" if lr is not None else ""
-                    logger.info(
-                        f"step {step}: loss={loss:.4f}{lr_s} "
-                        f"({rate:.2f} it/s)"
-                    )
-                if (
-                    self._eval_dataset is not None
-                    and self.tcfg.eval_interval
-                    and step % self.tcfg.eval_interval == 0
-                ):
-                    self._last_eval = self.evaluate()
-                    logger.info(
-                        f"step {step}: "
-                        f"eval_loss={self._last_eval['eval_loss']:.4f} "
-                        f"ppl={self._last_eval['eval_ppl']:.2f}"
-                    )
+            batches = self._epoch_batches(num_steps)
+            while True:
+                # the step span + its phase children are the trace's
+                # spine: a dump shows where each step's wall time went
+                # (docs/observability.md span taxonomy). An exception
+                # escaping the body must CANCEL the span — a leaked
+                # open frame would poison hang attribution for the
+                # rest of the process (cancel after end is a no-op)
+                step_sp = span("step")
+                step_t0 = time.perf_counter()
+                try:
+                    try:
+                        with span("data_wait"):
+                            x, y = next(batches)
+                    except StopIteration:
+                        step_sp.cancel()
+                        break
+                    with span("compute"):
+                        metrics = self._run_step(x, y)
+                        # materializing the step count forces the
+                        # dispatched update on synchronous backends —
+                        # that wall time is compute, so it must land
+                        # inside this span
+                        step = self.global_step
+                    # interleave checkpoint chunks while the step
+                    # computes (the engine emits its own ckpt_stage
+                    # span)
+                    self._advance_stager()
                     if self._metrics_hook is not None:
-                        self._metrics_hook(step, dict(self._last_eval))
-                    if self._after_eval(step):
+                        self._metrics_hook(step, metrics)
+                    if step % self.tcfg.log_interval == 0:
+                        # the only host sync in the loop: loss is
+                        # materialized at log cadence, not every step
+                        # (async dispatch stays ahead of the host
+                        # otherwise)
+                        with span("host_sync"):
+                            loss = float(metrics["loss"])
+                        with span("report"):
+                            scalars = {"loss": loss}
+                            lr = self.current_lr()
+                            if lr is not None:
+                                scalars["lr"] = lr
+                            if self._last_eval:
+                                scalars.update(self._last_eval)
+                            # the agent's TrainingMonitor forwards
+                            # these to the master's collector
+                            # (TrainMetricsReport)
+                            self._report_metrics(step, scalars)
+                            rate = (step - start_step) / max(
+                                time.time() - t0, 1e-9
+                            )
+                            lr_s = (
+                                f" lr={lr:.2e}" if lr is not None else ""
+                            )
+                            logger.info(
+                                f"step {step}: loss={loss:.4f}{lr_s} "
+                                f"({rate:.2f} it/s)"
+                            )
+                    if (
+                        self._eval_dataset is not None
+                        and self.tcfg.eval_interval
+                        and step % self.tcfg.eval_interval == 0
+                    ):
+                        with span("eval"):
+                            self._last_eval = self.evaluate()
                         logger.info(
-                            f"early stopping at step {step}: no eval "
-                            f"improvement in "
-                            f"{self.tcfg.early_stopping_patience} evals "
-                            f"(best {self._best_eval_loss:.4f})"
+                            f"step {step}: "
+                            f"eval_loss={self._last_eval['eval_loss']:.4f} "
+                            f"ppl={self._last_eval['eval_ppl']:.2f}"
                         )
-                        jax.block_until_ready(self.state.params)
-                        return self.state
-                self._maybe_save(step)
+                        if self._metrics_hook is not None:
+                            self._metrics_hook(
+                                step, dict(self._last_eval)
+                            )
+                        if self._after_eval(step):
+                            logger.info(
+                                f"early stopping at step {step}: no "
+                                f"eval improvement in "
+                                f"{self.tcfg.early_stopping_patience} "
+                                f"evals (best {self._best_eval_loss:.4f})"
+                            )
+                            step_sp.end()
+                            jax.block_until_ready(self.state.params)
+                            return self.state
+                    with span("ckpt_save"):
+                        self._maybe_save(step)
+                    step_sp.end()
+                    self._observe_step_time(
+                        time.perf_counter() - step_t0
+                    )
+                except BaseException:
+                    step_sp.cancel()
+                    raise
                 if step >= num_steps:
                     break
             self._close_prefetcher()  # fresh buffer per epoch
@@ -1406,6 +1517,9 @@ class ElasticTrainer:
         logger.info(f"learning rate rescaled x{scale} (linear scaling)")
 
     def close(self):
+        if self._span_heartbeat is not None:
+            self._span_heartbeat.stop()
+            self._span_heartbeat = None
         self._close_prefetcher()
         self._abort_stager()
         if self._spec_compiler is not None:

@@ -1746,6 +1746,118 @@ def run_trace_bench(jax, results: dict, smoke: bool = False):
         trainer.close()
 
 
+def run_recovery_bench(jax, results: dict, smoke: bool = False):
+    """Checkpoint-integrity recovery leg: inject a torn shard write and
+    a persistent-ENOSPC persist through the deterministic fault points
+    (``common/faults.py``) and measure/assert the recovery contract:
+
+    - a torn newest step is DETECTED at load, quarantined, and restore
+      falls back to the previous verified step (``ckpt_recover_ms``
+      times that detect+rollback+restore);
+    - persistent ENOSPC drops the saver into shm-only degraded mode
+      (visible in the metrics registry), and the first healthy persist
+      exits it;
+    - ``faults_triggered`` counts every injected fault that fired.
+
+    ``--smoke`` exits nonzero on any undetected corruption or failed
+    rollback — the durability path regressing must fail CI loudly.
+    """
+    import shutil
+
+    import jax.numpy as jnp
+
+    from dlrover_tpu.common import faults
+    from dlrover_tpu.ckpt.checkpointer import FlashCheckpointer, StorageType
+    from dlrover_tpu.ckpt.saver import (
+        AsyncCheckpointSaver,
+        QUARANTINE_SUFFIX,
+    )
+    from dlrover_tpu.obs.metrics import default_registry
+
+    faults.reset()
+    AsyncCheckpointSaver.reset()
+    tmp = tempfile.mkdtemp(prefix="dlrover_recovery_")
+    try:
+        # -- leg 1: torn shard write -> detect + rollback (sync path) --
+        ckptr = FlashCheckpointer(os.path.join(tmp, "ckpt"))
+        w_good = np.arange(4096.0, dtype=np.float32)
+        assert ckptr.save_checkpoint(
+            1, {"w": jnp.asarray(w_good), "step": 1}, StorageType.DISK
+        )
+        faults.configure("ckpt.shard_write:torn_write:1.0:1")
+        ckptr.save_checkpoint(
+            2,
+            {"w": jnp.asarray(w_good * 2), "step": 2},
+            StorageType.DISK,
+        )
+        faults.configure("")  # disarm, keep the trigger tally
+        target = {"w": jnp.zeros(4096, jnp.float32), "step": 0}
+        t0 = time.perf_counter()
+        step, state = ckptr.load_checkpoint(target)
+        recover_ms = (time.perf_counter() - t0) * 1e3
+        torn_detected = any(
+            QUARANTINE_SUFFIX in n for n in os.listdir(ckptr.checkpoint_dir)
+        )
+        rollback_ok = (
+            step == 1
+            and state is not None
+            and np.array_equal(np.asarray(state["w"]), w_good)
+        )
+        results["ckpt_recover_ms"] = round(recover_ms, 2)
+        results["recovery_torn_detected"] = torn_detected
+        results["recovery_rollback_ok"] = bool(rollback_ok)
+
+        # -- leg 2: persistent ENOSPC -> degraded mode + recovery ------
+        from dlrover_tpu.ckpt.engine import CheckpointEngine
+
+        saver = AsyncCheckpointSaver.start_async_saving_ckpt(
+            local_shard_num=1
+        )
+        saver.persist_retries = 2
+        saver.persist_backoff_base = 0.01
+        saver.persist_backoff_cap = 0.02
+        try:
+            engine = CheckpointEngine()
+            ckpt_dir2 = os.path.join(tmp, "ckpt2")
+            faults.configure("ckpt.persist:enospc:1.0")
+            engine.save_to_memory(
+                1, {"w": jnp.arange(64.0)}, ckpt_dir2
+            )
+            deadline = time.time() + 30
+            while time.time() < deadline and not saver.degraded:
+                time.sleep(0.05)
+            degraded = saver.degraded
+            gauge_visible = (
+                default_registry()
+                .gauge("dlrover_ckpt_degraded_mode")
+                .value
+                == 1.0
+            )
+            faults.configure("")  # heal the disk, keep the tallies
+            deadline = time.time() + 30
+            saved = False
+            while time.time() < deadline and not saved:
+                saved = engine.save_to_memory(
+                    2, {"w": jnp.arange(64.0) + 1}, ckpt_dir2
+                )
+                time.sleep(0.1)
+            deadline = time.time() + 30
+            while time.time() < deadline and saver.degraded:
+                time.sleep(0.05)
+            results["recovery_enospc_degraded"] = bool(
+                degraded and gauge_visible
+            )
+            results["recovery_enospc_recovered"] = bool(
+                saved and not saver.degraded
+            )
+        finally:
+            AsyncCheckpointSaver.reset()
+        results["faults_triggered"] = faults.triggered_total()
+    finally:
+        faults.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_smoke() -> int:
     """Fast CPU-only pass over the pipeline + resize keys (CI wiring:
     overlap and resize-fast-path regressions must fail loudly without a
@@ -1779,6 +1891,10 @@ def run_smoke() -> int:
         run_trace_bench(jax, results, smoke=True)
     except Exception as e:
         results["trace_error"] = repr(e)
+    try:
+        run_recovery_bench(jax, results, smoke=True)
+    except Exception as e:
+        results["recovery_error"] = repr(e)
     print(json.dumps(results))
     sys.stdout.flush()
     sys.stderr.flush()
@@ -1812,6 +1928,18 @@ def run_smoke() -> int:
         and results.get("trace_step_coverage_pct") is not None
         and results["trace_step_coverage_pct"] >= TRACE_COVERAGE_GATE_PCT
         and results.get("trace_overhead_ok") is True
+        # the durability gates: an injected torn write must be detected
+        # and rolled back to the previous verified step, and persistent
+        # ENOSPC must enter (and a healthy persist exit) shm-only
+        # degraded mode — undetected corruption or a failed rollback is
+        # a data-loss bug and must fail CI loudly
+        and "recovery_error" not in results
+        and results.get("recovery_torn_detected") is True
+        and results.get("recovery_rollback_ok") is True
+        and results.get("recovery_enospc_degraded") is True
+        and results.get("recovery_enospc_recovered") is True
+        and results.get("ckpt_recover_ms") is not None
+        and (results.get("faults_triggered") or 0) > 0
     )
     os._exit(0 if ok else 1)
 
@@ -1958,6 +2086,11 @@ def main() -> int:
     except Exception as e:
         results["trace_overhead_pct"] = None
         results["trace_error"] = repr(e)
+    try:
+        run_recovery_bench(jax, results)
+    except Exception as e:
+        results["ckpt_recover_ms"] = None
+        results["recovery_error"] = repr(e)
     try:
         run_mfu(jax, results)
     except Exception as e:

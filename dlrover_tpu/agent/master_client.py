@@ -9,12 +9,13 @@ store, paral config.
 from __future__ import annotations
 
 import os
+import random
 import time
 from typing import Dict, List, Optional, Tuple
 
 import grpc
 
-from dlrover_tpu.common import comm
+from dlrover_tpu.common import comm, faults
 from dlrover_tpu.common.constants import NodeEnv, RendezvousName
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.servicer import SERVICE_NAME
@@ -70,10 +71,25 @@ class MasterClient:
         message,
         retries: int = 3,
         rpc_timeout: Optional[float] = None,
+        retry_budget_s: float = 60.0,
     ):
+        """One RPC with bounded retries.
+
+        Backoff is FULL JITTER (``uniform(0, min(2**i, 8))``): after a
+        master restart every agent in the fleet retries at once, and the
+        old fixed ``sleep(min(2**i, 8))`` phase-locked those retries into
+        synchronized storms that hammered the fresh master in lockstep.
+        ``retry_budget_s`` bounds the total time THIS CALL may spend
+        retrying (attempt time + backoff) — a caller holding a lock or a
+        monitor tick must fail in bounded time, not ride an unbounded
+        exponential tail."""
         err: Optional[Exception] = None
+        deadline = time.monotonic() + retry_budget_s
         for i in range(retries):
             try:
+                # fault point rpc.send: injected OSError/delay exercises
+                # exactly the retry/backoff path a flaky network does
+                faults.fire("rpc.send")
                 resp_bytes = rpc(
                     self._wrap(message),
                     timeout=rpc_timeout or self._timeout,
@@ -85,10 +101,19 @@ class MasterClient:
                         f"{resp.message}"
                     )
                 return comm.deserialize_message(resp.data)
-            except grpc.RpcError as e:
+            except (grpc.RpcError, OSError) as e:
                 err = e
-                if i < retries - 1:
-                    time.sleep(min(2**i, 8))
+                if i >= retries - 1:
+                    break
+                delay = random.uniform(0.0, min(2.0**i, 8.0))
+                if time.monotonic() + delay >= deadline:
+                    logger.warning(
+                        f"{type(message).__name__}: retry budget "
+                        f"({retry_budget_s}s) exhausted after "
+                        f"{i + 1} attempts"
+                    )
+                    break
+                time.sleep(delay)
         raise ConnectionError(
             f"master {self._master_addr} unreachable: {err!r}"
         )
@@ -98,8 +123,17 @@ class MasterClient:
             self._get_rpc, message, retries=retries, rpc_timeout=rpc_timeout
         )
 
-    def report(self, message, retries: int = 3):
-        return self._call(self._report_rpc, message, retries=retries)
+    def report(self, message, retries: int = 3, idempotent: bool = True):
+        """``idempotent=False`` declares that replaying the message on a
+        lost *response* would double-apply it server-side (counter adds,
+        joins with side effects): such reports get exactly one attempt —
+        the caller owns recovery — instead of each call site hand-rolling
+        a ``retries=1`` with a comment."""
+        return self._call(
+            self._report_rpc,
+            message,
+            retries=retries if idempotent else 1,
+        )
 
     # -- data sharding -------------------------------------------------
     def report_dataset_shard_params(self, params: comm.DatasetShardParams):
@@ -281,8 +315,10 @@ class MasterClient:
         return resp.value if resp else b""
 
     def kv_store_add(self, key: str, amount: int) -> int:
-        # not idempotent: never blind-retry, a lost response would re-add
-        resp = self.report(comm.KeyValueAdd(key=key, amount=amount), retries=1)
+        # a lost response would re-add on replay
+        resp = self.report(
+            comm.KeyValueAdd(key=key, amount=amount), idempotent=False
+        )
         if isinstance(resp, comm.KeyValuePair):
             return int(resp.value or b"0")
         return 0

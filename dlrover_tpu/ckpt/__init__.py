@@ -20,4 +20,10 @@ from dlrover_tpu.ckpt.checkpointer import (  # noqa: F401
     StorageType,
 )
 from dlrover_tpu.ckpt.engine import CheckpointEngine  # noqa: F401
-from dlrover_tpu.ckpt.saver import AsyncCheckpointSaver  # noqa: F401
+from dlrover_tpu.ckpt.saver import (  # noqa: F401
+    AsyncCheckpointSaver,
+    gc_checkpoints,
+    quarantine_step_dir,
+    resolve_verified_step,
+    verify_step_dir,
+)

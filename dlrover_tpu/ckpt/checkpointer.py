@@ -85,7 +85,17 @@ class FlashCheckpointer(Checkpointer):
         buffers (the train loop must not donate them)."""
         return self.engine.staging_in_flight()
 
+    def latest_verified_step(self) -> int:
+        """Newest committed step whose shards pass integrity
+        verification (crc32 + completeness); -1 when none does. A
+        corrupt newest step is quarantined and the tracker rolled back
+        (only on global shard 0 — see
+        ``CheckpointEngine.latest_verified_step``)."""
+        return self.engine.latest_verified_step(self.checkpoint_dir)
+
     def load_checkpoint(self, target: Any) -> Tuple[int, Optional[Any]]:
         """Returns ``(step, state)``; ``(-1, None)`` when no checkpoint
-        exists yet."""
+        exists yet. The restored step is the newest *verified* one —
+        corrupt/partial newer steps are detected and rolled past, never
+        silently restored."""
         return self.engine.load(target, self.checkpoint_dir)

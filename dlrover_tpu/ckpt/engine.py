@@ -17,10 +17,12 @@ from __future__ import annotations
 import os
 import threading
 import time
+import zlib
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.multi_process import (
     SharedLock,
@@ -111,7 +113,12 @@ class ChunkedStager:
         self.chunks_written = 0
         self._cursor = 0  # plan index
         self._elem_off = 0  # element offset within the current record
-        self._inflight = None  # (byte_offset, nbytes, host_producer)
+        # running crc32 per record index, folded chunk-by-chunk as the
+        # bytes are written (writes are in offset order per record, so
+        # the incremental crc equals the whole-record crc); published
+        # with the metas at commit for end-to-end shm integrity
+        self._crcs: Dict[int, int] = {}
+        self._inflight = None  # (rec_idx, byte_offset, nbytes, producer)
         self._finished = False
         self._failed = False
         self._engine._shm.begin_save(max(self.total_bytes, 1))
@@ -141,23 +148,24 @@ class ChunkedStager:
     # -- chunk pipeline ------------------------------------------------
     def _start_next(self):
         """Build the next write group and start its D2H. A group is a
-        list of ``(byte_offset, nbytes, source)`` members totalling at
-        most ``chunk_bytes``: consecutive small records coalesce into
-        one group (a pytree of many tiny leaves must not become one
-        chunk per leaf), a record larger than ``chunk_bytes`` is split
-        into equal-size windows (consistent slice shapes, so the eager
-        slice op compiles once). Returns None at plan's end."""
+        list of ``(rec_idx, byte_offset, nbytes, source)`` members
+        totalling at most ``chunk_bytes``: consecutive small records
+        coalesce into one group (a pytree of many tiny leaves must not
+        become one chunk per leaf), a record larger than ``chunk_bytes``
+        is split into equal-size windows (consistent slice shapes, so the
+        eager slice op compiles once). Returns None at plan's end."""
         import jax
 
         group = []
         budget = self._chunk_bytes
         while self._cursor < len(self._plan) and budget > 0:
+            idx = self._cursor
             rec, src = self._plan[self._cursor]
             meta = self._metas[self._cursor]
             if isinstance(src, np.ndarray):
                 if src.nbytes > budget and group:
                     break
-                group.append((meta.offset, src.nbytes, src))
+                group.append((idx, meta.offset, src.nbytes, src))
                 budget -= src.nbytes
                 self._cursor += 1
                 continue
@@ -187,7 +195,12 @@ class ChunkedStager:
             except Exception:
                 pass
             group.append(
-                (meta.offset + lo * itemsize, (hi - lo) * itemsize, dev)
+                (
+                    idx,
+                    meta.offset + lo * itemsize,
+                    (hi - lo) * itemsize,
+                    dev,
+                )
             )
             budget -= (hi - lo) * itemsize
         return group or None
@@ -196,10 +209,10 @@ class ChunkedStager:
     def _may_defer(cls, group) -> bool:
         """True when a budgeted advance should leave this group to ride
         the async stream instead of blocking on its transfer."""
-        total = sum(n for _, n, _ in group)
+        total = sum(n for _, n, _, _ in group)
         if total < cls._DEFER_MIN_BYTES:
             return False
-        for _, _, src in group:
+        for _, _, _, src in group:
             if isinstance(src, np.ndarray):
                 continue
             try:
@@ -219,10 +232,16 @@ class ChunkedStager:
         group = self._inflight
         self._inflight = self._start_next()
         written = 0
-        for offset, nbytes, src in group:
+        for idx, offset, nbytes, src in group:
             data = (
                 src if isinstance(src, np.ndarray) else np.asarray(src)
             )
+            # fold the chunk into the record's running crc BEFORE
+            # write_chunk (whose ckpt.shm_stage fault point corrupts):
+            # per-record writes are in offset order, so the incremental
+            # crc equals the whole-record crc published at commit
+            flat = np.ascontiguousarray(data).reshape(-1).view(np.uint8)
+            self._crcs[idx] = zlib.crc32(flat, self._crcs.get(idx, 0))
             self._engine._shm.write_chunk(offset, data)
             written += nbytes
         self._staged_bytes += written
@@ -283,6 +302,8 @@ class ChunkedStager:
         try:
             with span("ckpt_commit", step=self.step):
                 self.advance(budget_s=None, stats=stats)
+                for i, m in enumerate(self._metas):
+                    m.crc32 = self._crcs.get(i)
                 self._engine._shm.commit_save(
                     self.step,
                     self._metas,
@@ -600,28 +621,39 @@ class CheckpointEngine:
     def _save_sync(self, step: int, state: Any, checkpoint_dir: str) -> bool:
         """No agent: write this process's shard directly to storage through
         the same payload/done/commit helpers the saver uses, so files stay
-        interchangeable."""
-        with span("ckpt_persist", step=step):
-            records = host_shard_records(state)
-            self.storage.safe_makedirs(
-                os.path.join(
-                    saver_mod.step_dir(checkpoint_dir, step),
-                    saver_mod.DONE_DIR,
+        interchangeable. A storage failure (ENOSPC, transient FS error)
+        returns False instead of killing the train loop — the next save
+        cadence retries; the last verified step stays restorable."""
+        try:
+            with span("ckpt_persist", step=step):
+                faults.fire("ckpt.persist")
+                records = host_shard_records(state)
+                self.storage.safe_makedirs(
+                    os.path.join(
+                        saver_mod.step_dir(checkpoint_dir, step),
+                        saver_mod.DONE_DIR,
+                    )
                 )
-            )
-            payload = saver_mod.build_shard_payload(
-                step, self.global_shard_id, self.global_shard_num,
-                records, {},
-            )
-            saver_mod.write_shard_and_done(
-                self.storage, checkpoint_dir, step, payload
-            )
-            if self.global_shard_id == 0:
-                return saver_mod.commit_checkpoint(
-                    self.storage, checkpoint_dir, step,
-                    self.global_shard_num,
+                payload = saver_mod.build_shard_payload(
+                    step, self.global_shard_id, self.global_shard_num,
+                    records, {},
                 )
-            return True
+                saver_mod.write_shard_and_done(
+                    self.storage, checkpoint_dir, step, payload
+                )
+                if self.global_shard_id == 0:
+                    return saver_mod.commit_checkpoint(
+                        self.storage, checkpoint_dir, step,
+                        self.global_shard_num,
+                    )
+                return True
+        except OSError as e:
+            logger.error(f"step {step}: sync persist failed: {e!r}")
+            saver_mod._metric_counter(
+                "dlrover_ckpt_persist_failures_total",
+                "failed checkpoint persist attempts",
+            ).inc()
+            return False
 
     # ------------------------------------------------------------------
     # load
@@ -629,15 +661,43 @@ class CheckpointEngine:
     def latest_step(self, checkpoint_dir: str) -> int:
         return saver_mod.read_tracker(self.storage, checkpoint_dir)
 
+    def latest_verified_step(
+        self, checkpoint_dir: str, repair: Optional[bool] = None
+    ) -> int:
+        """Newest committed step whose shards pass integrity
+        verification. ``repair`` (default: only global shard 0, so one
+        process per job mutates the store) quarantines corrupt step
+        dirs and rolls the tracker back; the repairing rank runs the
+        deep read+crc pass, the others the cheap completeness/length
+        check (N ranks each reading every shard's full bytes just to
+        pick the restore step would swamp restart I/O). Known tradeoff:
+        the repairing rank reads the verified step once to checksum it
+        and again to restore — 2x one checkpoint read on the rare
+        restart path, accepted for the simplicity of keeping
+        verification separate from the sliced ``.idx``-driven load."""
+        if repair is None:
+            repair = self.global_shard_id == 0
+        return saver_mod.resolve_verified_step(
+            self.storage, checkpoint_dir, repair=repair, deep=repair
+        )
+
     def load(
         self, target: Any, checkpoint_dir: str, prefer_memory: bool = True
     ) -> Tuple[int, Optional[Any]]:
         """Restore ``target``-shaped state. Prefers shm when *every*
         process holds the same usable step at least as new as the committed
         one (fast elastic-restart path, engine.py:315), else reads the
-        committed step from storage. ``prefer_memory=False`` skips the shm
-        proposal entirely — the full-loss path (replacement node, no
-        surviving agent shm).
+        newest *verified* committed step from storage. ``prefer_memory=
+        False`` skips the shm proposal entirely — the full-loss path
+        (replacement node, no surviving agent shm).
+
+        Both sources are integrity-checked: the shm proposal recomputes
+        each record's crc32 against the writer's published checksum (a
+        corrupt segment downgrades to the storage path), and the storage
+        step comes from ``latest_verified_step`` — a torn/bit-flipped/
+        partial newest step is quarantined and restore falls back to the
+        newest older step that verifies, never silently restoring
+        corrupt bytes.
 
         The cross-process agreement mirrors the reference's
         ``verify_all_rank_step_consistent`` (engine.py:318): because
@@ -645,8 +705,17 @@ class CheckpointEngine:
         hosts can hold *different* shm steps after an elastic restart —
         restoring them as-is would silently diverge the replicas. Every
         process must call ``load`` (it's the restart path), so the
-        allgather below cannot deadlock."""
-        committed = self.latest_step(checkpoint_dir)
+        allgather below cannot deadlock.
+
+        The storage step is cross-rank agreed too (fleet MINIMUM): only
+        the repairing rank deep-verifies, so after it quarantines a
+        length-preserving bit flip and rolls the tracker back, the other
+        ranks' shallow check may still name the corrupt newer step —
+        without the min they would restore different steps (or read a
+        step dir mid-quarantine-rename)."""
+        committed = self._agree_committed(
+            self.latest_verified_step(checkpoint_dir)
+        )
         # propose this host's usable shm step (-1 = none). The shard lock
         # guards against reading shm mid-rewrite by an in-flight
         # block=False staging thread or the persisting saver; a lock
@@ -663,9 +732,11 @@ class CheckpointEngine:
                 try:
                     # zero-copy views: consumed (packed into transfer
                     # buffers) inside restore_state below, all before the
-                    # lock is released in the finally
+                    # lock is released in the finally. verify=True: a
+                    # corrupt segment (bit rot, partial staging) raises
+                    # ValueError and the proposal downgrades to -1
                     shm_step, records, _ = self._shm.load_records(
-                        copy=False
+                        copy=False, verify=True
                     )
                     if shm_step >= committed and self._shm_covers(
                         records, target
@@ -713,6 +784,33 @@ class CheckpointEngine:
         return committed, self._load_from_storage(
             target, checkpoint_dir, committed
         )
+
+    def _agree_committed(self, committed: int) -> int:
+        """Fleet minimum of per-rank verified storage steps. The min is
+        always a step the repairing rank verified deeply (its own value
+        after any rollback), so every rank restores the same bytes."""
+        try:
+            import jax
+
+            if jax.process_count() <= 1:
+                return committed
+            from jax.experimental import multihost_utils
+
+            steps = multihost_utils.process_allgather(
+                np.asarray([committed], np.int64)
+            )
+            agreed = int(np.min(steps))
+            if agreed != committed:
+                logger.warning(
+                    f"verified storage step disagreement: local "
+                    f"{committed}, fleet min {agreed}; using the min"
+                )
+            return agreed
+        except Exception as e:
+            logger.warning(
+                f"storage step agreement check unavailable: {e!r}"
+            )
+            return committed
 
     def _all_processes_agree(self, candidate: int) -> bool:
         """True iff every JAX process proposes the same shm step. Uses a

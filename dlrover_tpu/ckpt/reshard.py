@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
 
 # index of a shard in the global array: ((start, stop) per dim)
@@ -171,6 +172,10 @@ def reshard_state(
     import jax
 
     t0 = time.perf_counter()
+    # fault point reshard.gather: an injected failure here exercises the
+    # resize path's recovery contract (trainer falls back to the shm/
+    # storage restore instead of resizing with half-moved state)
+    faults.fire("reshard.gather")
     report = ReshardReport()
     s_leaves, s_def = jax.tree_util.tree_flatten_with_path(state)
     t_leaves, t_def = jax.tree_util.tree_flatten_with_path(target_spec)

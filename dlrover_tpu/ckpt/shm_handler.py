@@ -11,11 +11,13 @@ so a reader can never see step-N metadata over step-M bytes.
 from __future__ import annotations
 
 import os
+import zlib
 from dataclasses import asdict, dataclass
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.multi_process import (
     SharedDict,
@@ -27,6 +29,12 @@ from dlrover_tpu.ckpt.sharding import Index, ShardRecord
 
 _META_DICT_PREFIX = "ckpt_meta"
 _SHM_PREFIX = "dlrover_tpu_ckpt"
+
+
+def data_crc32(data) -> int:
+    """crc32 of an array's raw bytes (any dtype/shape; one pass)."""
+    arr = np.ascontiguousarray(data)
+    return zlib.crc32(arr.reshape(-1).view(np.uint8))
 
 
 def shard_meta_name(local_rank: int) -> str:
@@ -47,6 +55,11 @@ class RecordMeta:
     index: Index
     offset: int
     nbytes: int
+    # crc32 of the record's bytes, computed by the WRITER before the
+    # bytes enter shm: a reader (the persisting saver, or a restore's
+    # shm proposal) can detect corruption that happened in flight or
+    # at rest in the segment. None on writers predating checksums.
+    crc32: Optional[int] = None
 
 
 class ShmHandler:
@@ -80,8 +93,13 @@ class ShmHandler:
 
     def write_chunk(self, offset: int, data: np.ndarray) -> None:
         """Copy one chunk of raw bytes into the open segment. ``data``
-        is any array; its buffer lands byte-for-byte at ``offset``."""
+        is any array; its buffer lands byte-for-byte at ``offset``.
+
+        Fault point ``ckpt.shm_stage``: corruption is applied AFTER the
+        writer computed its record checksum, so an armed bit-flip is
+        detectable downstream — exactly like real in-flight rot."""
         src = np.ascontiguousarray(data)
+        src = faults.corrupt_array("ckpt.shm_stage", src)
         view = np.ndarray(
             (src.nbytes,),
             dtype=np.uint8,
@@ -136,6 +154,9 @@ class ShmHandler:
         total = metas[-1].offset + metas[-1].nbytes if metas else 1
         self.begin_save(total)
         for r, m in zip(records, metas):
+            # checksum BEFORE the bytes enter shm (write_chunk is where
+            # the ckpt.shm_stage fault corrupts): end-to-end integrity
+            m.crc32 = data_crc32(r.data)
             self.write_chunk(m.offset, r.data)
         self.commit_save(step, metas, extra)
 
@@ -144,7 +165,7 @@ class ShmHandler:
         return self._meta.as_dict()
 
     def load_records(
-        self, copy: bool = True
+        self, copy: bool = True, verify: bool = False
     ) -> Tuple[int, List[ShardRecord], Dict]:
         """Read back (step, records, extra); records hold *copies* of the
         bytes so the segment can be overwritten immediately after.
@@ -153,7 +174,13 @@ class ShmHandler:
         caller must hold the shard lock until it has consumed them and
         must drop every record before the handler closes (a live view
         pins the mapping). The restore path uses this: its packed
-        transfer makes exactly one host copy, shm → flat buffer."""
+        transfer makes exactly one host copy, shm → flat buffer.
+
+        ``verify=True`` recomputes each record's crc32 against the
+        writer's published checksum and raises ``ValueError`` on the
+        first mismatch — the saver uses it before persisting (corrupt
+        shm must not poison storage) and the restore's shm proposal
+        uses it to downgrade to the storage fallback."""
         meta = self.metadata()
         if not meta.get("valid"):
             raise LookupError("no valid checkpoint in shared memory")
@@ -179,6 +206,14 @@ class ShmHandler:
                 buffer=shm.buf,
                 offset=m["offset"],
             )
+            if verify and m.get("crc32") is not None:
+                got = zlib.crc32(raw)
+                if got != m["crc32"]:
+                    raise ValueError(
+                        f"shm record {m['path']!r} checksum mismatch "
+                        f"(want {m['crc32']}, got {got}): shared-memory "
+                        f"checkpoint is corrupt"
+                    )
             shape = tuple(hi - lo for lo, hi in m["index"])
             data = (raw.copy() if copy else raw).view(
                 np.dtype(m["dtype"])

@@ -110,6 +110,19 @@ class CheckpointStorage(ABC):
     def listdir(self, path: str) -> List[str]:
         ...
 
+    def rename(self, src: str, dst: str):
+        """Atomic move within the store (quarantining corrupt step dirs).
+        Backends without rename semantics may leave this unimplemented —
+        callers fall back to deletion."""
+        raise NotImplementedError
+
+    def size(self, path: str) -> Optional[int]:
+        """Byte length of ``path``; None when absent. Default reads the
+        object — backends with cheap metadata should override (shallow
+        checkpoint verification leans on this to avoid full reads)."""
+        data = self.read(path)
+        return None if data is None else len(data)
+
     def commit(self, step: int, success: bool):
         """Hook run after a step is fully persisted."""
 
@@ -172,6 +185,15 @@ class PosixDiskStorage(CheckpointStorage):
             return sorted(os.listdir(path))
         except OSError:
             return []
+
+    def rename(self, src: str, dst: str):
+        os.rename(src, dst)
+
+    def size(self, path: str) -> Optional[int]:
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return None
 
     def commit(self, step: int, success: bool):
         if success and self._deletion_strategy is not None:

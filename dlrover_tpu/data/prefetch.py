@@ -33,6 +33,7 @@ from collections import deque
 from typing import Any, Callable, Iterator, Optional
 
 from dlrover_tpu.accel.profiler import PipelineStats
+from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.obs.trace import span
 
@@ -125,6 +126,10 @@ class DevicePrefetcher:
             # run OUTSIDE the lock so the consumer never blocks on them
             pull_sp = span("prefetch_pull")
             try:
+                # fault point prefetch.pull: an injected OSError rides the
+                # normal producer-error path — delivered to the consumer
+                # in order, after every batch pulled before it
+                faults.fire("prefetch.pull")
                 host = next(self._src)
             except StopIteration:
                 pull_sp.end()

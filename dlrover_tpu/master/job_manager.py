@@ -59,6 +59,10 @@ class JobManager:
         self._next_node_id: Dict[str, int] = {}
         self._stopped = False
         self._relaunch_listeners: List[Callable[[Node, Node], None]] = []
+        # bounded log of non-fatal node incidents (degraded checkpoint
+        # mode, recoveries, ...): queryable by operators/tests and
+        # mirrored to the Brain when a reporter is wired
+        self._node_events: List[Dict] = []
 
     # -- node table ----------------------------------------------------
     def add_node(self, node: Node):
@@ -171,31 +175,13 @@ class JobManager:
     def _handle_node_failure(self, node: Node):
         if self._speed_monitor:
             self._speed_monitor.remove_running_worker(node.id)
-        # only report incidents with a PHYSICAL host identity: falling
-        # back to the per-job logical name would let two unrelated jobs'
-        # "worker-0" failures condemn a phantom host cluster-wide
-        if self._brain_reporter is not None and node.hostname:
-            # fire-and-forget on a daemon thread: the client retries with
-            # backoff, so an unreachable Brain would otherwise stall the
-            # servicer's event path (and every relaunch) for ~30s
-            args = (
-                node.id,
-                node.hostname,
-                "oom"
-                if node.exit_reason == NodeExitReason.OOM
-                else "failed",
-                node.config_resource.memory_mb,
-            )
-
-            def _report():
-                try:
-                    self._brain_reporter(*args)
-                except Exception as e:
-                    logger.warning(f"brain node-event report failed: {e!r}")
-
-            threading.Thread(
-                target=_report, name="brain-node-event", daemon=True
-            ).start()
+        self._report_to_brain(
+            node,
+            "oom"
+            if node.exit_reason == NodeExitReason.OOM
+            else "failed",
+            node.config_resource.memory_mb,
+        )
         if node.exit_reason == NodeExitReason.OOM:
             # give the replacement more memory (parity: reference doubles
             # memory on OOM relaunch via the resource optimizer)
@@ -260,6 +246,64 @@ class JobManager:
             node.exit_reason = NodeExitReason.HARDWARE_ERROR
             node.update_status(NodeStatus.BREAKDOWN)
             self._handle_node_failure(node)
+        elif level == TrainingExceptionLevel.WARNING:
+            # non-fatal incident (e.g. the saver's "ckpt_degraded: ..."
+            # shm-only-persistence alert): record a node event, don't
+            # touch the relaunch machinery — the node is healthy, its
+            # storage is not
+            event = error_data.split(":", 1)[0].strip() or "warning"
+            self.record_node_event(
+                node_type, node_id, event, detail=error_data
+            )
+
+    def record_node_event(
+        self, node_type: str, node_id: int, event: str, detail: str = ""
+    ):
+        with self._lock:
+            self._node_events.append(
+                {
+                    "node_type": node_type,
+                    "node_id": node_id,
+                    "event": event,
+                    "detail": detail,
+                    "ts": time.time(),
+                }
+            )
+            del self._node_events[:-200]
+        node = self.get_node(node_type, node_id)
+        if node is not None:
+            self._report_to_brain(node, event, 0)
+
+    def _report_to_brain(self, node: Node, event: str, memory_mb: int):
+        """Mirror one node incident to the Brain. Only with a PHYSICAL
+        host identity: falling back to the per-job logical name would
+        let two unrelated jobs' "worker-0" incidents condemn a phantom
+        host cluster-wide. Fire-and-forget on a daemon thread: the
+        client retries with backoff, so an unreachable Brain would
+        otherwise stall the servicer's event path (and every relaunch)
+        for ~30s."""
+        if self._brain_reporter is None or not node.hostname:
+            return
+        args = (node.id, node.hostname, event, memory_mb)
+
+        def _report():
+            try:
+                self._brain_reporter(*args)
+            except Exception as e:
+                logger.warning(f"brain node-event report failed: {e!r}")
+
+        threading.Thread(
+            target=_report, name="brain-node-event", daemon=True
+        ).start()
+
+    def node_events(self, event: str = "") -> List[Dict]:
+        """Recorded incidents, optionally filtered by event name."""
+        with self._lock:
+            return [
+                dict(e)
+                for e in self._node_events
+                if not event or e["event"] == event
+            ]
 
     # -- hang detection -------------------------------------------------
     def all_running_node_hanged(self) -> bool:

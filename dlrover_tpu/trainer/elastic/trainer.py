@@ -1044,10 +1044,33 @@ class ElasticTrainer:
         # closes on the raise paths below — a leaked open span would
         # poison hang attribution for the rest of the process
         with span("resize_reshard") as reshard_sp:
-            new_state, report = reshard_mod.reshard_state(
-                strip_residual(self.state), spec,
-                stats=self.pipeline_stats,
-            )
+            try:
+                new_state, report = reshard_mod.reshard_state(
+                    strip_residual(self.state), spec,
+                    stats=self.pipeline_stats,
+                )
+            except (OSError, RuntimeError) as e:
+                # a failed on-device gather must not abort the resize
+                # mid-world-change: degrade every leaf to the host
+                # fallback below and restore the whole state from the
+                # checkpoint instead. RuntimeError covers the real
+                # failure mode (XLA surfaces interconnect/device errors
+                # as XlaRuntimeError), OSError the injected
+                # reshard.gather fault; ValueError (shape/struct
+                # mismatch = model change) still raises
+                import jax as _jax
+
+                logger.error(
+                    f"resize: on-device reshard failed ({e!r}); "
+                    f"falling back to a full checkpoint restore"
+                )
+                _leaves, _ = _jax.tree_util.tree_flatten_with_path(spec)
+                report = reshard_mod.ReshardReport(
+                    fallback_paths=[
+                        reshard_mod._keystr(kp) for kp, _ in _leaves
+                    ]
+                )
+                new_state = spec
             reshard_sp.set(
                 fallback_leaves=len(report.fallback_paths),
                 device_bytes=report.device_bytes,

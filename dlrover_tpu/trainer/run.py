@@ -256,6 +256,14 @@ def run(args) -> int:
         saver = AsyncCheckpointSaver.start_async_saving_ckpt(
             local_shard_num=args.nproc_per_node, node_rank=args.node_rank
         )
+        # degraded-checkpoint-mode (and recovery) node events reach the
+        # master: a job silently running shm-only would lose everything
+        # on the next node death without anyone being told
+        saver.set_event_reporter(
+            lambda event, msg: client.report_failure(
+                f"{event}: {msg}", level="warning"
+            )
+        )
         # agent-side daemons (parity: launch_agent starts the monitors at
         # training.py:721): resource usage + global step to the master,
         # master-tuned paral config to the dataloader's file

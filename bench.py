@@ -1568,6 +1568,153 @@ def run_grad_sync_bench(jax, results: dict, smoke: bool = False):
     )
 
 
+def run_topology_bench(jax, results: dict, smoke: bool = False):
+    """Measured link-cost model + two-level multi-slice gradient sync
+    (parallel/topology.py, grad_sync's hierarchical schedule).
+
+    Three legs:
+
+    - **probe smoke**: ``probe_link_model`` must produce a ``LinkModel``
+      with sane ordering (ici >= dcn >= host link — a model violating
+      it would invert every scheduling decision built on it; the
+      virtual CPU backend gets the documented fallback constants,
+      labeled), and a second probe must hit the persisted per-
+      fingerprint cache — the warm-restart/resize invariant
+      (docs/elastic-resize.md: re-probe only on fingerprint change);
+    - **two-level vs flat A/B** on an emulated 2-slice mesh (dp over 2
+      DCN slices, CPU virtual backend): the hierarchical schedule must
+      move strictly fewer cross-slice bytes than the flat ring
+      (``grad_sync_2level_wire_vs_flat`` < 1.0) while training
+      bit-identically to GSPMD's monolithic all-reduce in fp32;
+    - **model-driven pricing**: the dry-runner's exposed-comm seconds
+      must move when the installed ``LinkModel``'s DCN rate moves —
+      ``est_step_s`` is priced from the probe, not the legacy
+      ``_SEC_PER_ICI_BYTE`` constant.
+
+    Keys: ``link_ici_GBps`` / ``link_dcn_GBps`` / ``link_host_GBps`` /
+    ``link_ordering_ok`` / ``link_model_source`` /
+    ``topology_probe_cache_hit`` / ``grad_sync_2level_wire_vs_flat`` /
+    ``grad_sync_2level_parity`` / ``grad_sync_ici_ms`` /
+    ``grad_sync_dcn_ms`` / ``dry_run_priced_from_link_model``.
+    """
+    import optax
+
+    from dlrover_tpu.accel.dry_runner import DryRunReport, _comm_estimate
+    from dlrover_tpu.accel.strategy import Strategy
+    from dlrover_tpu.models import tiny
+    from dlrover_tpu.models.train import (
+        build_train_step,
+        init_sharded_state,
+        shard_batch,
+    )
+    from dlrover_tpu.parallel import topology
+    from dlrover_tpu.parallel.grad_sync import (
+        measure_sync_legs_ms,
+        resolve_plan,
+    )
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    devs = list(jax.devices())
+    dp = 8 if len(devs) >= 8 else 4 if len(devs) >= 4 else 0
+    if not dp:
+        results["topology_error"] = "needs >= 4 devices"
+        return
+    devs = devs[:dp]
+    cache = tempfile.mkdtemp(prefix="bench_topo_")
+    topology.reset_link_model()
+    try:
+        # -- leg 1: probe + warm-cache hit ---------------------------
+        m1 = topology.probe_link_model(
+            devices=devs, force=True, cache_dir=cache
+        )
+        m2 = topology.probe_link_model(devices=devs, cache_dir=cache)
+        results["link_ici_GBps"] = round(m1.ici_gbps, 3)
+        results["link_dcn_GBps"] = round(m1.dcn_gbps, 3)
+        results["link_host_GBps"] = round(
+            min(m1.host_d2h_gbps, m1.host_h2d_gbps), 3
+        )
+        results["link_model_source"] = m1.source
+        results["link_ordering_ok"] = bool(m1.ordering_ok)
+        results["topology_probe_cache_hit"] = bool(m2 == m1)
+
+        # -- leg 2: two-level vs flat on an emulated 2-slice mesh ----
+        cfg = replace(
+            tiny(num_layers=1), dtype="float32", param_dtype="float32"
+        )
+        mc = MeshConfig(dp=dp, dcn_axes=("dp",), slices=2)
+        mesh = build_mesh(mc, devices=devs)
+        strategy = Strategy(
+            mesh=mc, dtype="float32", comm_overlap=True,
+            grad_bucket_mb=1,
+        )
+        plan = resolve_plan(cfg, strategy)
+        results["grad_sync_2level_dcn_bytes"] = [
+            plan.dcn_bytes_twolevel(), plan.dcn_bytes_flat()
+        ]
+        results["grad_sync_2level_wire_vs_flat"] = round(
+            plan.dcn_bytes_twolevel() / plan.dcn_bytes_flat(), 4
+        )
+        tx = optax.adamw(1e-2)
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+        b = shard_batch({"x": x, "y": x}, mesh)
+
+        def run(comm_overlap: bool, slices: int) -> float:
+            state, _ = init_sharded_state(
+                jax.random.PRNGKey(0), cfg, mesh, tx
+            )
+            step = build_train_step(
+                cfg, mesh, tx, donate=False,
+                comm_overlap=comm_overlap, grad_bucket_mb=1,
+                grad_slices=slices,
+            )
+            for _ in range(8):
+                state, metrics = step(state, b["x"], b["y"])
+            return float(metrics["loss"])
+
+        loss_gspmd = run(False, 1)
+        loss_2level = run(True, 2)
+        results["grad_sync_loss_gspmd"] = round(loss_gspmd, 6)
+        results["grad_sync_loss_2level"] = round(loss_2level, 6)
+        # fp32 bit-parity: same math, different schedule — any drift
+        # here is a reduction-order/correctness bug, not noise
+        results["grad_sync_2level_parity"] = bool(
+            loss_2level == loss_gspmd
+        )
+        ici_ms, dcn_ms = measure_sync_legs_ms(plan, mesh, iters=3)
+        results["grad_sync_ici_ms"] = round(ici_ms, 3)
+        results["grad_sync_dcn_ms"] = round(dcn_ms, 3)
+
+        # -- leg 3: dry-runner prices from the installed model -------
+        fp = topology.device_fingerprint(devs)
+
+        def exposed(dcn_gbps: float) -> float:
+            topology.set_link_model(
+                topology.LinkModel(
+                    ici_gbps=90.0, dcn_gbps=dcn_gbps,
+                    source="measured", fingerprint=fp,
+                ),
+                devices=devs,
+            )
+            r = DryRunReport(strategy=strategy, ok=True)
+            _comm_estimate(r, cfg, 8, 32, devs)
+            return r.comm_exposed_s
+
+        fast, slow = exposed(100.0), exposed(1.0)
+        results["dry_run_priced_from_link_model"] = bool(
+            slow > fast > 0
+        )
+        results["topology_note"] = (
+            f"{dp}-dev 2-slice emulated mesh: two-level sync crosses "
+            f"{results['grad_sync_2level_wire_vs_flat']:.0%} of the "
+            "flat ring's DCN bytes at fp32 bit parity; probe cached "
+            f"per fingerprint ({m1.fingerprint})"
+        )
+    finally:
+        # the installed test models must not leak into later legs
+        topology.reset_link_model()
+
+
 # tracer overhead gate (docs/observability.md): with tracing enabled the
 # measured step time may exceed the disabled baseline by at most this —
 # the span tracer's contract is "cheap enough to leave on in production"
@@ -1888,6 +2035,10 @@ def run_smoke() -> int:
     except Exception as e:
         results["grad_sync_error"] = repr(e)
     try:
+        run_topology_bench(jax, results, smoke=True)
+    except Exception as e:
+        results["topology_error"] = repr(e)
+    try:
         run_trace_bench(jax, results, smoke=True)
     except Exception as e:
         results["trace_error"] = repr(e)
@@ -1920,6 +2071,19 @@ def run_smoke() -> int:
         and results["grad_sync_loss_gap"] <= GRAD_SYNC_LOSS_GATE
         and results.get("grad_sync_wire_ratio") is not None
         and results["grad_sync_wire_ratio"] <= GRAD_SYNC_WIRE_GATE
+        # the topology gates: the probed LinkModel must be sane
+        # (ici >= dcn >= host) and warm-cached per fingerprint, the
+        # two-level schedule must move strictly fewer cross-slice
+        # bytes than the flat ring at fp32 bit parity, and the
+        # dry-runner's comm term must be priced from the installed
+        # model, not the legacy flat-ICI constant
+        and "topology_error" not in results
+        and results.get("link_ordering_ok") is True
+        and results.get("topology_probe_cache_hit") is True
+        and results.get("grad_sync_2level_wire_vs_flat") is not None
+        and results["grad_sync_2level_wire_vs_flat"] < 1.0
+        and results.get("grad_sync_2level_parity") is True
+        and results.get("dry_run_priced_from_link_model") is True
         # the telemetry gates: the dumped trace must be valid Chrome-
         # trace JSON whose step spans are explained by their phase
         # children, and tracing must stay cheap enough to leave on
@@ -2081,6 +2245,11 @@ def main() -> int:
     except Exception as e:
         results["grad_sync_ms"] = None
         results["grad_sync_error"] = repr(e)
+    try:
+        run_topology_bench(jax, results)
+    except Exception as e:
+        results["grad_sync_2level_wire_vs_flat"] = None
+        results["topology_error"] = repr(e)
     try:
         run_trace_bench(jax, results)
     except Exception as e:

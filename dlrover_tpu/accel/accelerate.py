@@ -216,6 +216,7 @@ def auto_accelerate(
             comm_overlap=strategy.resolved_comm_overlap(),
             grad_compress=strategy.resolved_grad_compress(),
             grad_bucket_mb=strategy.grad_bucket_mb,
+            grad_slices=strategy.mesh.dp_slices(),
         )
     return AccelerateResult(
         strategy=strategy,

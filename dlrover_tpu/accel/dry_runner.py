@@ -31,10 +31,13 @@ from dlrover_tpu.models.config import TransformerConfig
 # (459 Tflop/s bf16, 2.8 TB/s HBM).
 _SEC_PER_FLOP = 1 / 459e12
 _SEC_PER_BYTE = 1 / 2.8e12
-# interconnect seconds per wire byte for the gradient-sync comm term
-# (v5p ICI ~90 GB/s effective per chip) — the cost XLA's per-device
-# flop/byte analysis is blind to, and the term comm_overlap /
-# grad_compress exist to shrink
+# LEGACY interconnect constant (v5p ICI ~90 GB/s effective per chip),
+# kept only as the documented fallback the measured model reproduces:
+# topology.FALLBACK_ICI_GBPS == 90 makes fallback pricing identical to
+# the historical flat-ICI model. The comm term itself now routes every
+# wire byte through ``parallel.topology.get_link_model()`` — per-link
+# ICI/DCN rates, two-level legs for hybrid dp axes — and logs once
+# (``note_fallback_use``) when no probe cache exists for this backend.
 _SEC_PER_ICI_BYTE = 1 / 9e10
 
 
@@ -165,6 +168,7 @@ def _build(
             comm_overlap=strategy.comm_overlap,
             grad_compress=strategy.grad_compress,
             grad_bucket_mb=strategy.grad_bucket_mb,
+            grad_slices=strategy.mesh.dp_slices(),
         )
 
         def init_fn(key):
@@ -252,12 +256,18 @@ def _comm_estimate(
     (pure-DP mesh + comm_overlap/grad_compress) syncs ONCE per
     optimizer step and hides OVERLAP_HIDDEN_FRACTION of the wire time
     behind backward compute; the GSPMD default path syncs every
-    microbatch at full precision with no overlap credit."""
+    microbatch at full precision with no overlap credit. Wire seconds
+    are priced per link from ``topology.get_link_model()`` — a hybrid
+    dp axis bills its ICI and DCN legs at their own measured rates, a
+    data axis listed whole in ``dcn_axes`` bills the flat ring at DCN
+    rate, and fsdp/tp meshes stop inheriting the flat-ICI constant
+    silently (the fallback model reproduces it, logged once)."""
     from dlrover_tpu.accel.profiler import profile_model
     from dlrover_tpu.parallel.grad_sync import (
         OVERLAP_HIDDEN_FRACTION,
         _qualifying_dp,
         comm_bytes_per_device,
+        comm_time_per_device_s,
     )
 
     s = report.strategy
@@ -272,10 +282,13 @@ def _comm_estimate(
     explicit = bool(
         _qualifying_dp(m.axis_sizes())
     ) and s.resolved_comm_overlap()
-    one_sync = comm_bytes_per_device(
-        param_bytes, s, grad_itemsize=p_bytes
-    )
     if explicit:
+        one_sync = comm_bytes_per_device(
+            param_bytes, s, grad_itemsize=p_bytes
+        )
+        one_sync_s = comm_time_per_device_s(
+            param_bytes, s, grad_itemsize=p_bytes
+        )
         syncs = 1
         exposed_frac = 1.0 - OVERLAP_HIDDEN_FRACTION
     else:
@@ -286,12 +299,13 @@ def _comm_estimate(
         one_sync = comm_bytes_per_device(
             param_bytes, s, grad_itemsize=p_bytes, compress="none"
         )
+        one_sync_s = comm_time_per_device_s(
+            param_bytes, s, grad_itemsize=p_bytes, compress="none"
+        )
         syncs = max(s.grad_accum, 1)
         exposed_frac = 1.0
     report.comm_bytes_per_device = one_sync * syncs
-    report.comm_exposed_s = (
-        report.comm_bytes_per_device * exposed_frac * _SEC_PER_ICI_BYTE
-    )
+    report.comm_exposed_s = one_sync_s * syncs * exposed_frac
 
 
 def _finalize_estimate(

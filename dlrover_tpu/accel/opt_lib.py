@@ -130,6 +130,17 @@ register_optimization(
     ),
     tunable=True,
 )
+# link-aware bucket sizing: grad_bucket_mb=0 means each bucket targets
+# ~topology.BUCKET_TARGET_COMM_MS of wire time on the link it actually
+# crosses (measured LinkModel; the DCN leg for multi-slice meshes)
+# instead of one global MiB knob; implies the explicit sync path
+register_optimization(
+    "auto_bucket",
+    lambda cfg, s: (
+        cfg,
+        dc_replace(s, comm_overlap=True, grad_bucket_mb=0),
+    ),
+)
 register_optimization(
     "1f1b", lambda cfg, s: (cfg, dc_replace(s, pp_schedule="1f1b"))
 )

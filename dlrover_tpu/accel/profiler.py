@@ -76,10 +76,20 @@ class PipelineStats:
     # standalone wall time of one bucketed sync (its roofline: the
     # in-step cost is this minus whatever the scheduler overlaps)
     grad_sync_ms: float = 0.0
+    # per-link split of the standalone sync (grad_sync.measure_sync_
+    # legs_ms): slice-local ICI legs vs the cross-slice DCN all-reduce;
+    # flat (single-slice) plans are all-ICI by construction
+    grad_sync_ici_ms: float = 0.0
+    grad_sync_dcn_ms: float = 0.0
     # fraction of sync wire time hidden behind backward compute; the
     # analytic model constant on backends where overlap cannot be
     # profiled (None until a grad-sync plan is active)
     comm_overlap_pct: Optional[float] = None
+    # the A/B-measured twin of comm_overlap_pct (grad_sync.measured_
+    # overlap_pct: step time with the sync vs without, normalized by
+    # the standalone roofline); None until someone ran the A/B —
+    # ElasticTrainer.measure_realized_overlap or the topology bench
+    overlap_pct_measured: Optional[float] = None
     # wire bytes one sync moves vs what the uncompressed monolithic
     # sync would move (per optimizer step, per device ring traffic
     # aside — the ratio is the compression win)
@@ -133,7 +143,10 @@ class PipelineStats:
             "resize_count": self.resize_count,
             "resize_downtime_ms": round(self.resize_downtime_ms, 2),
             "grad_sync_ms": round(self.grad_sync_ms, 3),
+            "grad_sync_ici_ms": round(self.grad_sync_ici_ms, 3),
+            "grad_sync_dcn_ms": round(self.grad_sync_dcn_ms, 3),
             "comm_overlap_pct": self.comm_overlap_pct,
+            "overlap_pct_measured": self.overlap_pct_measured,
             "grad_bytes_wire": self.grad_bytes_wire,
             "grad_bytes_raw": self.grad_bytes_raw,
             "grad_bytes_wire_vs_raw": self.grad_bytes_wire_vs_raw,
@@ -152,11 +165,22 @@ class PipelineStats:
             if self.resize_count
             else ""
         )
+        legs = (
+            f" [{self.grad_sync_ici_ms:.1f} ici / "
+            f"{self.grad_sync_dcn_ms:.1f} dcn]"
+            if self.grad_sync_dcn_ms
+            else ""
+        )
+        measured = (
+            f", {self.overlap_pct_measured}% measured"
+            if self.overlap_pct_measured is not None
+            else ""
+        )
         gsync = (
-            f", grad sync {self.grad_sync_ms:.1f} ms standalone "
+            f", grad sync {self.grad_sync_ms:.1f} ms standalone{legs} "
             f"({'-' if self.comm_overlap_pct is None else self.comm_overlap_pct}"
-            f"% overlapped, {self.grad_bytes_wire >> 10} KiB wire vs "
-            f"{self.grad_bytes_raw >> 10} KiB raw per sync)"
+            f"% overlapped{measured}, {self.grad_bytes_wire >> 10} KiB "
+            f"wire vs {self.grad_bytes_raw >> 10} KiB raw per sync)"
             if self.grad_bytes_raw
             else ""
         )

@@ -46,7 +46,9 @@ class Strategy:
     # per-bucket shared scales, int32 accumulation and error feedback
     # (implies the explicit sync path)
     grad_compress: str = "none"
-    # target bucket size for the sync scheduler, MiB
+    # target bucket size for the sync scheduler, MiB; 0 = auto-size
+    # per link from the measured topology.LinkModel (the DCN leg on
+    # multi-slice meshes, the ICI ring otherwise)
     grad_bucket_mb: int = 4
     # named optimization-library entries applied to this strategy
     # (accel/opt_lib.py re-derives the config from these on every host)
@@ -98,6 +100,10 @@ class Strategy:
             a: s for a, s in self.mesh.axis_sizes().items() if s > 1
         } or {"dp": 1}
         bits = ["x".join(f"{a}{s}" for a, s in axes.items())]
+        if self.mesh.dp_slices() > 1:
+            # hybrid dp axis: grad sync runs the two-level ICI/DCN
+            # schedule over this many DCN slices
+            bits.append(f"{self.mesh.dp_slices()}slice")
         if self.num_microbatches > 1:
             bits.append(f"mb{self.num_microbatches}")
         if self.grad_accum > 1:

@@ -194,7 +194,10 @@ def init_sharded_state(
     return TrainState(step=step, params=params, opt_state=opt_state), sh
 
 
-def _grad_sync_plan(cfg, mesh, grad_compress: str, grad_bucket_mb: int):
+def _grad_sync_plan(
+    cfg, mesh, grad_compress: str, grad_bucket_mb: int,
+    grad_slices: int = 1,
+):
     """BucketPlan for the explicit sync path, or None when this mesh
     keeps GSPMD's native schedule — the gate lives in ONE place
     (``grad_sync.plan_for_mesh``, shared with the Strategy-level
@@ -209,6 +212,7 @@ def _grad_sync_plan(cfg, mesh, grad_compress: str, grad_bucket_mb: int):
         cfg, mesh,
         grad_compress=grad_compress,
         grad_bucket_mb=grad_bucket_mb,
+        slices=grad_slices,
     )
     if plan is None:
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -233,6 +237,7 @@ def build_train_step(
     comm_overlap: bool = False,
     grad_compress: str = "none",
     grad_bucket_mb: int = 4,
+    grad_slices: int = 1,
 ) -> Callable:
     """jitted (state, tokens, targets) → (state, metrics).
 
@@ -281,8 +286,14 @@ def build_train_step(
             ).opt_state
         )
 
+    # grad_slices: DCN slice count of a hybrid dp axis
+    # (MeshConfig.dp_slices() — the concrete Mesh cannot carry it);
+    # > 1 plans the two-level ICI/DCN sync schedule
     plan = (
-        _grad_sync_plan(cfg, mesh, grad_compress, grad_bucket_mb)
+        _grad_sync_plan(
+            cfg, mesh, grad_compress, grad_bucket_mb,
+            grad_slices=grad_slices,
+        )
         if (comm_overlap or grad_compress == "int8")
         else None
     )

@@ -28,6 +28,21 @@ module replaces it with an explicit, schedulable sync layer:
   biasing the trajectory. Convergence parity is gated in tests and
   ``bench.py --smoke``.
 
+- **Two-level sync for multi-slice meshes** (``BucketPlan.slices >
+  1``): when the dp axis spans DCN-connected slices (``MeshConfig
+  .dp_slices()``), each bucket syncs hierarchically — a slice-local
+  reduce-scatter over ICI, a cross-slice all-reduce of only the
+  slice-accumulated *shards* over DCN, then a slice-local all-gather.
+  Cross-slice traffic drops (``dcn_bytes_twolevel < dcn_bytes_flat``)
+  and — the bigger win — spreads over ``per_slice_degree`` parallel
+  stripe rings instead of funneling through the flat ring's few
+  boundary edges, so the hottest DCN path carries ``1/per_slice_
+  degree`` of the bytes. The int8 path quantizes exactly that leg
+  (the link where bytes are scarcest), carrying error
+  feedback on the shard. Bucket sizes come per link from the measured
+  ``parallel/topology.LinkModel`` when ``grad_bucket_mb`` is 0
+  ("auto") instead of one global target.
+
 Scope: the explicit path engages on pure-DP meshes (``dp > 1`` and
 every other axis 1). fsdp/tp/sp meshes keep GSPMD's native schedule —
 their collectives are entangled with the sharded matmuls themselves
@@ -77,10 +92,34 @@ class BucketPlan:
     leaf_dtypes: Tuple[str, ...]
     dp: int
     compress: str  # "none" | "int8"
+    # DCN slices the dp axis spans (MeshConfig.dp_slices()); > 1
+    # switches sync_grads to the two-level schedule: slice-local
+    # reduce-scatter over ICI, cross-slice all-reduce of the
+    # slice-accumulated shards over DCN, slice-local all-gather
+    slices: int = 1
 
     @property
     def num_buckets(self) -> int:
         return len(self.buckets)
+
+    @property
+    def two_level(self) -> bool:
+        return self.slices > 1
+
+    @property
+    def dp_ici(self) -> int:
+        """Per-slice dp degree (the ICI factor of the dp axis)."""
+        return self.dp // self.slices
+
+    def shard_elems(self, bucket: Bucket) -> int:
+        """Per-device length of what this bucket's error-feedback
+        residual covers: the slice-local shard for two-level (int8
+        quantizes the DCN leg), the full padded vector for flat."""
+        return (
+            bucket.padded // self.dp_ici
+            if self.two_level
+            else bucket.padded
+        )
 
     @property
     def raw_bytes(self) -> int:
@@ -92,17 +131,67 @@ class BucketPlan:
     def wire_bytes(self) -> int:
         """Wire bytes of one sync on THIS plan's path."""
         if self.compress == "int8":
+            if self.two_level:
+                # only the DCN shard is quantized; the ICI legs stay
+                # fp32 (padded x 4 for RS + gather is the flat cost)
+                return sum(
+                    b.padded * 4
+                    + b.padded // self.dp_ici * _INT8_BYTES
+                    + _SCALE_BYTES
+                    for b in self.buckets
+                )
             return sum(
                 b.padded * _INT8_BYTES + _SCALE_BYTES
                 for b in self.buckets
             )
         return self.raw_bytes
 
+    # -- cross-slice (DCN) accounting: totals over all devices/sync ----
+    def dcn_bytes_flat(self) -> int:
+        """Cross-slice bytes the FLAT schedule moves per sync: a ring
+        reduce-scatter + all-gather over dp devices laid out as
+        ``slices`` contiguous blocks crosses a slice boundary on
+        ``slices`` of its dp edges, each of 2(dp-1) rounds carrying
+        padded/dp fp32 elements per edge."""
+        if not self.two_level:
+            return 0
+        return sum(
+            int(2 * (self.dp - 1) * self.slices * b.padded * 4 / self.dp)
+            for b in self.buckets
+        )
+
+    def dcn_bytes_twolevel(self) -> int:
+        """Cross-slice bytes the two-level schedule moves per sync:
+        every device all-reduces only its slice-local shard across
+        slices (ring factor 2(S-1)/S), int8-compressed when the plan
+        compresses."""
+        if not self.two_level:
+            return 0
+        S = self.slices
+        per_elem = (
+            _INT8_BYTES if self.compress == "int8" else 4
+        )
+        total = 0
+        for b in self.buckets:
+            shard = b.padded // self.dp_ici
+            per_dev = 2.0 * (S - 1) / S * shard * per_elem
+            if self.compress == "int8":
+                per_dev += _SCALE_BYTES
+            total += int(per_dev * self.dp)
+        return total
+
     def describe(self) -> str:
+        lvl = (
+            f", two-level over {self.slices} slices "
+            f"(dcn {self.dcn_bytes_twolevel() >> 20} MiB vs flat "
+            f"{self.dcn_bytes_flat() >> 20} MiB/sync)"
+            if self.two_level
+            else ""
+        )
         return (
             f"{self.num_buckets} buckets over {self.dp}-way dp, "
             f"{self.raw_bytes >> 20} MiB raw -> "
-            f"{self.wire_bytes >> 20} MiB wire ({self.compress})"
+            f"{self.wire_bytes >> 20} MiB wire ({self.compress}){lvl}"
         )
 
 
@@ -111,6 +200,7 @@ def plan_buckets(
     dp: int,
     bucket_bytes: int = 4 << 20,
     compress: str = "none",
+    slices: int = 1,
 ) -> BucketPlan:
     """Greedy size-targeted partition of the grad tree (leaf order =
     tree flatten order, which matches the order backward produces
@@ -131,6 +221,10 @@ def plan_buckets(
         )
     if dp < 1:
         raise ValueError(f"dp must be >= 1, got {dp}")
+    if slices < 1 or dp % slices:
+        raise ValueError(
+            f"slices={slices} must divide dp={dp} (and be >= 1)"
+        )
     leaves = jax.tree_util.tree_leaves(shapes_tree)
     shapes = tuple(tuple(int(d) for d in l.shape) for l in leaves)
     dtypes = tuple(str(np.dtype(l.dtype)) for l in leaves)
@@ -174,6 +268,7 @@ def plan_buckets(
         leaf_dtypes=dtypes,
         dp=dp,
         compress=compress,
+        slices=slices,
     )
 
 
@@ -189,9 +284,43 @@ def _qualifying_dp(axis_sizes: dict) -> int:
     return dp if dp > 1 and others == 1 else 0
 
 
+def resolve_bucket_bytes(
+    grad_bucket_mb: int,
+    dp: int = 1,
+    slices: int = 1,
+    compress: str = "none",
+    link_model=None,
+) -> int:
+    """Bucket-size target in bytes. ``grad_bucket_mb > 0`` is the
+    explicit global knob (historical behavior). ``0`` means **auto**:
+    size each bucket so its wire time on the link it actually crosses
+    is ~``topology.BUCKET_TARGET_COMM_MS`` — the DCN leg for two-level
+    plans (a bucket's cross-slice payload is ``1/dp_ici`` of its
+    elements, ``1/4`` again under int8, so the full-bucket target
+    scales back up by those factors), the ICI ring otherwise."""
+    if grad_bucket_mb > 0:
+        return grad_bucket_mb << 20
+    from dlrover_tpu.parallel import topology
+
+    model = link_model or topology.get_link_model()
+    topology.note_fallback_use(model)
+    if slices > 1:
+        dcn_payload = topology.bucket_bytes_for(model, "dcn")
+        scale = dp // slices
+        if compress == "int8":
+            scale *= 4  # the DCN shard ships int8, the target is fp32
+        b = dcn_payload * scale
+    else:
+        b = topology.bucket_bytes_for(model, "ici")
+    return max(
+        topology._BUCKET_MIN_BYTES,
+        min(topology._BUCKET_MAX_BYTES, int(b)),
+    )
+
+
 def _plan_for_cfg(
     cfg, dp: int, grad_compress: str, grad_bucket_mb: int,
-    params_shape=None,
+    params_shape=None, slices: int = 1,
 ) -> BucketPlan:
     if params_shape is None:
         import jax
@@ -204,8 +333,11 @@ def _plan_for_cfg(
     return plan_buckets(
         params_shape,
         dp=dp,
-        bucket_bytes=max(1, grad_bucket_mb) << 20,
+        bucket_bytes=resolve_bucket_bytes(
+            grad_bucket_mb, dp=dp, slices=slices, compress=grad_compress
+        ),
         compress=grad_compress,
+        slices=slices,
     )
 
 
@@ -215,16 +347,25 @@ def plan_for_mesh(
     grad_compress: str = "none",
     grad_bucket_mb: int = 4,
     params_shape: Optional[Any] = None,
+    slices: int = 1,
 ) -> Optional[BucketPlan]:
     """Gate + plan from a concrete ``jax.sharding.Mesh`` (the step
     builder's view — same gate and bucket construction as
-    ``resolve_plan``, which works from a Strategy's MeshConfig)."""
+    ``resolve_plan``, which works from a Strategy's MeshConfig).
+    ``slices``: DCN slice count of the dp axis (a concrete Mesh does
+    not carry the MeshConfig's hybrid factorization, so the step
+    builder threads it — ``MeshConfig.dp_slices()`` upstream)."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     dp = _qualifying_dp(sizes)
     if not dp:
         return None
+    if slices > 1 and dp % slices:
+        raise ValueError(
+            f"slices={slices} does not divide dp={dp}"
+        )
     return _plan_for_cfg(
-        cfg, dp, grad_compress, grad_bucket_mb, params_shape
+        cfg, dp, grad_compress, grad_bucket_mb, params_shape,
+        slices=slices if 1 < slices < dp else 1,
     )
 
 
@@ -240,7 +381,8 @@ def resolve_plan(
     requires the explicit path) is requested AND the mesh is pure DP.
     Model-sharded meshes fall back silently — candidate search stamps
     the opt names onto every candidate, and an fsdp candidate must
-    still build.
+    still build. A hybrid dp axis (``MeshConfig.dp_slices() > 1``)
+    plans the two-level ICI/DCN schedule.
     """
     if not strategy.resolved_comm_overlap():
         return None
@@ -253,6 +395,7 @@ def resolve_plan(
         strategy.resolved_grad_compress(),
         strategy.grad_bucket_mb,
         params_shape,
+        slices=strategy.mesh.dp_slices(),
     )
 
 
@@ -293,7 +436,87 @@ def _unflatten_bucket(flat, bucket: Bucket, plan: BucketPlan):
     return out
 
 
-def _sync_one_bucket(flat, residual, dp: int, compress: str):
+def _slice_groups(dp: int, slices: int) -> Tuple[list, list]:
+    """(ici_groups, dcn_groups) of dp ranks laid out slice-major
+    (mesh.py's hybrid dp axis: rank = slice * per + j). ICI groups are
+    the ``slices`` contiguous runs of ``per`` ranks; DCN groups are the
+    ``per`` stripes of same-intra-slice-rank devices across slices."""
+    per = dp // slices
+    ici = [
+        [s * per + j for j in range(per)] for s in range(slices)
+    ]
+    dcn = [
+        [s * per + j for s in range(slices)] for j in range(per)
+    ]
+    return ici, dcn
+
+
+def _sync_one_bucket_2level(
+    flat, residual, plan: "BucketPlan", legs: str = "all"
+):
+    """Two-level per-device bucket body for a hybrid dp axis
+    (``plan.slices`` DCN-connected slices of ``plan.dp_ici`` ICI-local
+    devices each): slice-local reduce-scatter over ICI, cross-slice
+    all-reduce of only the slice-accumulated *shard* over DCN, then a
+    slice-local all-gather. Every device ships ``padded/dp_ici``
+    elements across slices instead of the full bucket riding the ring
+    through every slice boundary — the DCN leg (where bytes are
+    scarcest) shrinks by the per-slice degree, and the int8 path
+    quantizes exactly that leg, carrying error feedback on the shard.
+
+    ``legs="ici"`` skips the cross-slice all-reduce (the per-link
+    timing probe subtracts this from the full sync to attribute wall
+    time to the DCN leg); the result is then only the slice-local mean
+    and the residual rides through unchanged.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dp, S = plan.dp, plan.slices
+    ici_groups, dcn_groups = _slice_groups(dp, S)
+    # level 1 (ICI): reduce-scatter within the slice — each device ends
+    # holding the slice-LOCAL sum of its shard
+    shard = jax.lax.psum_scatter(
+        flat, "dp", scatter_dimension=0, tiled=True,
+        axis_index_groups=ici_groups,
+    )
+    new_residual = residual
+    if legs == "ici":
+        total = shard
+    elif plan.compress == "int8":
+        x = shard + residual if residual is not None else shard
+        # ONE shared scale across the whole dp axis (pmax over "dp"):
+        # every DCN group must quantize at the same step for the int32
+        # sum to be meaningful, and a single bucket-wide scale keeps
+        # the wire cost at one fp32 regardless of group count
+        scale = jax.lax.pmax(jnp.max(jnp.abs(x)), "dp") / 127.0
+        scale = jnp.maximum(scale, jnp.float32(1e-20))
+        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        # error feedback on the SHARD (what the DCN leg quantized) —
+        # the ICI legs stay exact fp32 and contribute no error
+        new_residual = x - q.astype(jnp.float32) * scale
+        # level 2 (DCN): int32 sum of S slice shards — S * 127 << 2^31
+        summed = jax.lax.psum(
+            q.astype(jnp.int32), "dp", axis_index_groups=dcn_groups
+        )
+        total = summed.astype(jnp.float32) * scale
+    else:
+        # level 2 (DCN): fp32 all-reduce of the slice-accumulated shard
+        total = jax.lax.psum(
+            shard, "dp", axis_index_groups=dcn_groups
+        )
+    # level 3 (ICI): gather the globally-summed shards back to a full
+    # replicated bucket within each slice
+    full = jax.lax.all_gather(
+        total, "dp", tiled=True, axis_index_groups=ici_groups
+    )
+    mean = full / dp
+    return mean, new_residual, jnp.sum(mean * mean)
+
+
+def _sync_one_bucket(
+    flat, residual, plan: "BucketPlan", legs: str = "all"
+):
     """Per-device body for one bucket (inside ``shard_map``, manual
     over dp): returns (mean-reduced replicated vector, new residual,
     sum of squares of the synced vector).
@@ -301,11 +524,16 @@ def _sync_one_bucket(flat, residual, dp: int, compress: str):
     The collective is the bandwidth-optimal reduce-scatter +
     all-gather decomposition of the all-reduce: two phases XLA can
     pipeline independently across buckets, and the exact collective
-    pair an fsdp extension would keep (dropping the gather).
+    pair an fsdp extension would keep (dropping the gather). Plans
+    whose dp axis spans DCN slices route to the hierarchical schedule
+    (``_sync_one_bucket_2level``).
     """
     import jax
     import jax.numpy as jnp
 
+    if plan.two_level:
+        return _sync_one_bucket_2level(flat, residual, plan, legs=legs)
+    dp, compress = plan.dp, plan.compress
     if compress == "int8":
         x = flat + residual if residual is not None else flat
         # shared scale: every device must quantize at the same step or
@@ -338,6 +566,7 @@ def sync_grads(
     mesh,
     plan: BucketPlan,
     residual: Optional[Tuple] = None,
+    _legs: str = "all",
 ):
     """Bucketed sync of per-device local grads → (synced grad tree,
     new residual tuple or None, global grad norm).
@@ -373,7 +602,7 @@ def sync_grads(
             flat = _bucket_flat(local, b, plan.dp)
             r = res_in[b.index][0] if ef else None
             mean, nr, ss = _sync_one_bucket(
-                flat, r, plan.dp, plan.compress
+                flat, r, plan, legs=_legs
             )
             sumsq = sumsq + ss
             out_parts.extend(_unflatten_bucket(mean, b, plan))
@@ -405,15 +634,17 @@ def sync_grads(
 
 
 def zero_residual(plan: BucketPlan, mesh=None) -> Tuple:
-    """Fresh error-feedback state: one ``(dp, padded)`` fp32 zeros per
-    bucket, sharded over dp when a mesh is given (each device carries
-    only its own row)."""
+    """Fresh error-feedback state: one ``(dp, shard_elems)`` fp32
+    zeros per bucket (``shard_elems`` = the full padded vector on flat
+    plans, the slice-local DCN shard on two-level plans — EF covers
+    exactly what quantization touches), sharded over dp when a mesh is
+    given (each device carries only its own row)."""
     import jax
     import jax.numpy as jnp
 
     out = []
     for b in plan.buckets:
-        z = jnp.zeros((plan.dp, b.padded), jnp.float32)
+        z = jnp.zeros((plan.dp, plan.shard_elems(b)), jnp.float32)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -434,7 +665,7 @@ def residual_spec(plan: BucketPlan, mesh) -> Tuple:
     sh = NamedSharding(mesh, P(("dp",)))
     return tuple(
         jax.ShapeDtypeStruct(
-            (plan.dp, b.padded), jnp.float32, sharding=sh
+            (plan.dp, plan.shard_elems(b)), jnp.float32, sharding=sh
         )
         for b in plan.buckets
     )
@@ -497,13 +728,141 @@ def comm_bytes_per_device(
     return ring * payload
 
 
+def comm_time_per_device_s(
+    n_param_bytes: float,
+    strategy,
+    link_model=None,
+    grad_itemsize: int = 4,
+    compress: Optional[str] = None,
+) -> float:
+    """Seconds of gradient-sync wire time per device per sync, priced
+    per link from the measured ``topology.LinkModel`` instead of one
+    flat ICI constant:
+
+    - hybrid dp axis (``dp_slices() > 1``, explicit two-level path):
+      the slice-local RS + AG legs ride ICI at the ring factor over
+      the per-slice degree, and only the ``1/dp_ici`` shard crosses
+      DCN (int8-compressed when the plan compresses);
+    - a data axis listed whole in ``dcn_axes``: the flat ring rides
+      DCN end to end (the honest worst case the two-level schedule
+      exists to beat);
+    - otherwise: the flat ring at the measured ICI rate.
+
+    Per-collective latency (one ring's worth of hops) is added from
+    the model so tiny syncs don't price as free."""
+    from dlrover_tpu.parallel import topology
+
+    m = strategy.mesh
+    n = m.dp * m.fsdp
+    if n <= 1:
+        return 0.0
+    model = link_model or topology.get_link_model()
+    topology.note_fallback_use(model)
+    if compress is None:
+        compress = strategy.resolved_grad_compress()
+    payload = float(n_param_bytes)
+    if compress == "int8":
+        c = _INT8_BYTES / float(grad_itemsize)
+    else:
+        c = 1.0
+    slices = m.dp_slices()
+    # same gate as the step builder: the two-level / compressed
+    # explicit schedule only runs when comm_overlap resolved on AND
+    # the mesh is pure DP — a comm_overlap=False hybrid mesh runs
+    # GSPMD's monolithic all-reduce and must be billed as the flat
+    # ring over DCN (the honest worst case), not the cheap two-level
+    # cost it never gets
+    explicit = bool(
+        _qualifying_dp(m.axis_sizes())
+    ) and strategy.resolved_comm_overlap()
+    if explicit and slices > 1:
+        per = m.dp // slices
+        # ICI legs stay full precision; only the DCN shard compresses
+        ici_s = (
+            2.0 * (per - 1) / per * payload * model.sec_per_ici_byte()
+            + 2 * per * model.ici_lat_s
+        )
+        dcn_s = (
+            2.0 * (slices - 1) / slices * (payload / per) * c
+            * model.sec_per_dcn_byte()
+            + 2 * slices * model.dcn_lat_s
+        )
+        return ici_s + dcn_s
+    ring = 2.0 * (n - 1) / n
+    crosses_dcn = any(a in m.dcn_axes for a in ("dp", "fsdp"))
+    sec_per_byte = (
+        model.sec_per_dcn_byte()
+        if crosses_dcn
+        else model.sec_per_ici_byte()
+    )
+    lat = model.dcn_lat_s if crosses_dcn else model.ici_lat_s
+    if explicit:
+        payload *= c  # flat explicit path compresses the whole ring
+    return ring * payload * sec_per_byte + 2 * n * lat
+
+
 def estimate_overlap_pct(strategy) -> Optional[float]:
     """Analytic hidden-fraction of sync wire time (documented model
-    constant — real measurement needs an accelerator profile; the CPU
-    smoke bench emits this estimate, labeled as such)."""
+    constant — ``measured_overlap_pct`` is the A/B-measured twin; the
+    CPU smoke bench emits both, labeled)."""
     if not strategy.resolved_comm_overlap():
         return None
     return round(100.0 * OVERLAP_HIDDEN_FRACTION, 2)
+
+
+def measured_overlap_pct(
+    standalone_sync_ms: Optional[float],
+    step_ms_with_sync: float,
+    step_ms_without_sync: float,
+) -> Optional[float]:
+    """Realized hidden fraction of the sync's wire time, from measured
+    step times: ``exposed = step_with_sync - step_without_sync`` (the
+    wall time the sync actually added to the step, clamped to [0,
+    standalone]) against the standalone roofline. 100% means the
+    scheduler hid the whole sync behind compute; 0% means it ran fully
+    serialized (the monolithic-GSPMD failure mode). None when there is
+    no standalone measurement to normalize by."""
+    if standalone_sync_ms is None or standalone_sync_ms <= 0:
+        return None
+    exposed = min(
+        max(step_ms_with_sync - step_ms_without_sync, 0.0),
+        standalone_sync_ms,
+    )
+    return round(100.0 * (1.0 - exposed / standalone_sync_ms), 2)
+
+
+def _measure_sync(
+    plan: BucketPlan, mesh, iters: int, legs: str
+) -> float:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P(("dp",)))
+    stacked = [
+        jax.device_put(
+            jnp.zeros((plan.dp,) + shape, jnp.dtype(dt)), sh
+        )
+        for shape, dt in zip(plan.leaf_shapes, plan.leaf_dtypes)
+    ]
+    res = (
+        zero_residual(plan, mesh) if plan.compress == "int8" else None
+    )
+
+    def run(tree, r):
+        g, _, gn = sync_grads(tree, mesh, plan, residual=r, _legs=legs)
+        return gn
+
+    fn = jax.jit(run)
+    jax.block_until_ready(fn(stacked, res))  # compile + warmup
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(stacked, res))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times) * 1e3)
 
 
 def measure_sync_ms(
@@ -513,35 +872,29 @@ def measure_sync_ms(
     (median of ``iters`` after compile) — the ``grad_sync_ms`` stat.
     Standalone isolation OVERSTATES the in-step cost by exactly the
     overlap the scheduler wins back; read it as the sync's roofline."""
-    import time
-
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     from dlrover_tpu.obs.trace import span
 
     with span("grad_sync_probe", buckets=plan.num_buckets):
-        sh = NamedSharding(mesh, P(("dp",)))
-        stacked = [
-            jax.device_put(
-                jnp.zeros((plan.dp,) + shape, jnp.dtype(dt)), sh
-            )
-            for shape, dt in zip(plan.leaf_shapes, plan.leaf_dtypes)
-        ]
-        res = (
-            zero_residual(plan, mesh) if plan.compress == "int8" else None
-        )
+        return _measure_sync(plan, mesh, iters, "all")
 
-        def run(tree, r):
-            g, _, gn = sync_grads(tree, mesh, plan, residual=r)
-            return gn
 
-        fn = jax.jit(run)
-        jax.block_until_ready(fn(stacked, res))  # compile + warmup
-        times = []
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            jax.block_until_ready(fn(stacked, res))
-            times.append(time.perf_counter() - t0)
-        return float(np.median(times) * 1e3)
+def measure_sync_legs_ms(
+    plan: BucketPlan, mesh, iters: int = 5
+) -> Tuple[float, float]:
+    """(ici_ms, dcn_ms) standalone wall time attributed per link class:
+    the full sync minus an ICI-legs-only run (slice-local RS + AG with
+    the cross-slice all-reduce elided) isolates the DCN leg's cost.
+    Flat plans are all-ICI by construction. Each probe is recorded as
+    a trace span (``grad_sync_ici`` / ``grad_sync_dcn``,
+    docs/observability.md)."""
+    from dlrover_tpu.obs.trace import span
+
+    if not plan.two_level:
+        with span("grad_sync_ici", buckets=plan.num_buckets):
+            ici = _measure_sync(plan, mesh, iters, "all")
+        return ici, 0.0
+    with span("grad_sync_ici", buckets=plan.num_buckets):
+        ici = _measure_sync(plan, mesh, iters, "ici")
+    with span("grad_sync_dcn", slices=plan.slices):
+        total = _measure_sync(plan, mesh, iters, "all")
+    return ici, max(0.0, total - ici)

@@ -42,6 +42,14 @@ class MeshConfig:
     # axes whose communication crosses slices/hosts over DCN; they are laid
     # out outermost so ICI keeps the bandwidth-hungry collectives
     dcn_axes: Tuple[str, ...] = ()
+    # number of DCN-connected slices the dp axis spans (requires "dp" in
+    # dcn_axes and slices | dp). 1 keeps the historical semantics (an
+    # axis in dcn_axes is *entirely* DCN); 1 < slices < dp makes dp a
+    # HYBRID axis — dp factors as [slices (DCN, outermost), dp/slices
+    # (ICI)], so each run of dp/slices consecutive dp coordinates is one
+    # ICI-adjacent slice. grad_sync's two-level sync and the topology
+    # cost model key off this factorization (dp_slices()).
+    slices: int = 1
 
     @property
     def num_devices(self) -> int:
@@ -49,6 +57,19 @@ class MeshConfig:
 
     def axis_sizes(self) -> Dict[str, int]:
         return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def dp_slices(self) -> int:
+        """The dp axis's DCN slice count when it is a valid hybrid axis
+        (dp = slices x per-slice-ICI-degree), else 1. slices == dp means
+        every dp rank is its own slice — that is the whole-axis-DCN case
+        with no ICI level, so it reports 1 (no two-level structure)."""
+        if (
+            "dp" in self.dcn_axes
+            and 1 < self.slices < self.dp
+            and self.dp % self.slices == 0
+        ):
+            return self.slices
+        return 1
 
     @staticmethod
     def from_dict(d: Dict[str, int]) -> "MeshConfig":
@@ -77,15 +98,28 @@ def build_mesh(
             f"mesh {dict(zip(axis_names, sizes))} needs {n} devices, "
             f"have {len(devices)}"
         )
+    if config.slices > 1 and (
+        "dp" not in config.dcn_axes or config.dp % config.slices
+    ):
+        raise ValueError(
+            f"slices={config.slices} needs 'dp' in dcn_axes "
+            f"({config.dcn_axes}) and slices | dp (dp={config.dp})"
+        )
     if config.dcn_axes:
-        dcn_sizes = tuple(
-            getattr(config, a) if a in config.dcn_axes else 1
-            for a in AXIS_ORDER
-        )
-        ici_sizes = tuple(
-            1 if a in config.dcn_axes else getattr(config, a)
-            for a in AXIS_ORDER
-        )
+        # per-axis (dcn_factor, ici_factor): an axis in dcn_axes is
+        # entirely DCN, EXCEPT dp with slices>1, which is hybrid —
+        # slices (DCN) x dp/slices (ICI), DCN factor outermost
+        factors = {}
+        for a in AXIS_ORDER:
+            size = getattr(config, a)
+            if a == "dp" and config.slices > 1:
+                factors[a] = (config.slices, size // config.slices)
+            elif a in config.dcn_axes:
+                factors[a] = (size, 1)
+            else:
+                factors[a] = (1, size)
+        dcn_sizes = tuple(factors[a][0] for a in AXIS_ORDER)
+        ici_sizes = tuple(factors[a][1] for a in AXIS_ORDER)
         has_slice_meta = (
             getattr(list(devices)[0], "slice_index", None) is not None
         )
@@ -100,18 +134,22 @@ def build_mesh(
             )
         else:
             # CPU/virtual devices carry no slice metadata (slice_index);
-            # emulate the hybrid layout — DCN axes get the LARGEST
-            # strides (outermost), so consecutive devices ("one slice")
-            # stay adjacent on the ICI axes, which is the property the
-            # hybrid mesh exists to provide
-            order = [a for a in AXIS_ORDER if a in config.dcn_axes] + [
-                a for a in AXIS_ORDER if a not in config.dcn_axes
-            ]
+            # emulate the hybrid layout — every DCN factor gets a LARGER
+            # stride than every ICI factor (DCN outermost), so
+            # consecutive devices ("one slice") stay adjacent on the ICI
+            # factors, which is the property the hybrid mesh exists to
+            # provide. Each final axis is its (dcn, ici) factor pair
+            # collapsed dcn-major, so a hybrid dp axis enumerates
+            # slice-major: coordinate d = slice * (dp/slices) + rank.
             arr = np.asarray(list(devices)).reshape(
-                [getattr(config, a) for a in order]
+                list(dcn_sizes) + list(ici_sizes)
             )
-            dev_array = arr.transpose(
-                [order.index(a) for a in AXIS_ORDER]
+            n_ax = len(AXIS_ORDER)
+            perm = []
+            for i in range(n_ax):
+                perm.extend([i, n_ax + i])
+            dev_array = arr.transpose(perm).reshape(
+                [d * i for d, i in zip(dcn_sizes, ici_sizes)]
             )
     else:
         try:

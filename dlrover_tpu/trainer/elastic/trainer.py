@@ -102,7 +102,9 @@ class TrainerConfig:
     # "none" | "int8": int8 collective payloads with error feedback
     # (implies comm_overlap's explicit sync path)
     grad_compress: str = "none"
-    # target sync bucket size, MiB
+    # target sync bucket size, MiB; 0 = auto-size per link from the
+    # measured topology.LinkModel (DCN-leg target on multi-slice
+    # meshes, ICI otherwise)
     grad_bucket_mb: int = 4
 
 
@@ -317,6 +319,12 @@ class ElasticTrainer:
             self._span_heartbeat.start()
         self.state = self.accel.init_fn(jax.random.PRNGKey(0))
         self._grad_sync_plan = None
+        # measured link-cost model (parallel/topology.py): probe once
+        # per device fingerprint (warm restarts hit the JSON cache);
+        # the dry-runner and the auto bucket sizer price wire time
+        # from it instead of the flat-ICI constant
+        self._link_fp: Optional[str] = None
+        self._setup_link_model()
         self._setup_grad_sync()
         self._state_nbytes = sum(
             x.size * x.dtype.itemsize
@@ -371,6 +379,73 @@ class ElasticTrainer:
                 self._best_ckptr = FlashCheckpointer(self._best_dir)
                 self._best_eval_loss = self._load_best_sidecar()
 
+    # -- measured link-cost model (parallel/topology.py) ----------------
+    def _setup_link_model(self):
+        """Probe (or reuse) the per-link bandwidth model for the
+        CURRENT device world. Called at startup and after every
+        resize; the probe itself runs only when the device fingerprint
+        actually changed (docs/elastic-resize.md invalidation rule) —
+        a resize back onto the same hardware, and any warm restart,
+        reuses the persisted cache without touching the devices."""
+        from dlrover_tpu.parallel import topology
+
+        try:
+            devices = list(self.mesh.devices.flatten())
+            fp = topology.device_fingerprint(devices)
+            if fp == self._link_fp:
+                logger.info(
+                    f"link model: device fingerprint unchanged ({fp}),"
+                    f" keeping the current probe"
+                )
+                return
+            model = topology.probe_link_model(
+                mesh_config=self.accel.strategy.mesh, devices=devices
+            )
+            self._link_fp = fp
+            topology.export_link_metrics(model, self._registry)
+        except Exception as e:  # the probe must never kill training
+            logger.warning(f"link-model probe failed: {e!r}")
+
+    def apply_slice_throughput(self, step_times_s) -> None:
+        """Heterogeneous per-slice data weighting (arXiv 2602.18007):
+        per-slice step times → normalized throughput weights → unequal
+        per-replica shards in the elastic sampler (a slice twice as
+        fast consumes twice the data, so the fast slices stop waiting
+        at the sync point). ``step_times_s``: one entry per DCN slice,
+        e.g. from the master's straggler attribution. No-op (reset to
+        equal shards) when the mesh has no multi-slice structure."""
+        from dlrover_tpu.parallel import topology
+
+        slices = self.accel.strategy.mesh.dp_slices()
+        reps = self.sampler.num_replicas
+        if slices <= 1 or len(step_times_s) != slices or reps % slices:
+            # NOT silent: the in-process trainer's own sampler is
+            # single-replica (one process consumes the whole global
+            # batch — there are no per-replica shards to reweight;
+            # multi-worker data planes construct per-rank samplers and
+            # call set_throughput_weights on those), and a mismatched
+            # slice count means the caller's view of the mesh is stale
+            if slices > 1:
+                logger.warning(
+                    f"slice throughput weighting not applied: "
+                    f"{slices} slices, {len(step_times_s)} step times, "
+                    f"{reps} sampler replicas (need len(times) == "
+                    f"slices and slices | replicas); resetting to "
+                    f"equal shards"
+                )
+            self.sampler.set_throughput_weights(None)
+            return
+        w = topology.slice_throughput_weights(step_times_s)
+        per = reps // slices
+        # replicas are slice-major (mesh.py hybrid dp layout): replica
+        # r lives in slice r // per and splits its slice's share evenly
+        self.sampler.set_throughput_weights(
+            [w[r // per] / per for r in range(reps)]
+        )
+        logger.info(
+            f"slice throughput weights applied: {[round(x, 3) for x in w]}"
+        )
+
     # -- overlap-scheduled gradient sync -------------------------------
     def _grad_sync_opt_names(self) -> tuple:
         """Named optimizations the trainer's grad-sync knobs translate
@@ -394,6 +469,7 @@ class ElasticTrainer:
         from dlrover_tpu.parallel.grad_sync import (
             ensure_residual,
             estimate_overlap_pct,
+            measure_sync_legs_ms,
             measure_sync_ms,
             resolve_plan,
         )
@@ -413,15 +489,94 @@ class ElasticTrainer:
             try:
                 # the sync's standalone roofline (one small compile;
                 # the in-step cost is this minus what the scheduler
-                # overlaps)
-                stats.grad_sync_ms = measure_sync_ms(
-                    plan, self.mesh, iters=3
-                )
+                # overlaps), split per link class for two-level plans.
+                # Two-level: the legs probe already times the full
+                # sync for its "all" leg — reuse ici+dcn as the total
+                # instead of compiling and timing it a second time
+                if plan.two_level:
+                    stats.grad_sync_ici_ms, stats.grad_sync_dcn_ms = (
+                        measure_sync_legs_ms(plan, self.mesh, iters=3)
+                    )
+                    stats.grad_sync_ms = (
+                        stats.grad_sync_ici_ms + stats.grad_sync_dcn_ms
+                    )
+                else:
+                    stats.grad_sync_ms = measure_sync_ms(
+                        plan, self.mesh, iters=3
+                    )
+                    stats.grad_sync_ici_ms = stats.grad_sync_ms
+                    stats.grad_sync_dcn_ms = 0.0
             except Exception as e:
                 logger.warning(
                     f"grad-sync timing probe failed: {e!r}"
                 )
         logger.info(f"grad sync: {plan.describe()}")
+
+    def measure_realized_overlap(self, iters: int = 3) -> Optional[float]:
+        """A/B-measure how much of the sync's wire time the scheduler
+        actually hides. The baseline twin uses GSPMD's monolithic
+        schedule, which serializes its sync after the last backward op
+        (the PR-3 premise this whole module exists to fix) — so the
+        *sync-free* step time is approximately ``baseline -
+        standalone_roofline``, and the explicit step's exposed sync is
+        what it runs above that. Writes ``PipelineStats.overlap_pct_
+        measured`` (the measured twin of the analytic
+        ``comm_overlap_pct``) and returns it. Opt-in — it costs one
+        extra step compile, so it is a diagnostic call / bench hook,
+        not startup work."""
+        import jax
+
+        from dlrover_tpu.models.train import build_train_step
+        from dlrover_tpu.parallel.grad_sync import (
+            measured_overlap_pct,
+            strip_residual,
+        )
+
+        plan = self._grad_sync_plan
+        stats = self.pipeline_stats
+        if plan is None or not stats.grad_sync_ms:
+            return None
+        s = self.accel.strategy
+        base_step = build_train_step(
+            self.cfg, self.mesh, self._tx, donate=False,
+            grad_accum=s.grad_accum,
+        )
+        rng = np.random.default_rng(0)
+        x = rng.integers(
+            0, self.cfg.vocab_size,
+            (self.tcfg.batch_size, self.tcfg.seq_len),
+        ).astype(np.int32)
+        b = shard_batch({"x": x, "y": x}, self.mesh)
+
+        def _time(fn, state):
+            st, _ = fn(state, b["x"], b["y"])  # compile + warmup
+            jax.block_until_ready(st.params)
+            times = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                st, _ = fn(state, b["x"], b["y"])
+                jax.block_until_ready(st.params)
+                times.append(time.perf_counter() - t0)
+            return float(np.median(times) * 1e3)
+
+        with span("grad_sync_overlap_probe"):
+            with_ms = _time(self._step_fn, self.state)
+            gspmd_ms = _time(
+                base_step, strip_residual(self.state)
+            )
+        # the GSPMD baseline carries its own monolithic sync fully
+        # serialized; subtracting the standalone roofline approximates
+        # the sync-free step the pure function normalizes against
+        stats.overlap_pct_measured = measured_overlap_pct(
+            stats.grad_sync_ms, with_ms,
+            gspmd_ms - stats.grad_sync_ms,
+        )
+        logger.info(
+            f"grad sync realized overlap: {stats.overlap_pct_measured}%"
+            f" (step {with_ms:.2f} ms explicit vs {gspmd_ms:.2f} ms "
+            f"gspmd, standalone {stats.grad_sync_ms:.2f} ms)"
+        )
+        return stats.overlap_pct_measured
 
     # -- checkpoint ----------------------------------------------------
     def _rewound_sampler_state(self, samp: Dict, buffered: int) -> Dict:
@@ -429,13 +584,14 @@ class ElasticTrainer:
         prefetcher's source cursor ran ahead of what actually trained,
         so a restore (or a resize that drops the buffer) must replay
         those batches instead of skipping them."""
-        rewind = (
-            buffered
-            * self.dataloader.batch_size
-            * self.sampler.num_replicas
-        )
         samp = dict(samp)
-        completed = samp["completed_num"] - rewind
+        # owned samples to replay; the sampler converts to global
+        # positions per its dealing mode (equal round-robin vs
+        # throughput-weighted)
+        completed = self.sampler.rewound_completed(
+            samp["completed_num"],
+            buffered * self.dataloader.batch_size,
+        )
         if completed < 0 and samp["epoch"] > 0:
             # the sampler already rolled over (its iterator exhausts
             # depth batches before the consumer does) but the buffered
@@ -1123,6 +1279,10 @@ class ElasticTrainer:
         )
         self._step_fn = accel.step_fn
         self._eval_step_fn = None  # per-mesh memo re-resolves lazily
+        # link model: re-probe ONLY when the device fingerprint changed
+        # (docs/elastic-resize.md) — a resize back onto the same
+        # hardware reuses the cached probe and costs nothing here
+        self._setup_link_model()
         # buckets are re-planned for the new dp degree and a fresh
         # error-feedback residual attached (shapes changed with dp);
         # the timing probe is skipped — downtime window

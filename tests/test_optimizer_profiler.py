@@ -155,7 +155,9 @@ class TestProfiler:
         )
         from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
 
-        cfg = tiny()
+        # 1 layer: this exercises trace_steps' profile writing, not
+        # the model — every saved compile second keeps tier-1 in budget
+        cfg = tiny(num_layers=1)
         mesh = build_mesh(MeshConfig(dp=len(jax.devices())))
         tx = optax.adamw(1e-3)
         state, _ = init_sharded_state(jax.random.PRNGKey(0), cfg, mesh, tx)

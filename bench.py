@@ -40,6 +40,18 @@ import numpy as np
 
 REF_GOODPUT_PCT = 95.0  # reference's published goodput (README.md:54-55)
 
+# every bench artifact (trace dumps, merged timelines, flight bundles
+# the forensics leg provokes) lands under one dir instead of littering
+# the repo root; override per-run with DLROVER_TPU_BENCH_ARTIFACTS
+ENV_BENCH_ARTIFACTS = "DLROVER_TPU_BENCH_ARTIFACTS"
+DEFAULT_BENCH_ARTIFACTS = "bench_artifacts"
+
+
+def artifacts_dir() -> str:
+    d = os.getenv(ENV_BENCH_ARTIFACTS, DEFAULT_BENCH_ARTIFACTS)
+    os.makedirs(d, exist_ok=True)
+    return d
+
 
 def _chip_peak_tflops(device) -> float | None:
     from dlrover_tpu.accel.profiler import chip_peak_tflops
@@ -261,7 +273,13 @@ def _goodput_body(
             done = step0
 
     wall = time.perf_counter() - t_bench0
-    goodput = 100.0 * step_time / wall
+    # the shared definition (obs/goodput.py) — bench legs measure their
+    # own productive/wall seconds (cross-process windows no single
+    # tracer sees) but must divide through the same formula the
+    # continuous ledger exports, or the two "goodput"s drift
+    from dlrover_tpu.obs.goodput import compute_goodput_pct
+
+    goodput = compute_goodput_pct(step_time, wall)
 
     results.update(
         {
@@ -714,6 +732,8 @@ def run_goodput_124m(jax, results: dict):
         b = _spawn_goodput_child(
             "B", os.path.join(tmp, "b.json"), env, 900
         )
+        from dlrover_tpu.obs.goodput import compute_goodput_pct
+
         step_time = a["step_time"] + b["step_time"]
         wall = b["t_end"] - a["t_start"]
         lost_steps = a["steps"] - a["staged_step"]
@@ -735,13 +755,13 @@ def run_goodput_124m(jax, results: dict):
         results.update(
             {
                 "goodput_124m_window_pct": round(
-                    100.0 * step_time / wall, 2
+                    compute_goodput_pct(step_time, wall), 2
                 ),
                 "goodput_124m_per_hr_pct": round(
-                    100.0 * (1.0 - overhead_s / 3600.0), 2
+                    compute_goodput_pct(3600.0 - overhead_s, 3600.0), 2
                 ),
                 "goodput_124m_window_at_1GBps_pct": round(
-                    100.0 * step_time / wall_real_link, 2
+                    compute_goodput_pct(step_time, wall_real_link), 2
                 ),
                 "goodput_124m_state_GB": a["state_GB"],
                 "goodput_124m_save_block_ms": a["save_block_ms"],
@@ -1846,7 +1866,10 @@ def run_trace_bench(jax, results: dict, smoke: bool = False):
         trainer.train(num_steps=trainer.global_step + 2 * steps)
         path = os.getenv(
             "DLROVER_TPU_TRACE_OUT",
-            "trace_smoke.json" if smoke else "trace_bench.json",
+            os.path.join(
+                artifacts_dir(),
+                "trace_smoke.json" if smoke else "trace_bench.json",
+            ),
         )
         tracer.dump(path)
         with open(path) as f:
@@ -2005,6 +2028,157 @@ def run_recovery_bench(jax, results: dict, smoke: bool = False):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_forensics_bench(jax, results: dict, smoke: bool = False):
+    """Goodput-ledger closure + crash-flight-recorder leg.
+
+    Two contracts from obs/goodput.py and obs/flight_recorder.py:
+
+    - **closure**: over a real traced training run, the ledger's
+      categories must sum back to wall time within
+      ``goodput_closure_gate_pct`` (= ``obs.goodput.CLOSURE_GATE_PCT``,
+      1%) — interval arithmetic double- or under-claiming time would
+      silently corrupt the number the Brain plans against;
+    - **black box**: a trainer killed by an injected fault
+      (``prefetch.pull:io_error`` through the PR-5 ``FaultPoint``
+      registry) must leave a flight-recorder bundle whose embedded
+      ``trace.json`` validates as Chrome trace JSON — the forensics
+      path only matters if it works when the process actually dies.
+
+    Keys: ``goodput_ledger_pct`` / ``goodput_closure_error_pct`` (gated)
+    / ``goodput_productive_s`` / ``goodput_ledger_wall_s`` /
+    ``flight_crash_injected`` / ``flight_bundle_ok`` /
+    ``flight_trace_valid`` / ``flight_bundle``. ``--smoke`` exits
+    nonzero when the closure gate misses or the crash leaves no valid
+    bundle.
+    """
+    import shutil
+
+    import optax
+
+    from dlrover_tpu.accel.strategy import Strategy
+    from dlrover_tpu.common import faults
+    from dlrover_tpu.models import tiny
+    from dlrover_tpu.obs import flight_recorder as obs_flight
+    from dlrover_tpu.obs.goodput import CLOSURE_GATE_PCT
+    from dlrover_tpu.obs.trace import get_tracer, validate_chrome_trace
+    from dlrover_tpu.parallel.mesh import MeshConfig
+    from dlrover_tpu.trainer.elastic.trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
+
+    class _Tokens:
+        def __init__(self, n=2048, seq=32, vocab=256):
+            rng = np.random.default_rng(11)
+            self.data = rng.integers(
+                0, vocab, (n, seq + 1), dtype=np.int32
+            )
+
+        def __len__(self):
+            return len(self.data)
+
+        def __getitem__(self, i):
+            return {"x": self.data[i][:-1], "y": self.data[i][1:]}
+
+    def _make_trainer():
+        return ElasticTrainer(
+            model_cfg=tiny(num_layers=1) if smoke else tiny(),
+            tx=optax.adamw(1e-2),
+            dataset=_Tokens(),
+            trainer_cfg=TrainerConfig(
+                batch_size=8,
+                seq_len=32,
+                report_metrics=False,
+                log_interval=4,
+                prefetch=2,
+                donation_aware=False,
+                speculative_compile=False,
+            ),
+            strategy=Strategy(mesh=MeshConfig(dp=1), dtype="float32"),
+            devices=list(jax.devices())[:1],
+        )
+
+    tracer = get_tracer()
+    was_enabled = tracer.enabled
+    tracer.enabled = True
+
+    # -- leg 1: goodput closure over a real traced run -----------------
+    trainer = _make_trainer()
+    try:
+        trainer.train(num_steps=24 if smoke else 96)
+        report = trainer._goodput.snapshot()
+    finally:
+        trainer.close()
+    results["goodput_ledger_pct"] = round(report.goodput_pct, 2)
+    results["goodput_closure_error_pct"] = round(
+        report.closure_error_pct, 4
+    )
+    results["goodput_closure_gate_pct"] = CLOSURE_GATE_PCT
+    results["goodput_ledger_wall_s"] = round(report.wall_s, 3)
+    results["goodput_productive_s"] = round(
+        report.seconds.get("productive_compute", 0.0), 3
+    )
+    results["goodput_data_stall_s"] = round(
+        report.seconds.get("data_stall", 0.0), 3
+    )
+    results["goodput_other_s"] = round(
+        report.seconds.get("other", 0.0), 3
+    )
+
+    # -- leg 2: injected crash -> flight-recorder bundle ---------------
+    flight_tmp = tempfile.mkdtemp(prefix="dlrover_flight_")
+    prev_dir = os.environ.get(obs_flight.ENV_FLIGHT_DIR)
+    os.environ[obs_flight.ENV_FLIGHT_DIR] = flight_tmp
+    faults.reset()
+    crashed = False
+    try:
+        t2 = _make_trainer()
+        try:
+            # every producer pull now raises OSError; it is delivered
+            # to the train thread in order and escapes _train_loop,
+            # which is exactly the crash the recorder must survive
+            faults.configure("prefetch.pull:io_error:1.0")
+            t2.train(num_steps=t2.global_step + 8)
+        except OSError:
+            crashed = True
+        finally:
+            faults.configure("")
+            t2.close()
+        bundles = sorted(
+            os.path.join(flight_tmp, d)
+            for d in os.listdir(flight_tmp)
+            if d.split("_")[1:2] == ["crash"]
+        ) if os.path.isdir(flight_tmp) else []
+        valid, reason = False, "no bundle"
+        if bundles:
+            with open(os.path.join(bundles[-1], "trace.json")) as f:
+                valid, reason = validate_chrome_trace(json.load(f))
+        results["flight_crash_injected"] = bool(crashed)
+        results["flight_bundle_ok"] = bool(bundles)
+        results["flight_trace_valid"] = bool(valid)
+        results["flight_trace_valid_reason"] = reason
+        results["flight_bundle"] = bundles[-1] if bundles else None
+        results["flight_bundle_files"] = (
+            sorted(os.listdir(bundles[-1])) if bundles else []
+        )
+        if bundles:
+            # keep the artifact where the other bench artifacts live
+            keep = os.path.join(
+                artifacts_dir(), os.path.basename(bundles[-1])
+            )
+            shutil.rmtree(keep, ignore_errors=True)
+            shutil.copytree(bundles[-1], keep)
+            results["flight_bundle"] = keep
+    finally:
+        faults.reset()
+        if prev_dir is None:
+            os.environ.pop(obs_flight.ENV_FLIGHT_DIR, None)
+        else:
+            os.environ[obs_flight.ENV_FLIGHT_DIR] = prev_dir
+        tracer.enabled = was_enabled
+        shutil.rmtree(flight_tmp, ignore_errors=True)
+
+
 def run_smoke() -> int:
     """Fast CPU-only pass over the pipeline + resize keys (CI wiring:
     overlap and resize-fast-path regressions must fail loudly without a
@@ -2046,6 +2220,10 @@ def run_smoke() -> int:
         run_recovery_bench(jax, results, smoke=True)
     except Exception as e:
         results["recovery_error"] = repr(e)
+    try:
+        run_forensics_bench(jax, results, smoke=True)
+    except Exception as e:
+        results["forensics_error"] = repr(e)
     print(json.dumps(results))
     sys.stdout.flush()
     sys.stderr.flush()
@@ -2104,6 +2282,22 @@ def run_smoke() -> int:
         and results.get("recovery_enospc_recovered") is True
         and results.get("ckpt_recover_ms") is not None
         and (results.get("faults_triggered") or 0) > 0
+        # the forensics gates: the goodput ledger's categories must sum
+        # back to wall time (a broken partition corrupts the number the
+        # Brain plans against), spans must actually flow into it, and
+        # an injected crash must leave a flight-recorder bundle whose
+        # trace loads as valid Chrome JSON — a black box that fails at
+        # the crash is decoration
+        and "forensics_error" not in results
+        and results.get("goodput_closure_error_pct") is not None
+        and (
+            results["goodput_closure_error_pct"]
+            <= results["goodput_closure_gate_pct"]
+        )
+        and (results.get("goodput_ledger_pct") or 0) > 0
+        and results.get("flight_crash_injected") is True
+        and results.get("flight_bundle_ok") is True
+        and results.get("flight_trace_valid") is True
     )
     os._exit(0 if ok else 1)
 
@@ -2260,6 +2454,11 @@ def main() -> int:
     except Exception as e:
         results["ckpt_recover_ms"] = None
         results["recovery_error"] = repr(e)
+    try:
+        run_forensics_bench(jax, results)
+    except Exception as e:
+        results["goodput_closure_error_pct"] = None
+        results["forensics_error"] = repr(e)
     try:
         run_mfu(jax, results)
     except Exception as e:

@@ -294,6 +294,17 @@ class MasterClient:
             )
         )
 
+    def poll_worker_commands(
+        self, ack_id: int = 0
+    ) -> List[comm.WorkerCommand]:
+        """This node's pending master->worker commands (flight dumps,
+        profiler captures). ``ack_id`` is the highest id the caller
+        has durably relayed: the master clears up to it and redelivers
+        the rest, so a lost response cannot drop a command (the caller
+        — the agent's WorkerCommandRelay — dedups by id)."""
+        resp = self.get(comm.WorkerCommandRequest(ack_id=ack_id))
+        return list(resp.commands) if resp is not None else []
+
     def report_training_status(self, status: int):
         return self.report(
             comm.TrainingStatusReport(

@@ -170,6 +170,82 @@ class TrainingMonitor(PollingDaemon):
                 )
 
 
+def _commands_path() -> str:
+    return os.getenv(
+        ConfigPath.ENV_WORKER_COMMANDS, ConfigPath.WORKER_COMMANDS
+    )
+
+
+def read_worker_commands(path: str = "") -> list:
+    """Trainer side: the relayed master->worker commands, newest last.
+    Each entry: ``{"id", "kind", "arg", "reason"}`` — consumers track
+    the highest ``id`` they executed (ids are master-monotonic)."""
+    path = path or _commands_path()
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return []
+    cmds = payload.get("commands", [])
+    return cmds if isinstance(cmds, list) else []
+
+
+def last_command_id(path: str = "") -> int:
+    """Highest command id in the relay file — THE watermark definition,
+    shared by the relay's ack (what it tells the master it has) and the
+    trainer's startup skip (commands already in the file target a
+    previous incarnation)."""
+    return max(
+        (int(c.get("id", 0)) for c in read_worker_commands(path)),
+        default=0,
+    )
+
+
+class WorkerCommandRelay(PollingDaemon):
+    """Mirror the master's pending worker commands (flight dumps,
+    profiler captures) into the command file the training process
+    polls — the paral-config pattern, because the master never opens a
+    connection INTO a worker and a training process has no RPC client.
+    The file keeps a bounded tail of relayed commands so a trainer that
+    polls slower than the relay cannot miss one."""
+
+    def __init__(self, client, interval: float = 5.0, path: str = "",
+                 keep: int = 16):
+        super().__init__("worker-command-relay", interval)
+        self._client = client
+        self._path = path or _commands_path()
+        self._keep = keep
+        # highest id durably in the file = what we ack to the master
+        # (resuming from the file keeps the ack watermark across agent
+        # restarts, so the master doesn't redeliver forever)
+        self._ack = last_command_id(self._path)
+
+    def _tick(self):
+        cmds = [
+            c
+            for c in self._client.poll_worker_commands(ack_id=self._ack)
+            if c.id > self._ack  # redelivery of an unacked poll: dedup
+        ]
+        if not cmds:
+            return
+        existing = read_worker_commands(self._path)
+        for c in cmds:
+            existing.append(
+                {
+                    "id": c.id, "kind": c.kind, "arg": c.arg,
+                    "reason": c.reason,
+                }
+            )
+        atomic_write_json(
+            self._path, {"commands": existing[-self._keep:]}
+        )
+        self._ack = max(c.id for c in cmds)
+        logger.info(
+            f"relayed {len(cmds)} worker command(s): "
+            + ", ".join(f"{c.kind}#{c.id}" for c in cmds)
+        )
+
+
 class ParalConfigTuner(PollingDaemon):
     """Poll the master's tuned config and rewrite the JSON file the
     ElasticDataLoader re-reads (parity: paral_config_tuner.py:30)."""

@@ -21,7 +21,8 @@ CREATE TABLE IF NOT EXISTS job_metrics (
     steps_per_sec REAL,
     alive_nodes INTEGER,
     total_cpu_percent REAL,
-    total_memory_mb INTEGER
+    total_memory_mb INTEGER,
+    goodput_pct REAL DEFAULT 0
 );
 CREATE INDEX IF NOT EXISTS job_metrics_job ON job_metrics (job, ts);
 CREATE TABLE IF NOT EXISTS job_end (
@@ -90,6 +91,14 @@ class BrainServicer:
         try:
             self._conn.execute(
                 "ALTER TABLE job_end ADD COLUMN end_ts REAL DEFAULT 0"
+            )
+        except sqlite3.OperationalError:
+            pass  # already present
+        # pre-goodput on-disk stores lack the goodput column
+        try:
+            self._conn.execute(
+                "ALTER TABLE job_metrics ADD COLUMN "
+                "goodput_pct REAL DEFAULT 0"
             )
         except sqlite3.OperationalError:
             pass  # already present
@@ -163,10 +172,11 @@ class BrainServicer:
     def persist_metrics(self, job: str, s: comm.JobMetricsSample):
         with self._lock:
             self._conn.execute(
-                "INSERT INTO job_metrics VALUES (?,?,?,?,?,?,?)",
+                "INSERT INTO job_metrics VALUES (?,?,?,?,?,?,?,?)",
                 (
                     job, s.timestamp, s.global_step, s.steps_per_sec,
                     s.alive_nodes, s.total_cpu_percent, s.total_memory_mb,
+                    getattr(s, "goodput_pct", 0.0),
                 ),
             )
             # bound the series per job (parity: the reference prunes by
@@ -319,8 +329,8 @@ class BrainServicer:
         # keep 10 would hold the lock for nothing
         query = (
             "SELECT ts, global_step, steps_per_sec, alive_nodes, "
-            "total_cpu_percent, total_memory_mb FROM job_metrics "
-            "WHERE job = ? ORDER BY ts"
+            "total_cpu_percent, total_memory_mb, goodput_pct "
+            "FROM job_metrics WHERE job = ? ORDER BY ts"
         )
         with self._lock:
             if last_n:
@@ -338,6 +348,7 @@ class BrainServicer:
                 alive_nodes=r[3],
                 total_cpu_percent=r[4],
                 total_memory_mb=r[5],
+                goodput_pct=r[6] or 0.0,
             )
             for r in rows
         ]

@@ -1000,6 +1000,19 @@ class AsyncCheckpointSaver:
             self._report_event(
                 "ckpt_degraded", f"step {step}: {detail}"
             )
+            # forensics + accounting: the flight recorder dumps a
+            # bundle on episode entry and the goodput ledger starts
+            # booking the episode (both best-effort — telemetry must
+            # never make a storage incident worse)
+            try:
+                from dlrover_tpu.obs import flight_recorder, goodput
+
+                goodput.note_degraded(True)
+                flight_recorder.note_event(
+                    "ckpt_degraded", f"step {step}: {detail}"
+                )
+            except Exception:
+                pass
         else:
             # already degraded: one node event per episode is enough —
             # repeats would spam the master at the save cadence
@@ -1019,6 +1032,17 @@ class AsyncCheckpointSaver:
         self._report_event(
             "ckpt_degraded_recovered", f"step {step} persisted"
         )
+        # close the goodput episode opened on entry — leaving it open
+        # would book every second after recovery as "degraded" forever
+        try:
+            from dlrover_tpu.obs import flight_recorder, goodput
+
+            goodput.note_degraded(False)
+            flight_recorder.note_event(
+                "ckpt_degraded_recovered", f"step {step} persisted"
+            )
+        except Exception:
+            pass
 
     def _commit_checkpoint(
         self, step: int, st: _StepState, timeout: float = 600.0

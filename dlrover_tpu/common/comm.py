@@ -319,6 +319,10 @@ class JobMetricsSample(Message):
     alive_nodes: int = 0
     total_cpu_percent: float = 0.0
     total_memory_mb: int = 0
+    # fleet goodput (obs/goodput.py ledger, aggregated per worker by
+    # TelemetryAggregator): the %-of-wall-time-productive number the
+    # Brain's allocation objective plans against. 0.0 = not reported.
+    goodput_pct: float = 0.0
 
 
 @dataclass
@@ -566,3 +570,37 @@ class ScaleRequest(Message):
 
     node_type: str = ""
     count: int = 0
+
+
+# -- master -> worker command channel (forensics / profiling) ---------------
+@dataclass
+class WorkerCommand(Message):
+    """One master-issued command for a specific worker. Kinds:
+
+    - ``flight_dump`` — dump a flight-recorder bundle now;
+    - ``profile`` — capture ``arg`` train steps with jax.profiler.
+
+    Commands ride the existing pull architecture: the agent polls them
+    off the master (``WorkerCommandRequest``) and relays them to the
+    training process through a JSON file (the paral-config pattern) —
+    the master never needs a connection INTO a worker."""
+
+    id: int = 0  # master-assigned, monotonic per worker
+    kind: str = ""
+    arg: int = 0
+    reason: str = ""
+
+
+@dataclass
+class WorkerCommandRequest(Message):
+    node_id: int = -1  # -1 = the requesting node (BaseRequest.node_id)
+    # highest command id the agent has durably relayed: the master
+    # clears only acked commands, so a lost RESPONSE redelivers
+    # instead of dropping (the pop itself must not be the ack — the
+    # poll is a read with a side effect otherwise)
+    ack_id: int = 0
+
+
+@dataclass
+class WorkerCommands(Message):
+    commands: List[WorkerCommand] = field(default_factory=list)

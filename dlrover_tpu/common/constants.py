@@ -122,6 +122,11 @@ class ConfigPath:
     PARAL_CONFIG = "/tmp/dlrover_tpu/auto_paral_config.json"
     ENV_RUNTIME_METRICS = "DLROVER_TPU_RUNTIME_METRICS_PATH"
     RUNTIME_METRICS = "/tmp/dlrover_tpu/runtime_metrics.json"
+    # master->worker command relay (flight dumps / profiler captures):
+    # the agent's WorkerCommandRelay polls the master and mirrors
+    # pending commands here; the trainer polls the file at log cadence
+    ENV_WORKER_COMMANDS = "DLROVER_TPU_WORKER_COMMANDS_PATH"
+    WORKER_COMMANDS = "/tmp/dlrover_tpu/worker_commands.json"
 
 
 class NodeEnv:

@@ -93,6 +93,9 @@ class LocalJobMaster:
             reporter=(
                 self._brain_client.reporter() if self._brain_client else None
             ),
+            # each sample carries the fleet goodput number (obs/goodput
+            # ledgers aggregated per worker) to the Brain datastore
+            telemetry=self.telemetry,
         )
         self.resource_optimizer = JobResourceOptimizer(
             metric_collector=self.metric_collector,
@@ -136,6 +139,14 @@ class LocalJobMaster:
             paral_config_service=self.paral_config_service,
             metric_collector=self.metric_collector,
             telemetry=self.telemetry,
+        )
+        # straggler auto-profile: a newly-flagged worker gets ONE
+        # `profile` command per episode, so the flag ships with
+        # jax.profiler evidence (obs/flight_recorder.ProfilerCapture)
+        self.telemetry.set_profile_requester(
+            lambda w: self.servicer.queue_worker_command(
+                w, "profile", arg=3, reason="straggler"
+            )
         )
         self._server = None
         self._brain_end_thread: Optional[threading.Thread] = None
@@ -227,6 +238,29 @@ class LocalJobMaster:
                     f"restarting workers (recovery "
                     f"{hang_recoveries}/{max_hang_recoveries})"
                 )
+                # best-effort forensics: ask every attributed worker
+                # for a flight-recorder bundle before the restart kills
+                # the evidence (a fully wedged trainer won't poll the
+                # command file — its own hang watchdog covers that
+                # case; this catches the partially-alive ones)
+                attributed = sorted(self.telemetry.hang_attribution())
+                for w in attributed:
+                    self.servicer.queue_worker_command(
+                        w, "flight_dump", reason="hang"
+                    )
+                if attributed:
+                    # one relay-poll window so partially-alive workers
+                    # can actually pull the command, then PURGE what
+                    # was never delivered — a dump request for the
+                    # dying incarnation executed by its healthy
+                    # replacement would forge "hang" evidence of a
+                    # fine process
+                    time.sleep(
+                        float(
+                            os.getenv("DLROVER_TPU_HANG_DUMP_GRACE_S", "6")
+                        )
+                    )
+                    self.servicer.clear_worker_commands()
                 self.job_manager.restart_all_workers()
             time.sleep(2)
         return JobExitReason.SUCCEEDED

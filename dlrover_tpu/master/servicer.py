@@ -63,6 +63,15 @@ class MasterServicer:
         self._node_addrs: dict = {}  # node_type -> {rank: addr}
         self._ckpt_steps: dict = {}  # node_id -> latest in-memory ckpt step
         self._run_configs: dict = {}
+        # master -> worker command channel (flight dumps, profiler
+        # captures): queued here, drained by the agent's poll
+        self._worker_commands: dict = {}  # node_id -> [WorkerCommand]
+        # ids already handed to an agent (pending only until acked):
+        # coalescing into one of these would return an id the trainer
+        # has already executed-and-deduped — the new request would
+        # silently never run
+        self._delivered_commands: dict = {}  # node_id -> {id, ...}
+        self._command_seq = 0
 
     # ------------------------------------------------------------------
     # RPC entrypoints (bytes in/out)
@@ -182,7 +191,73 @@ class MasterServicer:
                 else False
             )
             return comm.SyncResult(done=done)
+        if isinstance(message, comm.WorkerCommandRequest):
+            node_id = message.node_id if message.node_id >= 0 else req.node_id
+            ack = getattr(message, "ack_id", 0)
+            with self._lock:
+                pending = self._worker_commands.get(node_id, [])
+                # clear only what the agent ACKED (its previous poll's
+                # ids): a lost response redelivers rather than drops
+                pending[:] = [c for c in pending if c.id > ack]
+                delivered = self._delivered_commands.setdefault(
+                    node_id, set()
+                )
+                delivered.difference_update(
+                    i for i in list(delivered) if i <= ack
+                )
+                delivered.update(c.id for c in pending)
+                if not pending:
+                    self._worker_commands.pop(node_id, None)
+                return comm.WorkerCommands(commands=list(pending))
         raise ValueError(f"unknown get message: {type(message).__name__}")
+
+    # ------------------------------------------------------------------
+    # worker command queue (master-side producers: hang handler,
+    # straggler auto-profile, operators)
+    # ------------------------------------------------------------------
+    def queue_worker_command(
+        self, node_id: int, kind: str, arg: int = 0, reason: str = ""
+    ) -> comm.WorkerCommand:
+        """Queue one command for ``node_id``; delivered on the agent's
+        next ``WorkerCommandRequest`` poll and cleared once that poll's
+        ids come back acked. Duplicate (kind, reason) pairs still
+        pending are coalesced (newest ``arg`` wins) — a hang handler
+        firing every tick must not flood a wedged worker."""
+        with self._lock:
+            pending = self._worker_commands.setdefault(node_id, [])
+            delivered = self._delivered_commands.get(node_id, set())
+            for c in pending:
+                if (
+                    c.kind == kind
+                    and c.reason == reason
+                    and c.id not in delivered
+                ):
+                    # still undelivered: safe to fold the new request
+                    # in (a delivered id may already be executed and
+                    # deduped trainer-side — folding into it would
+                    # silently drop this request)
+                    c.arg = arg  # last request's argument wins
+                    return c
+            self._command_seq += 1
+            cmd = comm.WorkerCommand(
+                id=self._command_seq, kind=kind, arg=arg, reason=reason
+            )
+            pending.append(cmd)
+            return cmd
+
+    def clear_worker_commands(self, node_id: Optional[int] = None):
+        """Purge undelivered queued commands (all nodes when
+        ``node_id`` is None). The master calls this before restarting
+        workers: a pending command targets the incarnation that is
+        about to die, and executing it against the healthy replacement
+        would forge evidence."""
+        with self._lock:
+            if node_id is None:
+                self._worker_commands.clear()
+                self._delivered_commands.clear()
+            else:
+                self._worker_commands.pop(node_id, None)
+                self._delivered_commands.pop(node_id, None)
 
     def _get_task(self, node_id: int, message: comm.TaskRequest) -> comm.Task:
         if self._task_manager is None:

@@ -33,10 +33,14 @@ class JobMetricCollector(PollingDaemon):
         interval: float = 30.0,
         max_samples: int = 512,
         reporter: Optional[Callable[[comm.JobMetricsSample], None]] = None,
+        telemetry=None,
     ):
         super().__init__("job-metric-collector", interval)
         self._job_manager = job_manager
         self._speed_monitor = speed_monitor
+        # obs/aggregate.TelemetryAggregator: source of the fleet
+        # goodput number every sample carries to the Brain
+        self._telemetry = telemetry
         self._samples: Deque[comm.JobMetricsSample] = deque(
             maxlen=max_samples
         )
@@ -53,6 +57,11 @@ class JobMetricCollector(PollingDaemon):
             if self._job_manager
             else []
         )
+        goodput_pct = 0.0
+        if self._telemetry is not None:
+            fleet = self._telemetry.fleet_goodput()
+            if fleet is not None:
+                goodput_pct = fleet["goodput_pct"]
         sample = comm.JobMetricsSample(
             timestamp=time.time(),
             global_step=self._speed_monitor.completed_global_step,
@@ -64,6 +73,7 @@ class JobMetricCollector(PollingDaemon):
             total_memory_mb=sum(
                 n.used_resource.memory_mb for n in running
             ),
+            goodput_pct=goodput_pct,
         )
         self._samples.append(sample)
         self._dispatch_to_reporter(sample)

@@ -12,6 +12,15 @@ Three layers, one spine (docs/observability.md):
   folds into it so there is exactly one export path for every number
   the fast-path subsystems produce;
 - ``obs.aggregate`` — the master's side: per-worker step-time
-  aggregation, straggler detection against the fleet median, and hang
-  reports enriched with each worker's last open span.
+  aggregation, straggler detection against the fleet median, hang
+  reports enriched with each worker's last open span, and the fleet
+  goodput rollup;
+- ``obs.goodput`` — the accounting layer: a ``GoodputLedger`` that
+  attributes every second of trainer wall time to a closed taxonomy
+  derived from the span stream, with a closure invariant gated by
+  ``bench.py --smoke``;
+- ``obs.flight_recorder`` — the forensics layer: an always-on black
+  box that dumps a self-contained bundle (trace, metrics, stacks,
+  events, manifest) on crash/hang/degraded-entry or master request,
+  plus on-demand K-step ``jax.profiler`` captures.
 """

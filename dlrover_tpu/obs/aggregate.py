@@ -28,6 +28,7 @@ the per-worker view:
 
 from __future__ import annotations
 
+import re
 import statistics
 import threading
 import time
@@ -36,12 +37,24 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.global_context import Context
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.obs.goodput import (
+    CATEGORIES as GOODPUT_CATEGORIES,
+    compute_goodput_pct,
+)
 
 _ctx = Context.singleton_instance()
 
 # a (derived or explicit) step-time sample longer than this is a stall
 # artifact (restart, resize, rendezvous), not a speed signal
 _MAX_SAMPLE_S = 3600.0
+
+# the ledger scalars as they arrive through the runtime-metrics file ->
+# TrainingMonitor -> TrainMetricsReport flattening, e.g.
+# 'dlrover_goodput_seconds_total{category="ckpt_block"}'
+_GOODPUT_SECONDS_RE = re.compile(
+    r'^dlrover_goodput_seconds_total\{category="([a-z_]+)"\}$'
+)
+_GOODPUT_WALL_KEY = "dlrover_goodput_wall_seconds"
 
 
 class TelemetryAggregator:
@@ -70,6 +83,12 @@ class TelemetryAggregator:
         self._open_spans: Dict[int, Tuple[str, float, float]] = {}
         self._last_metrics: Dict[int, dict] = {}
         self._flagged: set = set()
+        # worker -> {"wall_s": float, "seconds": {category: s}} — the
+        # latest goodput-ledger snapshot each worker reported
+        self._goodput: Dict[int, dict] = {}
+        # straggler auto-profile: called once per newly-flagged worker
+        # (the master wires this to queue a `profile` worker command)
+        self._profile_requester: Optional[Callable[[int], None]] = None
 
     # -- ingestion (servicer / speed-monitor hooks) --------------------
     def observe_step_report(
@@ -109,6 +128,7 @@ class TelemetryAggregator:
         with self._lock:
             if metrics:
                 self._last_metrics[worker_id] = dict(metrics)
+                self._ingest_goodput(worker_id, metrics)
             st_ms = metrics.get("step_time_ms")
             if st_ms is not None and st_ms > 0:
                 if worker_id not in self._explicit:
@@ -129,6 +149,69 @@ class TelemetryAggregator:
                 # the worker reported "nothing open": clear stale frames
                 self._open_spans.pop(worker_id, None)
 
+    def _ingest_goodput(self, worker_id: int, metrics: dict):
+        """Pick the goodput-ledger scalars out of a metrics report
+        (lock held by caller). Workers export absolute category seconds
+        since their ledger started; the fleet view re-derives fractions
+        so restarts (which reset a worker's ledger) stay consistent."""
+        seconds: Dict[str, float] = {}
+        wall = None
+        for key, value in metrics.items():
+            if key == _GOODPUT_WALL_KEY:
+                wall = float(value)
+                continue
+            m = _GOODPUT_SECONDS_RE.match(key)
+            if m and m.group(1) in GOODPUT_CATEGORIES:
+                seconds[m.group(1)] = float(value)
+        if wall is not None and wall > 0 and seconds:
+            self._goodput[worker_id] = {
+                "wall_s": wall, "seconds": seconds,
+            }
+
+    def set_profile_requester(self, fn: Optional[Callable[[int], None]]):
+        """``fn(worker_id)`` fires once per newly-flagged straggler —
+        the master wires it to queue a ``profile`` worker command so a
+        flagged worker ships jax.profiler evidence with its
+        attribution (at most once per episode: recovery clears the
+        flag, a relapse re-triggers)."""
+        self._profile_requester = fn
+
+    # -- goodput (fleet accounting) ------------------------------------
+    def worker_goodput(self, worker_id: int) -> Optional[dict]:
+        """Latest reported ledger snapshot for one worker:
+        ``{"wall_s", "seconds": {category: s}, "goodput_pct"}``."""
+        with self._lock:
+            rec = self._goodput.get(worker_id)
+        if rec is None:
+            return None
+        productive = rec["seconds"].get("productive_compute", 0.0)
+        return {
+            **rec,
+            "goodput_pct": compute_goodput_pct(productive, rec["wall_s"]),
+        }
+
+    def fleet_goodput(self) -> Optional[dict]:
+        """Wall-time-weighted fleet rollup — THE number ROADMAP item 1
+        plans against: ``goodput_pct`` plus summed per-category
+        seconds. None until any worker has reported its ledger."""
+        with self._lock:
+            recs = list(self._goodput.values())
+        if not recs:
+            return None
+        wall = sum(r["wall_s"] for r in recs)
+        seconds = {c: 0.0 for c in GOODPUT_CATEGORIES}
+        for r in recs:
+            for cat, s in r["seconds"].items():
+                seconds[cat] = seconds.get(cat, 0.0) + s
+        return {
+            "wall_s": wall,
+            "seconds": seconds,
+            "goodput_pct": compute_goodput_pct(
+                seconds.get("productive_compute", 0.0), wall
+            ),
+            "workers": len(recs),
+        }
+
     def remove_worker(self, worker_id: int):
         """A departed worker's history must not haunt the fleet median."""
         with self._lock:
@@ -138,6 +221,7 @@ class TelemetryAggregator:
             self._open_spans.pop(worker_id, None)
             self._last_metrics.pop(worker_id, None)
             self._flagged.discard(worker_id)
+            self._goodput.pop(worker_id, None)
 
     def _bucket(self, worker_id: int) -> Deque[float]:
         b = self._samples.get(worker_id)
@@ -206,6 +290,16 @@ class TelemetryAggregator:
                 except Exception as e:
                     logger.warning(
                         f"straggler brain report failed: {e!r}"
+                    )
+            if self._profile_requester is not None:
+                # once per episode (only NEW flags reach here): the
+                # flagged worker ships profiler evidence with its
+                # attribution
+                try:
+                    self._profile_requester(w)
+                except Exception as e:
+                    logger.warning(
+                        f"straggler profile request failed: {e!r}"
                     )
         return sorted(flagged)
 
@@ -284,3 +378,33 @@ class TelemetryAggregator:
         registry.gauge(
             "dlrover_straggler_count", "currently flagged stragglers"
         ).set(len(self.stragglers))
+        # fleet goodput accounting (the Brain objective + dashboards)
+        fleet = self.fleet_goodput()
+        gw = registry.gauge(
+            "dlrover_goodput_worker_pct",
+            "per-worker productive share of wall time, percent",
+            labelnames=("worker",),
+        )
+        live_g = set()
+        with self._lock:
+            goodput_workers = sorted(self._goodput)
+        for w in goodput_workers:
+            rec = self.worker_goodput(w)
+            if rec is not None:
+                gw.labels(str(w)).set(rec["goodput_pct"])
+                live_g.add((str(w),))
+        with gw._lock:
+            for key in [k for k in gw._children if k not in live_g]:
+                del gw._children[key]
+        if fleet is not None:
+            registry.gauge(
+                "dlrover_goodput_fleet_pct",
+                "fleet productive share of wall time, percent",
+            ).set(fleet["goodput_pct"])
+            gc = registry.gauge(
+                "dlrover_goodput_fleet_seconds_total",
+                "fleet wall seconds attributed per goodput category",
+                labelnames=("category",),
+            )
+            for cat, secs in fleet["seconds"].items():
+                gc.labels(cat).set(secs)

@@ -22,6 +22,7 @@ test in tests/test_obs.py enforces the ``as_dict`` side).
 from __future__ import annotations
 
 import math
+import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -32,6 +33,26 @@ DEFAULT_BUCKETS = (
 
 # every PipelineStats-derived gauge is exported under this prefix
 PIPELINE_PREFIX = "dlrover_pipeline_"
+
+# cardinality guard: a label value drawn from an unbounded set (step
+# numbers, pod names of a churning fleet) would grow the exposition —
+# and every scalars() forward to the master — without bound. Past the
+# cap a metric warns ONCE and refuses growth: unseen label sets share
+# one detached overflow child that never enters the exposition, so
+# writes stay cheap no-ops instead of raising on the hot path.
+# (Departed-WORKER pruning is the aggregator's job; this protects the
+# registry itself from any mislabeled series.)
+ENV_MAX_LABEL_SETS = "DLROVER_TPU_METRIC_MAX_LABEL_SETS"
+DEFAULT_MAX_LABEL_SETS = 256
+
+
+def _default_max_label_sets() -> int:
+    try:
+        return int(
+            os.getenv(ENV_MAX_LABEL_SETS, str(DEFAULT_MAX_LABEL_SETS))
+        )
+    except ValueError:
+        return DEFAULT_MAX_LABEL_SETS
 
 
 def _label_key(
@@ -64,11 +85,24 @@ def _fmt_value(v: float) -> str:
 class _Metric:
     kind = "untyped"
 
-    def __init__(self, name: str, help: str, labelnames: Sequence[str]):
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: Sequence[str],
+        max_label_sets: Optional[int] = None,
+    ):
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
+        self.max_label_sets = (
+            int(max_label_sets)
+            if max_label_sets is not None
+            else _default_max_label_sets()
+        )
         self._children: Dict[Tuple[str, ...], object] = {}
+        self._overflow = None  # shared sink past the cardinality cap
+        self._overflow_warned = False
         self._lock = threading.Lock()
 
     def labels(self, *labelvalues, **labelkw):
@@ -80,8 +114,38 @@ class _Metric:
         child = self._children.get(key)
         if child is None:
             with self._lock:
-                child = self._children.setdefault(key, self._new_child())
+                child = self._children.get(key)
+                if child is None:
+                    if len(self._children) >= self.max_label_sets:
+                        return self._overflow_child()
+                    child = self._children.setdefault(
+                        key, self._new_child()
+                    )
         return child
+
+    def _overflow_child(self):
+        """Detached child for label sets past the cap (lock held):
+        callers keep working, but the series never reaches the
+        exposition — bounded memory beats a hot-path exception."""
+        if not self._overflow_warned:
+            self._overflow_warned = True
+            from dlrover_tpu.common.log import default_logger as logger
+
+            logger.warning(
+                f"metric {self.name} hit its label-set cap "
+                f"({self.max_label_sets}); new label sets are dropped "
+                f"from the exposition — an unbounded label value "
+                f"(step? pod name?) is leaking into "
+                f"{self.labelnames} (cap: {ENV_MAX_LABEL_SETS})"
+            )
+        if self._overflow is None:
+            self._overflow = self._new_child()
+        return self._overflow
+
+    def label_set_count(self) -> int:
+        """Distinct label sets currently live (the guard's read side)."""
+        with self._lock:
+            return len(self._children)
 
     def _default_child(self):
         if self.labelnames:
@@ -218,8 +282,13 @@ class _HistogramChild:
 class Histogram(_Metric):
     kind = "histogram"
 
-    def __init__(self, name, help, labelnames=(), buckets=DEFAULT_BUCKETS):
-        super().__init__(name, help, labelnames)
+    def __init__(
+        self, name, help, labelnames=(), buckets=DEFAULT_BUCKETS,
+        max_label_sets=None,
+    ):
+        super().__init__(
+            name, help, labelnames, max_label_sets=max_label_sets
+        )
         self.buckets = tuple(sorted(float(b) for b in buckets))
         if not self.buckets:
             raise ValueError("histogram needs at least one bucket")
@@ -266,11 +335,23 @@ class MetricsRegistry:
             self._metrics[name] = m
             return m
 
-    def counter(self, name: str, help: str = "", labelnames=()) -> Counter:
-        return self._get_or_create(Counter, name, help, labelnames)
+    def counter(
+        self, name: str, help: str = "", labelnames=(),
+        max_label_sets=None,
+    ) -> Counter:
+        return self._get_or_create(
+            Counter, name, help, labelnames,
+            max_label_sets=max_label_sets,
+        )
 
-    def gauge(self, name: str, help: str = "", labelnames=()) -> Gauge:
-        return self._get_or_create(Gauge, name, help, labelnames)
+    def gauge(
+        self, name: str, help: str = "", labelnames=(),
+        max_label_sets=None,
+    ) -> Gauge:
+        return self._get_or_create(
+            Gauge, name, help, labelnames,
+            max_label_sets=max_label_sets,
+        )
 
     def histogram(
         self,
@@ -278,9 +359,11 @@ class MetricsRegistry:
         help: str = "",
         labelnames=(),
         buckets=DEFAULT_BUCKETS,
+        max_label_sets=None,
     ) -> Histogram:
         return self._get_or_create(
-            Histogram, name, help, labelnames, buckets=buckets
+            Histogram, name, help, labelnames, buckets=buckets,
+            max_label_sets=max_label_sets,
         )
 
     def metrics(self) -> List[_Metric]:

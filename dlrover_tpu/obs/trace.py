@@ -33,6 +33,7 @@ emits ``grad_sync_probe``.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
@@ -41,8 +42,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 _TRACE_ENV = "DLROVER_TPU_TRACE"  # "0"/"false" disables at import
 
-# record layout: (name, tid, start_ns, dur_ns, depth, attrs-or-None)
-_Record = Tuple[str, int, int, int, int, Optional[dict]]
+# record layout: (name, tid, start_ns, dur_ns, depth, attrs-or-None, seq)
+# seq is a process-lifetime monotonic id (``drain`` cursors key on it)
+_Record = Tuple[str, int, int, int, int, Optional[dict], int]
 
 
 class _NoopSpan:
@@ -118,11 +120,23 @@ class SpanTracer:
         self.enabled = bool(enabled)
         self._buf: deque = deque(maxlen=max(int(capacity), 16))
         self._appended = 0  # total ever; dropped = appended - len(buf)
+        # process-lifetime record ids. Seq draw + append happen under
+        # one tiny lock so buffer order == seq order — without it, a
+        # thread preempted between next(seq) and append would let a
+        # HIGHER seq land first, and a drain cursor advancing past it
+        # would silently drop the straggler record forever (~100ns
+        # acquire vs the ~µs span cost the bench gate bounds)
+        self._seq = itertools.count()
+        self._end_lock = threading.Lock()
         # tid -> stack of live _OpenSpan (each thread mutates only its
         # own list; snapshots copy, so no lock is needed around them)
         self._stacks: Dict[int, list] = {}
         self._thread_names: Dict[int, str] = {}
         self._t0_ns = time.monotonic_ns()
+        # wall-clock anchor of the monotonic epoch: lets an offline
+        # tool (tools/merge_timeline.py) align traces from different
+        # processes/hosts onto one master-timestamp axis
+        self._wall_t0 = time.time()
         self._pid = os.getpid()
 
     # -- hot path ------------------------------------------------------
@@ -159,10 +173,14 @@ class SpanTracer:
             # is the observable symptom of the caller's bug
             while stack and stack.pop() is not sp:
                 pass
-        self._buf.append(
-            (sp.name, sp._tid, sp.start_ns, dur_ns, sp.depth, sp.attrs)
-        )
-        self._appended += 1
+        with self._end_lock:
+            self._buf.append(
+                (
+                    sp.name, sp._tid, sp.start_ns, dur_ns, sp.depth,
+                    sp.attrs, next(self._seq),
+                )
+            )
+            self._appended += 1
 
     def _cancel(self, sp: _OpenSpan):
         if sp._done:
@@ -203,6 +221,37 @@ class SpanTracer:
         in the fresh buffer)."""
         self._buf.clear()
         self._appended = 0
+
+    def drain(self, cursor: int = 0) -> Tuple[List[_Record], int, int]:
+        """``(records, new_cursor, dropped)`` — every completed span
+        with ``seq >= cursor`` still in the ring, in append order.
+
+        The incremental-consumer API (GoodputLedger): each record is
+        delivered exactly once per cursor chain, concurrent appends are
+        safe (records are immutable tuples, ``list(deque)`` snapshots
+        under the GIL), and a consumer lapped by the hot path learns
+        how many records it lost (``dropped``) instead of silently
+        double-counting or tearing."""
+        snap = list(self._buf)
+        fresh = [r for r in snap if r[6] >= cursor]
+        if not fresh:
+            return [], cursor, 0
+        dropped = max(0, fresh[0][6] - cursor) if cursor else 0
+        return fresh, fresh[-1][6] + 1, dropped
+
+    def open_span_records(
+        self, tid: Optional[int] = None
+    ) -> List[Tuple[str, int, int, int]]:
+        """``(name, tid, start_ns, depth)`` of every live span —
+        the raw-timestamp twin of :meth:`open_spans` (the ledger
+        attributes the elapsed part of still-open spans from this)."""
+        out = []
+        for t, stack in list(self._stacks.items()):
+            if tid is not None and t != tid:
+                continue
+            for sp in list(stack):
+                out.append((sp.name, t, sp.start_ns, sp.depth))
+        return out
 
     def open_spans(self, tid: Optional[int] = None) -> List[dict]:
         """Snapshot of every live span, outermost first per thread."""
@@ -262,7 +311,9 @@ class SpanTracer:
                     "args": {"name": tname},
                 }
             )
-        for name, tid, start_ns, dur_ns, depth, attrs in list(self._buf):
+        for name, tid, start_ns, dur_ns, depth, attrs, _seq in list(
+            self._buf
+        ):
             args: Dict[str, Any] = {"depth": depth}
             if attrs:
                 args.update(attrs)
@@ -277,7 +328,14 @@ class SpanTracer:
                     "args": args,
                 }
             )
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            # extra top-level keys are legal in the JSON object format;
+            # merge_timeline.py uses wall_t0_s for cross-worker clock
+            # alignment (ts 0 of this trace == this wall-clock second)
+            "otherData": {"wall_t0_s": self._wall_t0, "pid": self._pid},
+        }
 
     def dump(self, path: str) -> str:
         """Atomically write the Chrome-trace JSON to ``path``."""

@@ -35,6 +35,11 @@ from dlrover_tpu.ckpt.checkpointer import FlashCheckpointer, StorageType
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.models.config import TransformerConfig
 from dlrover_tpu.models.train import shard_batch
+from dlrover_tpu.obs.flight_recorder import (
+    ProfilerCapture,
+    default_recorder,
+)
+from dlrover_tpu.obs.goodput import GoodputLedger, install_default_ledger
 from dlrover_tpu.obs.metrics import default_registry, fold_pipeline_stats
 from dlrover_tpu.obs.trace import SpanHeartbeat, span
 from dlrover_tpu.trainer.elastic.dataloader import ElasticDataLoader
@@ -317,6 +322,38 @@ class ElasticTrainer:
         )
         if self._span_heartbeat is not None:
             self._span_heartbeat.start()
+        # -- goodput ledger + crash forensics (obs/goodput, obs/
+        # flight_recorder): every second of this trainer's wall time is
+        # attributed to the closed taxonomy and exported at log
+        # cadence; the flight recorder dumps a bundle on crash, hang
+        # (its own watchdog thread) or degraded-mode entry, and the
+        # master can request dumps/profiles via the command file
+        self._goodput = install_default_ledger(
+            GoodputLedger(tid_fn=lambda: self._train_tid)
+        )
+        self._replay_until_step: Optional[int] = None
+        self._flight = default_recorder()
+        self._flight.set_identity(
+            node_id=int(os.getenv("DLROVER_TPU_NODE_ID", "0") or 0),
+            job_name=os.getenv("DLROVER_TPU_JOB_NAME", ""),
+            mesh=str(self.accel.strategy.mesh.axis_sizes()),
+            model=type(model_cfg).__name__,
+        )
+        if self.tcfg.report_metrics:
+            self._flight.start_watchdog(
+                hang_dump_after_s=float(
+                    os.getenv("DLROVER_TPU_HANG_DUMP_AFTER_S", "120")
+                ),
+                tid_fn=lambda: self._train_tid,
+            )
+        self._profiler_capture = ProfilerCapture()
+        # the command file outlives a worker restart, but its commands
+        # target the PREVIOUS incarnation (dump THAT process, profile
+        # THAT hang) — start past them instead of replaying stale
+        # forensics against a healthy fresh process
+        from dlrover_tpu.agent.monitor import last_command_id
+
+        self._last_command_id = last_command_id()
         self.state = self.accel.init_fn(jax.random.PRNGKey(0))
         self._grad_sync_plan = None
         # measured link-cost model (parallel/topology.py): probe once
@@ -625,6 +662,7 @@ class ElasticTrainer:
         return {"train": strip_residual(self.state), "sampler": samp}
 
     def _maybe_restore(self):
+        from dlrover_tpu.agent.monitor import read_runtime_metrics
         from dlrover_tpu.parallel.grad_sync import ensure_residual
 
         step, restored = self._ckptr.load_checkpoint(self._ckpt_state())
@@ -634,6 +672,23 @@ class ElasticTrainer:
             )
             self.sampler.load_state_dict(restored["sampler"])
             logger.info(f"resumed from flash checkpoint step {step}")
+            # restart-replay accounting: the runtime-metrics file
+            # outlives the previous incarnation, so the step it had
+            # already published tells us how much progress this restore
+            # lost — steps up to it re-earn old work and the goodput
+            # ledger books that wall time as restart_replay, not
+            # productive_compute
+            if self.tcfg.report_metrics:
+                prev_step = int(
+                    read_runtime_metrics().get("global_step", -1) or -1
+                )
+                if prev_step > step:
+                    self._replay_until_step = prev_step
+                    self._goodput.replay_begin()
+                    logger.info(
+                        f"replaying lost progress: steps {step}.."
+                        f"{prev_step} count as restart_replay"
+                    )
 
     def save(self, storage: StorageType = StorageType.MEMORY) -> bool:
         if self._ckptr is None:
@@ -1506,6 +1561,17 @@ class ElasticTrainer:
         self._evals_since_best = 0
         try:
             return self._train_loop(num_steps, t0, start_step)
+        except BaseException as e:
+            # crash flight recorder: the black box dumps BEFORE the
+            # exception unwinds past the trainer (stacks, last-N spans,
+            # metrics, recent events) — by the time a human reads the
+            # worker log, the process is long gone
+            if not isinstance(e, (KeyboardInterrupt, SystemExit)):
+                # force past the rate limiter: the process is about to
+                # die and the exception is evidence no earlier dump
+                # (hang watchdog, degraded episode) captured
+                self._flight.dump("crash", exc=e, force=True)
+            raise
         finally:
             self._close_prefetcher()
             try:
@@ -1540,11 +1606,63 @@ class ElasticTrainer:
                 f"dlrover_train_{k}", "training scalar"
             ).set(v)
         fold_pipeline_stats(self.pipeline_stats, self._registry)
+        # goodput accounting rides the same export: collect the window
+        # since the last report and publish the dlrover_goodput_*
+        # gauges (the aggregator re-assembles the fleet number from
+        # these scalars)
+        self._goodput.export(self._registry)
+        self._poll_worker_commands()
         if self.tcfg.report_metrics:
             report_runtime_metrics(
                 step, **{**scalars, **self._registry.scalars()}
             )
         return scalars
+
+    def _poll_worker_commands(self):
+        """Execute master->worker commands relayed by the agent
+        (flight dumps, profiler captures). Log-cadence polling of one
+        small JSON file; ids are master-monotonic so a command runs
+        exactly once per process."""
+        from dlrover_tpu.agent.monitor import read_worker_commands
+
+        try:
+            cmds = read_worker_commands()
+        except Exception:
+            return
+        for c in cmds:
+            try:
+                cid = int(c.get("id", 0))
+            except (TypeError, ValueError):
+                continue
+            if cid <= self._last_command_id:
+                continue
+            self._last_command_id = cid
+            kind = c.get("kind", "")
+            reason = str(c.get("reason", "") or "master_request")
+            if kind == "flight_dump":
+                logger.info(
+                    f"master requested flight dump (#{cid}, {reason})"
+                )
+                self._flight.dump(f"request_{reason}")
+            elif kind == "profile":
+                steps = int(c.get("arg", 0) or 3)
+                if self._profiler_capture.request(steps, reason=reason):
+                    logger.info(
+                        f"master requested profiler capture (#{cid}, "
+                        f"{steps} steps, {reason})"
+                    )
+                else:
+                    # refusal is the artifact-volume bound working
+                    # (live capture / cooldown), but it must be
+                    # visible — the master believes evidence is coming
+                    logger.warning(
+                        f"profiler capture request #{cid} ({reason}) "
+                        f"refused: capture active or cooling down"
+                    )
+            else:
+                logger.warning(
+                    f"unknown worker command kind {kind!r} (#{cid})"
+                )
 
     def _train_loop(self, num_steps: int, t0, start_step) -> Any:
         import jax
@@ -1561,6 +1679,9 @@ class ElasticTrainer:
             # (modulo the prefetch rewind in _ckpt_state)
             batches = self._epoch_batches(num_steps)
             while True:
+                # on-demand jax.profiler capture (no-op unless a master
+                # `profile` command armed it)
+                self._profiler_capture.on_step_begin()
                 # the step span + its phase children are the trace's
                 # spine: a dump shows where each step's wall time went
                 # (docs/observability.md span taxonomy). An exception
@@ -1646,9 +1767,18 @@ class ElasticTrainer:
                     with span("ckpt_save"):
                         self._maybe_save(step)
                     step_sp.end()
+                    self._profiler_capture.on_step_end()
                     self._observe_step_time(
                         time.perf_counter() - step_t0
                     )
+                    if (
+                        self._replay_until_step is not None
+                        and step >= self._replay_until_step
+                    ):
+                        # caught back up to the pre-restart frontier:
+                        # wall time is productive again
+                        self._goodput.replay_end()
+                        self._replay_until_step = None
                 except BaseException:
                     step_sp.cancel()
                     raise
@@ -1703,6 +1833,8 @@ class ElasticTrainer:
         if self._span_heartbeat is not None:
             self._span_heartbeat.stop()
             self._span_heartbeat = None
+        self._flight.stop_watchdog()
+        self._profiler_capture.abort()
         self._close_prefetcher()
         self._abort_stager()
         if self._spec_compiler is not None:

@@ -271,12 +271,16 @@ def run(args) -> int:
             ParalConfigTuner,
             ResourceMonitor,
             TrainingMonitor,
+            WorkerCommandRelay,
         )
 
         monitors += [
             ResourceMonitor(client),
             TrainingMonitor(client),
             ParalConfigTuner(client),
+            # master->worker forensics channel: flight-dump / profile
+            # requests land in the command file the trainer polls
+            WorkerCommandRelay(client),
         ]
         for m in monitors:
             m.start()

@@ -318,6 +318,9 @@ def test_hbm_gate_tristate_consistent_across_search_paths(monkeypatch):
     assert dr_mod.hbm_fits(0.0, None) is True  # no budget -> no gate
 
 
+@pytest.mark.slow  # ~23s end-to-end TPE search + compile; the TPE
+# machinery itself (tpe_propose/tpe_search, hbm gating, dry-run
+# consistency) stays tier-1 in the unit tests above — budget
 def test_auto_accelerate_bayes_search():
     """The TPE path returns a measured, trainable winner."""
     cfg = tiny(num_layers=2)

@@ -166,6 +166,9 @@ class TestMoE:
         assert d[1, 1, 0] == 1  # token 1 primary kept (NOT evicted)
         assert d.sum() == 2  # both secondaries dropped
 
+    @pytest.mark.slow  # ~28s quality A/B (two full toy trainings);
+    # routing correctness (dispatch/combine, capacity drops, EP-vs-
+    # dense parity) stays tier-1 in the other TestMoE tests — budget
     def test_top2_beats_top1_on_toy_task(self):
         """Cluster-structured regression where each cluster needs TWO
         experts' capacity: training the tiny MoE LM with top-2 routing

@@ -42,7 +42,7 @@ class TestSpanTracer:
         with t.span("work"):
             time.sleep(0.005)
         assert len(t) == 1
-        name, tid, start_ns, dur_ns, depth, attrs = list(t._buf)[0]
+        name, tid, start_ns, dur_ns, depth, attrs, _seq = list(t._buf)[0]
         assert name == "work"
         assert tid == threading.get_ident()
         assert dur_ns >= 4_000_000  # slept 5ms

@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Merge per-worker Chrome traces + master node events into ONE
+Perfetto-loadable cross-worker timeline.
+
+Each worker's ``SpanTracer`` dumps a trace whose ``ts`` axis is its own
+process-local monotonic clock — loading two of them side by side tells
+you nothing about *simultaneity* (did worker 3's ``ckpt_commit`` stall
+while worker 0 was resizing, or an hour earlier?). Every trace carries
+its wall-clock anchor for exactly this purpose:
+``otherData.wall_t0_s`` is the ``time.time()`` instant at which that
+tracer's ``ts == 0``. This tool re-bases every input onto one shared
+axis (the earliest anchor across all inputs), assigns each worker its
+own Perfetto process row, and overlays the master's node events
+(restarts, degraded episodes, straggler flags, injected faults) as
+instant markers — so one artifact answers "what was the whole fleet
+doing when X happened".
+
+Usage::
+
+    python tools/merge_timeline.py -o merged.json \
+        worker0_trace.json worker1_trace.json \
+        --events node_events.json
+
+``--events`` accepts either shape found in this repo:
+
+- the master's ``job_manager.node_events()`` rows
+  (``{"node_type", "node_id", "event", "detail", "ts"}``), or
+- a flight-recorder bundle's ``events.json``
+  (``{"ts", "kind", "detail"}``);
+
+both use wall-clock ``ts`` seconds, which is the shared axis already.
+
+Traces predating the ``wall_t0_s`` anchor still merge (offset 0,
+flagged in ``otherData.unaligned``) — you lose alignment, not data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+MASTER_PID = 0  # the synthetic process row node events land on
+
+
+def _anchor_s(trace: dict) -> Optional[float]:
+    """The trace's wall-clock second at ts=0 (None for pre-anchor
+    artifacts)."""
+    other = trace.get("otherData")
+    if isinstance(other, dict) and "wall_t0_s" in other:
+        try:
+            return float(other["wall_t0_s"])
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+def _normalize_event(e: dict) -> Optional[Tuple[float, str, dict]]:
+    """(wall_ts_s, name, args) from either node-event shape."""
+    try:
+        ts = float(e["ts"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    name = str(e.get("event") or e.get("kind") or "event")
+    args = {
+        k: e[k]
+        for k in ("node_type", "node_id", "detail")
+        if e.get(k) not in (None, "")
+    }
+    return ts, name, args
+
+
+def merge_traces(
+    traces: List[dict],
+    labels: Optional[List[str]] = None,
+    events: Optional[List[dict]] = None,
+) -> dict:
+    """Pure merge: re-based copies of every input's events on one
+    shared microsecond axis, one pid per input trace (master events on
+    pid 0). Raises ValueError when no input carries events."""
+    labels = list(labels or [])
+    while len(labels) < len(traces):
+        labels.append(f"worker{len(labels)}")
+
+    anchors = [_anchor_s(t) for t in traces]
+    known = [a for a in anchors if a is not None]
+    norm_events = []
+    for e in events or []:
+        ne = _normalize_event(e)
+        if ne is not None:
+            norm_events.append(ne)
+    # the shared axis origin: the earliest thing we can place on it
+    candidates = known + [ts for ts, _, _ in norm_events]
+    t_ref = min(candidates) if candidates else 0.0
+
+    out: List[dict] = []
+    unaligned: List[str] = []
+    for i, (trace, label, anchor) in enumerate(
+        zip(traces, labels, anchors)
+    ):
+        pid = i + 1  # distinct Perfetto process row per worker
+        if anchor is None:
+            offset_us = 0.0
+            unaligned.append(label)
+        else:
+            offset_us = (anchor - t_ref) * 1e6
+        out.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": label},
+            }
+        )
+        for e in trace.get("traceEvents", []):
+            if not isinstance(e, dict):
+                continue
+            ne = dict(e)
+            ne["pid"] = pid
+            if "ts" in ne:
+                ne["ts"] = ne["ts"] + offset_us
+            out.append(ne)
+    if norm_events:
+        out.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": MASTER_PID,
+                "tid": 0,
+                "args": {"name": "master events"},
+            }
+        )
+        for ts, name, args in sorted(norm_events):
+            out.append(
+                {
+                    "ph": "i",
+                    "s": "g",  # global scope: draws across all rows
+                    "name": name,
+                    "pid": MASTER_PID,
+                    "tid": 0,
+                    "ts": (ts - t_ref) * 1e6,
+                    "args": args,
+                }
+            )
+    if not out:
+        raise ValueError("no events to merge")
+    merged = {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "wall_t0_s": t_ref,
+            "sources": labels[: len(traces)],
+        },
+    }
+    if unaligned:
+        merged["otherData"]["unaligned"] = unaligned
+    return merged
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="merge per-worker Chrome traces + master node "
+        "events into one aligned timeline"
+    )
+    p.add_argument("traces", nargs="+", help="per-worker trace JSONs")
+    p.add_argument(
+        "-o", "--out", default="merged_timeline.json",
+        help="output path (default: merged_timeline.json)",
+    )
+    p.add_argument(
+        "--events", default="",
+        help="node-events JSON (master node_events dump or a "
+        "flight-recorder bundle's events.json)",
+    )
+    args = p.parse_args(argv)
+
+    traces: List[dict] = []
+    labels: List[str] = []
+    for path in args.traces:
+        with open(path) as f:
+            traces.append(json.load(f))
+        labels.append(os.path.splitext(os.path.basename(path))[0])
+    events = None
+    if args.events:
+        with open(args.events) as f:
+            payload = json.load(f)
+        events = payload if isinstance(payload, list) else (
+            payload.get("events") or payload.get("node_events") or []
+        )
+
+    merged = merge_traces(traces, labels, events)
+
+    from dlrover_tpu.obs.trace import validate_chrome_trace
+
+    ok, reason = validate_chrome_trace(merged)
+    if not ok:
+        print(f"merged timeline INVALID: {reason}", file=sys.stderr)
+        return 1
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    n = len(merged["traceEvents"])
+    print(
+        f"wrote {args.out}: {n} events from {len(traces)} trace(s)"
+        + (f" + {len(events)} node event(s)" if events else "")
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1735,6 +1735,223 @@ def run_topology_bench(jax, results: dict, smoke: bool = False):
         topology.reset_link_model()
 
 
+# the dp x tp explicit sync runs the same psum in the same order as
+# GSPMD's, but the partitioner makes different matmul splits inside vs
+# outside the partial-manual region — parity is float-noise-tight
+# (measured ~2e-7 after 6 steps) rather than bitwise; dp x fsdp IS
+# bitwise (the ZeRO composition reproduces GSPMD's reduction grouping)
+HYBRID_TP_PARITY_GATE = 1e-5
+
+
+def run_hybrid_sync_bench(jax, results: dict, smoke: bool = False):
+    """Hybrid-mesh overlap sync (ISSUE 8): the explicit bucketed
+    gradient sync on model-sharded meshes.
+
+    Three legs on emulated CPU meshes:
+
+    - **dp2 x fsdp2 three-way** (GSPMD / explicit ZeRO / int8+EF):
+      the explicit path must engage (``hybrid_sync_path_fsdp=
+      explicit``, no GSPMD-fallback log), train **bitwise-identical**
+      to GSPMD at fp32 (the ZeRO reduce-scatter-into-shards schedule
+      reproduces GSPMD's own reduction grouping), move strictly fewer
+      ring bytes than the monolithic all-reduce
+      (``hybrid_sync_fsdp_wire_bytes < hybrid_sync_gspmd_wire_
+      bytes`` — no fsdp all-gather leg, dp legs ride the 1/fsdp
+      chunk), and the int8+error-feedback composition on the dp axis
+      must land within ``GRAD_SYNC_LOSS_GATE`` of the fp32 baseline;
+    - **dp2 x tp2 A/B** (GSPMD / explicit): the bucketed dp-axis sync
+      runs under the GSPMD tp submesh (partial-manual shard_map);
+      parity is gated at ``HYBRID_TP_PARITY_GATE`` (see the constant:
+      the sync is order-identical, the matmul partitioning is not);
+    - **warm dp x tp resize**: an ElasticTrainer on dp2 x tp2 resizes
+      to dp4 x tp2 (cold) and back (warm, AOT cache hit) — the
+      per-dimension reshard path at work on a model-sharded mesh,
+      reported as ``resize_downtime_warm_tp_ms`` alongside the
+      DP-only ``resize_downtime_warm_ms``.
+    """
+    import optax
+
+    from dlrover_tpu.accel.strategy import Strategy
+    from dlrover_tpu.models import tiny
+    from dlrover_tpu.models.train import (
+        build_train_step,
+        init_sharded_state,
+        shard_batch,
+    )
+    from dlrover_tpu.parallel import grad_sync
+    from dlrover_tpu.parallel.grad_sync import (
+        ensure_residual,
+        plan_for_mesh,
+        resolve_plan,
+    )
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    devs = list(jax.devices())
+    if len(devs) < 4:
+        results["hybrid_sync_error"] = (
+            f"hybrid sync bench needs >= 4 devices, have {len(devs)}"
+        )
+        return
+    cfg = tiny(num_layers=1) if smoke else tiny()
+    cfg = replace(cfg, dtype="float32", param_dtype="float32")
+    tx = optax.adamw(1e-2)
+    steps = 6 if smoke else 12
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+
+    def run(mesh, comm_overlap: bool, compress: str) -> float:
+        state, _ = init_sharded_state(
+            jax.random.PRNGKey(0), cfg, mesh, tx
+        )
+        step = build_train_step(
+            cfg, mesh, tx, donate=False,
+            comm_overlap=comm_overlap, grad_compress=compress,
+            grad_bucket_mb=1,
+        )
+        if compress == "int8":
+            plan = plan_for_mesh(
+                cfg, mesh, grad_compress="int8", grad_bucket_mb=1
+            )
+            state = ensure_residual(state, plan, mesh)
+        b = shard_batch({"x": x, "y": x}, mesh)
+        for _ in range(steps):
+            state, metrics = step(state, b["x"], b["y"])
+        return float(metrics["loss"])
+
+    def fallback_key(mc):
+        sizes = mc.axis_sizes()
+        return tuple(sorted((k, int(v)) for k, v in sizes.items()))
+
+    # -- leg 1: dp2 x fsdp2 three-way ------------------------------------
+    mc_fsdp = MeshConfig(dp=2, fsdp=2)
+    mesh_fsdp = build_mesh(mc_fsdp, devices=devs[:4])
+    plan = resolve_plan(
+        cfg,
+        Strategy(
+            mesh=mc_fsdp, dtype="float32", comm_overlap=True,
+            grad_bucket_mb=1,
+        ),
+    )
+    results["hybrid_sync_path_fsdp"] = (
+        "explicit" if plan is not None else "gspmd"
+    )
+    results["hybrid_sync_fsdp_wire_bytes"] = plan.explicit_wire_bytes()
+    results["hybrid_sync_gspmd_wire_bytes"] = (
+        plan.gspmd_allreduce_bytes()
+    )
+    results["hybrid_sync_fsdp_wire_vs_gspmd"] = round(
+        plan.explicit_wire_bytes() / plan.gspmd_allreduce_bytes(), 4
+    )
+    loss_gspmd = run(mesh_fsdp, False, "none")
+    loss_zero = run(mesh_fsdp, True, "none")
+    loss_int8 = run(mesh_fsdp, True, "int8")
+    results["hybrid_sync_loss_fsdp_gspmd"] = round(loss_gspmd, 6)
+    results["hybrid_sync_loss_fsdp_explicit"] = round(loss_zero, 6)
+    # fp32 bit parity: same math, same reduction grouping — any drift
+    # is a correctness bug, not noise
+    results["hybrid_sync_parity_fsdp"] = bool(loss_zero == loss_gspmd)
+    results["hybrid_sync_int8_loss_gap"] = round(
+        abs(loss_int8 - loss_gspmd), 5
+    )
+
+    # -- leg 2: dp2 x tp2 A/B --------------------------------------------
+    mc_tp = MeshConfig(dp=2, tp=2)
+    mesh_tp = build_mesh(mc_tp, devices=devs[:4])
+    plan_tp = resolve_plan(
+        cfg,
+        Strategy(
+            mesh=mc_tp, dtype="float32", comm_overlap=True,
+            grad_bucket_mb=1,
+        ),
+    )
+    results["hybrid_sync_path_tp"] = (
+        "explicit" if plan_tp is not None else "gspmd"
+    )
+    loss_tp_gspmd = run(mesh_tp, False, "none")
+    loss_tp_expl = run(mesh_tp, True, "none")
+    results["hybrid_sync_loss_tp_gspmd"] = round(loss_tp_gspmd, 6)
+    results["hybrid_sync_loss_tp_explicit"] = round(loss_tp_expl, 6)
+    results["hybrid_sync_tp_loss_gap"] = abs(
+        loss_tp_expl - loss_tp_gspmd
+    )
+    results["hybrid_sync_parity_tp"] = bool(
+        abs(loss_tp_expl - loss_tp_gspmd) <= HYBRID_TP_PARITY_GATE
+    )
+    # neither mesh may have taken the silent fallback (the once-per-
+    # mesh log also records which meshes fell back)
+    results["hybrid_sync_no_fallback_log"] = bool(
+        fallback_key(mc_fsdp) not in grad_sync._GSPMD_FALLBACK_LOGGED
+        and fallback_key(mc_tp) not in grad_sync._GSPMD_FALLBACK_LOGGED
+    )
+
+    # -- leg 3: warm dp x tp resize via the AOT cache --------------------
+    if len(devs) < 8:
+        results["hybrid_resize_note"] = (
+            "skipped: resize leg needs 8 devices"
+        )
+        return
+    from dlrover_tpu.trainer.elastic.trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
+
+    class _Tokens:
+        def __init__(self, n=128, seq=32, vocab=256):
+            rng = np.random.default_rng(0)
+            self.data = rng.integers(
+                0, vocab, (n, seq + 1), dtype=np.int32
+            )
+
+        def __len__(self):
+            return len(self.data)
+
+        def __getitem__(self, i):
+            return {"x": self.data[i][:-1], "y": self.data[i][1:]}
+
+    trainer = ElasticTrainer(
+        model_cfg=tiny(num_layers=1) if smoke else tiny(),
+        tx=optax.adamw(1e-2),
+        dataset=_Tokens(),
+        trainer_cfg=TrainerConfig(
+            batch_size=8,
+            seq_len=32,
+            report_metrics=False,
+            log_interval=1000,
+            prefetch=2,
+            donation_aware=False,
+            speculative_compile=False,
+            comm_overlap=True,
+        ),
+        strategy=Strategy(mesh=MeshConfig(dp=2, tp=2), dtype="float32"),
+        devices=devs[:4],
+    )
+    try:
+        results["hybrid_sync_path_trainer"] = (
+            trainer.pipeline_stats.grad_sync_path
+        )
+        trainer.train(num_steps=2)
+        cold = trainer.resize(8)  # dp4 x tp2: never compiled
+        trainer.train(num_steps=4)
+        warm = trainer.resize(4)  # back to dp2 x tp2: AOT cache hit
+        trainer.train(num_steps=6)
+        results["resize_downtime_cold_tp_ms"] = round(
+            cold["downtime_ms"], 2
+        )
+        results["resize_downtime_warm_tp_ms"] = round(
+            warm["downtime_ms"], 2
+        )
+        results["hybrid_resize_cache_hit"] = bool(
+            warm["compile_cache_hit"]
+        )
+        results["hybrid_resize_note"] = (
+            "dp2xtp2 -> dp4xtp2 (cold) -> dp2xtp2 (warm AOT hit): the "
+            "per-dimension reshard keeps tp shards on device while dp "
+            "absorbs the delta; explicit sync re-planned per world"
+        )
+    finally:
+        trainer.close()
+
+
 # tracer overhead gate (docs/observability.md): with tracing enabled the
 # measured step time may exceed the disabled baseline by at most this —
 # the span tracer's contract is "cheap enough to leave on in production"
@@ -2213,6 +2430,10 @@ def run_smoke() -> int:
     except Exception as e:
         results["topology_error"] = repr(e)
     try:
+        run_hybrid_sync_bench(jax, results, smoke=True)
+    except Exception as e:
+        results["hybrid_sync_error"] = repr(e)
+    try:
         run_trace_bench(jax, results, smoke=True)
     except Exception as e:
         results["trace_error"] = repr(e)
@@ -2262,6 +2483,29 @@ def run_smoke() -> int:
         and results["grad_sync_2level_wire_vs_flat"] < 1.0
         and results.get("grad_sync_2level_parity") is True
         and results.get("dry_run_priced_from_link_model") is True
+        # the hybrid-mesh gates (ISSUE 8): the explicit path must
+        # engage on dp x fsdp and dp x tp meshes (no silent GSPMD
+        # fallback), fsdp fp32 must be BITWISE with GSPMD and its
+        # ZeRO schedule must move strictly fewer ring bytes than the
+        # monolithic all-reduce, int8+EF on the dp axis must track
+        # the baseline, and a dp x tp mesh must resize warm through
+        # the AOT cache
+        and "hybrid_sync_error" not in results
+        and results.get("hybrid_sync_path_fsdp") == "explicit"
+        and results.get("hybrid_sync_path_tp") == "explicit"
+        and results.get("hybrid_sync_path_trainer") == "explicit"
+        and results.get("hybrid_sync_no_fallback_log") is True
+        and results.get("hybrid_sync_parity_fsdp") is True
+        and results.get("hybrid_sync_parity_tp") is True
+        and results.get("hybrid_sync_fsdp_wire_bytes") is not None
+        and (
+            results["hybrid_sync_fsdp_wire_bytes"]
+            < results["hybrid_sync_gspmd_wire_bytes"]
+        )
+        and results.get("hybrid_sync_int8_loss_gap") is not None
+        and results["hybrid_sync_int8_loss_gap"] <= GRAD_SYNC_LOSS_GATE
+        and results.get("resize_downtime_warm_tp_ms") is not None
+        and results.get("hybrid_resize_cache_hit") is True
         # the telemetry gates: the dumped trace must be valid Chrome-
         # trace JSON whose step spans are explained by their phase
         # children, and tracing must stay cheap enough to leave on
@@ -2444,6 +2688,11 @@ def main() -> int:
     except Exception as e:
         results["grad_sync_2level_wire_vs_flat"] = None
         results["topology_error"] = repr(e)
+    try:
+        run_hybrid_sync_bench(jax, results)
+    except Exception as e:
+        results["hybrid_sync_parity_fsdp"] = None
+        results["hybrid_sync_error"] = repr(e)
     try:
         run_trace_bench(jax, results)
     except Exception as e:

@@ -253,21 +253,24 @@ def _comm_estimate(
     full-fat twin rank identically).
 
     Models what build_train_step actually does: the explicit scheduler
-    (pure-DP mesh + comm_overlap/grad_compress) syncs ONCE per
-    optimizer step and hides OVERLAP_HIDDEN_FRACTION of the wire time
-    behind backward compute; the GSPMD default path syncs every
+    (a ``resolve_sync_mode``-qualifying mesh — pure-dp, dp x fsdp, or
+    dp x tp/sp — with comm_overlap/grad_compress requested) syncs ONCE
+    per optimizer step and hides OVERLAP_HIDDEN_FRACTION of the wire
+    time behind backward compute; the GSPMD default path syncs every
     microbatch at full precision with no overlap credit. Wire seconds
     are priced per link from ``topology.get_link_model()`` — a hybrid
     dp axis bills its ICI and DCN legs at their own measured rates, a
     data axis listed whole in ``dcn_axes`` bills the flat ring at DCN
-    rate, and fsdp/tp meshes stop inheriting the flat-ICI constant
-    silently (the fallback model reproduces it, logged once)."""
+    rate, the explicit fsdp path bills the ZeRO reduce-scatter plus
+    chunk-sized dp legs, and unsupported (pp/ep/3D) meshes stop
+    inheriting the flat-ICI constant silently (the fallback model
+    reproduces it, logged once)."""
     from dlrover_tpu.accel.profiler import profile_model
     from dlrover_tpu.parallel.grad_sync import (
         OVERLAP_HIDDEN_FRACTION,
-        _qualifying_dp,
         comm_bytes_per_device,
         comm_time_per_device_s,
+        resolve_sync_mode,
     )
 
     s = report.strategy
@@ -279,8 +282,8 @@ def _comm_estimate(
     param_bytes = prof.total_params * p_bytes
     # the shared mesh gate — this cost model must engage the explicit
     # path for exactly the meshes the step builder does
-    explicit = bool(
-        _qualifying_dp(m.axis_sizes())
+    explicit = (
+        resolve_sync_mode(m.axis_sizes()) is not None
     ) and s.resolved_comm_overlap()
     if explicit:
         one_sync = comm_bytes_per_device(

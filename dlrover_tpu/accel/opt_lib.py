@@ -108,12 +108,15 @@ register_optimization(
     lambda cfg, s: (cfg, dc_replace(s, offload_opt=True)),
 )
 # overlap-scheduled gradient sync (parallel/grad_sync.py): bucketed
-# per-bucket reduce-scatter under shard_map on pure-DP meshes — XLA
+# per-bucket collectives under shard_map — RS+AG on pure-dp meshes,
+# ZeRO reduce-scatter into the fsdp shard layout on dp x fsdp, the
+# bucketed dp sync under the GSPMD tp submesh on dp x tp/sp — XLA
 # gets independent collectives it can overlap with backward compute,
 # and grad_accum syncs once per optimizer step instead of per
 # microbatch. Tunable: auto_accelerate's candidate stamping may apply
-# it across the whole candidate list; non-qualifying meshes fall back
-# to the GSPMD default schedule inside build_train_step.
+# it across the whole candidate list; non-qualifying meshes (pp/ep/3D)
+# fall back to the GSPMD default schedule inside build_train_step with
+# a once-per-mesh log.
 register_optimization(
     "comm_overlap",
     lambda cfg, s: (cfg, dc_replace(s, comm_overlap=True)),

@@ -72,7 +72,17 @@ class PipelineStats:
     reshard_bytes_host: int = 0  # leaves that fell back to shm restore
     resize_count: int = 0
     resize_downtime_ms: float = 0.0  # last resize's wall downtime
+    # ranks left idle by the last resize's graceful degradation (a
+    # non-divisible device count picks the largest valid mesh <= n
+    # instead of failing; also dlrover_resize_idle_ranks gauge)
+    resize_idle_ranks: int = 0
     # -- overlap-scheduled gradient sync (parallel/grad_sync.py) -------
+    # which gradient-sync schedule the current mesh runs: "explicit"
+    # (the bucketed scheduler engaged) or "gspmd" (fallback — was
+    # silent-by-design before ISSUE 8; now visible in bench output and
+    # the metrics registry via the numeric grad_sync_explicit twin).
+    # "" until a trainer resolves the plan.
+    grad_sync_path: str = ""
     # standalone wall time of one bucketed sync (its roofline: the
     # in-step cost is this minus whatever the scheduler overlaps)
     grad_sync_ms: float = 0.0
@@ -142,6 +152,16 @@ class PipelineStats:
             ],
             "resize_count": self.resize_count,
             "resize_downtime_ms": round(self.resize_downtime_ms, 2),
+            "resize_idle_ranks": self.resize_idle_ranks,
+            "grad_sync_path": self.grad_sync_path,
+            # numeric twin for the metrics registry (fold_pipeline_
+            # stats skips strings): 1 = explicit, 0 = gspmd fallback,
+            # None = no trainer resolved a plan yet
+            "grad_sync_explicit": (
+                None
+                if not self.grad_sync_path
+                else int(self.grad_sync_path == "explicit")
+            ),
             "grad_sync_ms": round(self.grad_sync_ms, 3),
             "grad_sync_ici_ms": round(self.grad_sync_ici_ms, 3),
             "grad_sync_dcn_ms": round(self.grad_sync_dcn_ms, 3),
@@ -176,13 +196,15 @@ class PipelineStats:
             if self.overlap_pct_measured is not None
             else ""
         )
+        path = f" [{self.grad_sync_path}]" if self.grad_sync_path else ""
         gsync = (
-            f", grad sync {self.grad_sync_ms:.1f} ms standalone{legs} "
+            f", grad sync{path} {self.grad_sync_ms:.1f} ms "
+            f"standalone{legs} "
             f"({'-' if self.comm_overlap_pct is None else self.comm_overlap_pct}"
             f"% overlapped{measured}, {self.grad_bytes_wire >> 10} KiB "
             f"wire vs {self.grad_bytes_raw >> 10} KiB raw per sync)"
             if self.grad_bytes_raw
-            else ""
+            else (f", grad sync{path}" if self.grad_sync_path else "")
         )
         return (
             f"prefetch {self.prefetch_hits}h/{self.prefetch_misses}m"

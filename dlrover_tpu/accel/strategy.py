@@ -37,10 +37,11 @@ class Strategy:
     # only (pp>1 keeps its own state layout on device)
     offload_opt: bool = False
     # explicit overlap-scheduled gradient sync (parallel/grad_sync.py):
-    # bucketed reduce-scatter under shard_map on pure-DP meshes, one
-    # sync per optimizer step under grad_accum. Engages only where the
-    # mesh qualifies (dp>1, other axes 1) — elsewhere the step builder
-    # falls back to the GSPMD default schedule with a log.
+    # bucketed collectives under shard_map, one sync per optimizer
+    # step under grad_accum. Engages where the mesh qualifies
+    # (resolve_sync_mode: pure-dp, dp x fsdp ZeRO, dp x tp/sp) —
+    # pp/ep/3D meshes fall back to the GSPMD default schedule with a
+    # once-per-mesh log.
     comm_overlap: bool = False
     # "none" | "int8": int8-quantized collective payloads with
     # per-bucket shared scales, int32 accumulation and error feedback

@@ -42,6 +42,25 @@ class ReshardReport:
     moved_leaves: int = 0  # rebuilt on device under the new sharding
     fallback_paths: List[str] = field(default_factory=list)
     elapsed_s: float = 0.0
+    # per-dimension reshard plan: mesh axes whose degree changed
+    # between the source and target worlds, axis -> (old, new). A tp
+    # entry here means model-axis stitching ran, not just a dp/fsdp
+    # absorb (docs/elastic-resize.md per-dimension reshard rules).
+    axis_changes: Dict[str, Tuple[int, int]] = field(
+        default_factory=dict
+    )
+    # target shards that had to be assembled from MULTIPLE overlapping
+    # source shards (the multi-source stitching path — e.g. a tp-degree
+    # shrink concatenating two old shards, or a non-pow2 transition)
+    stitched_shards: int = 0
+
+    def describe_axis_changes(self) -> str:
+        if not self.axis_changes:
+            return "no axis changes"
+        return ", ".join(
+            f"{a} {old}->{new}"
+            for a, (old, new) in sorted(self.axis_changes.items())
+        )
 
 
 def _keystr(kp) -> str:
@@ -81,6 +100,31 @@ def _source_shards(leaf) -> Optional[List[Tuple[Index, Any]]]:
     return list(out.items())
 
 
+def _axis_changes(old_leaf, new_sharding) -> Dict[str, Tuple[int, int]]:
+    """Per-dimension reshard plan: mesh axes whose degree differs
+    between a live leaf's sharding and its target — the resize log's
+    answer to "what actually changed" (a dp/fsdp absorb vs a tp-degree
+    stitch are different stories at the same byte count)."""
+    try:
+        old_mesh = old_leaf.sharding.mesh
+        old_sizes = dict(
+            zip(old_mesh.axis_names, old_mesh.devices.shape)
+        )
+        new_mesh = new_sharding.mesh
+        new_sizes = dict(
+            zip(new_mesh.axis_names, new_mesh.devices.shape)
+        )
+    except Exception:
+        return {}
+    out: Dict[str, Tuple[int, int]] = {}
+    for a in sorted(set(old_sizes) | set(new_sizes)):
+        o = int(old_sizes.get(a, 1))
+        n = int(new_sizes.get(a, 1))
+        if o != n:
+            out[a] = (o, n)
+    return out
+
+
 def _overlap(a: Index, b: Index):
     """Intersection of two index blocks, or None."""
     out = []
@@ -96,7 +140,8 @@ def _assemble_target_shard(
     want: Index, dtype, sources: List[Tuple[Index, Any]], device
 ):
     """Build the ``want`` block on ``device`` from overlapping on-device
-    sources. Returns None when the sources don't cover ``want``.
+    sources. Returns ``(block, n_sources_used)``; ``(None, 0)`` when
+    the sources don't cover ``want``.
 
     Fast paths avoid the scratch-zeros allocation: an exact-index source
     is a straight device transfer; a containing source is one on-device
@@ -110,7 +155,7 @@ def _assemble_target_shard(
     shape = tuple(hi - lo for lo, hi in want)
     for idx, data in sources:
         if idx == want:
-            return jax.device_put(data, device)
+            return jax.device_put(data, device), 1
     for idx, data in sources:
         inter = _overlap(idx, want)
         if inter == want:
@@ -119,7 +164,7 @@ def _assemble_target_shard(
                 for (wlo, whi), (slo, _) in zip(want, idx)
             )
             piece = data[sel] if sel else data
-            return jax.device_put(piece, device)
+            return jax.device_put(piece, device), 1
     covered = (
         np.zeros(shape, dtype=bool) if shape else np.zeros((), bool)
     )
@@ -142,7 +187,7 @@ def _assemble_target_shard(
         else:
             covered[...] = True
     if not bool(covered.all()):
-        return None
+        return None, 0
     base = jax.device_put(jnp.zeros(shape, dtype), device)
     for src_sel, dst_sel, data in pieces:
         piece = jax.device_put(
@@ -152,7 +197,7 @@ def _assemble_target_shard(
             base = base.at[dst_sel].set(piece)
         else:
             base = piece
-    return base
+    return base, len(pieces)
 
 
 def reshard_state(
@@ -209,6 +254,8 @@ def reshard_state(
                 continue
         except Exception:
             pass
+        if not report.axis_changes:
+            report.axis_changes = _axis_changes(old, sharding)
         sources = _source_shards(old)
         nbytes = int(
             np.prod(spec.shape, dtype=np.int64)
@@ -216,7 +263,9 @@ def reshard_state(
         ) if spec.shape else np.dtype(spec.dtype).itemsize
         new_leaf = None
         if sources:
-            new_leaf = _reshard_leaf(spec, sharding, sources)
+            new_leaf = _reshard_leaf(
+                spec, sharding, sources, report=report
+            )
         if new_leaf is None:
             report.fallback_paths.append(path)
             report.host_bytes += nbytes
@@ -229,19 +278,27 @@ def reshard_state(
     if stats is not None:
         stats.reshard_bytes_device += report.device_bytes
         stats.reshard_bytes_host += report.host_bytes
-    if report.fallback_paths:
+    if report.fallback_paths or report.axis_changes:
+        stitch = (
+            f", {report.stitched_shards} shards stitched from "
+            f"multiple sources"
+            if report.stitched_shards
+            else ""
+        )
         logger.info(
-            f"reshard: {report.moved_leaves} leaves moved on device "
-            f"({report.device_bytes >> 20} MiB), "
+            f"reshard [{report.describe_axis_changes()}]: "
+            f"{report.moved_leaves} leaves moved on device "
+            f"({report.device_bytes >> 20} MiB){stitch}, "
             f"{len(report.fallback_paths)} fall back to host restore "
             f"({report.host_bytes >> 20} MiB)"
         )
     return jax.tree_util.tree_unflatten(s_def, out), report
 
 
-def _reshard_leaf(spec, sharding, sources):
+def _reshard_leaf(spec, sharding, sources, report=None):
     """One leaf: build every addressable target shard from local
-    sources; None as soon as any shard cannot be covered."""
+    sources; None as soon as any shard cannot be covered. Counts
+    multi-source assemblies into ``report.stitched_shards``."""
     import jax
 
     gshape = tuple(spec.shape)
@@ -250,14 +307,19 @@ def _reshard_leaf(spec, sharding, sources):
     except Exception:
         return None
     pieces = []
+    stitched = 0
     for device, slices in index_map.items():
         want = _slices_to_index(slices, gshape)
-        block = _assemble_target_shard(
+        block, n_used = _assemble_target_shard(
             want, np.dtype(spec.dtype), sources, device
         )
         if block is None:
             return None
+        if n_used > 1:
+            stitched += 1
         pieces.append(block)
+    if report is not None:
+        report.stitched_shards += stitched
     return jax.make_array_from_single_device_arrays(
         gshape, sharding, pieces
     )

@@ -201,12 +201,15 @@ def _grad_sync_plan(
     """BucketPlan for the explicit sync path, or None when this mesh
     keeps GSPMD's native schedule — the gate lives in ONE place
     (``grad_sync.plan_for_mesh``, shared with the Strategy-level
-    ``resolve_plan`` the trainer/cost model consult). Non-pure-DP
-    meshes fall back silently with a log: the strategy search stamps
-    the opt names onto every candidate and an fsdp candidate must
-    still build."""
-    from dlrover_tpu.common.log import default_logger as logger
-    from dlrover_tpu.parallel.grad_sync import plan_for_mesh
+    ``resolve_plan`` the trainer/cost model consult). pp/ep and 3D
+    dp x fsdp x tp meshes fall back with a once-per-mesh log
+    (``note_gspmd_fallback``): the strategy search stamps the opt
+    names onto every candidate and such a candidate must still
+    build."""
+    from dlrover_tpu.parallel.grad_sync import (
+        note_gspmd_fallback,
+        plan_for_mesh,
+    )
 
     plan = plan_for_mesh(
         cfg, mesh,
@@ -216,11 +219,7 @@ def _grad_sync_plan(
     )
     if plan is None:
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-        logger.info(
-            f"grad_sync: explicit scheduler needs a pure-DP mesh "
-            f"(dp>1, others 1), have {sizes}; keeping the GSPMD "
-            f"default schedule"
-        )
+        note_gspmd_fallback(sizes)
     return plan
 
 
@@ -262,15 +261,18 @@ def build_train_step(
     PCIe.
 
     ``comm_overlap`` / ``grad_compress="int8"``: route gradient sync
-    through the explicit bucketed scheduler (parallel/grad_sync.py) on
-    pure-DP meshes — per-bucket reduce-scatter + all-gather under
-    ``shard_map`` (independent collectives XLA's latency-hiding
-    scheduler can overlap with backward compute), local fp32
-    accumulation under ``grad_accum`` so only the final microbatch
-    syncs (wire traffic cut K×), and optionally int8-quantized wire
-    payloads with error feedback when the state carries a residual
-    (``grad_sync.ensure_residual``). Non-pure-DP meshes fall back to
-    the GSPMD default schedule with a log."""
+    through the explicit bucketed scheduler (parallel/grad_sync.py) —
+    per-bucket reduce-scatter + all-gather under ``shard_map`` on
+    dp meshes (independent collectives XLA's latency-hiding scheduler
+    can overlap with backward compute), a ZeRO-style reduce-scatter
+    into the fsdp shard layout on dp x fsdp meshes (no gather leg),
+    and a bucketed dp-axis sync under the tp/sp submesh on dp x tp/sp
+    meshes; local fp32 accumulation under ``grad_accum`` means only
+    the final microbatch syncs (wire traffic cut K×), and optionally
+    int8-quantized wire payloads with error feedback when the state
+    carries a residual (``grad_sync.ensure_residual``; dp/fsdp plans
+    only). pp/ep and 3D meshes fall back to the GSPMD default
+    schedule with a once-per-mesh log."""
     opt_sh = None
     if offload_opt_state:
         # the MIXED tree from offload_shardings: host-kind tensors,
@@ -297,6 +299,12 @@ def build_train_step(
         if (comm_overlap or grad_compress == "int8")
         else None
     )
+    # synced grads are pinned to the params' canonical shardings:
+    # sync_grads hands back bucket slices whose GSPMD layout is the
+    # flat bucket's (fsdp chunks / whatever auto-tp propagation
+    # chose), and without the constraint the updated state would
+    # drift off the layout the AOT executable was compiled with
+    grad_sh = param_shardings(cfg, mesh, rules) if plan is not None else None
 
     def grads_and_loss(params, tokens, targets):
         def lf(p):
@@ -307,17 +315,29 @@ def build_train_step(
         return jax.value_and_grad(lf, has_aux=True)(params)
 
     def local_grads_and_loss(params, tokens, targets):
-        """Per-device UNsynchronized grads under a full-manual
-        ``shard_map``: each device differentiates the loss of its own
-        batch shard (mesh=None inside — no sharding constraints in a
-        manual region), and every output gains a leading dp axis of
+        """Per-device UNsynchronized grads under ``shard_map``: each
+        device differentiates the loss of its own batch shard
+        (mesh=None inside — no sharding constraints in a manual
+        region), and every output gains a leading data axis of
         per-device size 1 so 'different value on every device' has a
-        GSPMD-legal sharded representation (``P(('dp',))``)."""
+        GSPMD-legal sharded representation (``P(plan.stack_axes)``).
+
+        dp and ZeRO plans run full-manual (the data axes are the only
+        real axes). dp x tp/sp plans run manual over **dp only**
+        (``axis_names``): tp/sp stay GSPMD axes inside the body, so
+        the model-sharded matmuls keep their native partitioned
+        schedule instead of being computed replicated per device —
+        each dp rank here is the whole tp submesh."""
         from jax.sharding import PartitionSpec as P
 
         from dlrover_tpu.common.jax_compat import shard_map
 
-        batch_spec = P(("dp", "fsdp"), "sp")  # others are size 1 here
+        kw = {}
+        if plan.auto_axes:
+            kw["axis_names"] = ("dp",)
+            batch_spec = P(("dp",))  # tp/sp sharding rides as auto
+        else:
+            batch_spec = P(("dp", "fsdp"), "sp")
 
         def body(p, x, y):
             def lf(pp):
@@ -331,13 +351,14 @@ def build_train_step(
                 jax.tree_util.tree_map(lead, g),
             )
 
-        stacked = P(("dp",))
+        stacked = P(plan.stack_axes)
         return shard_map(
             body,
             mesh=mesh,
             in_specs=(P(), batch_spec, batch_spec),
             out_specs=(stacked, stacked, stacked),
             check_vma=False,
+            **kw,
         )(params, tokens, targets)
 
     def _microbatches(tokens, targets):
@@ -365,7 +386,7 @@ def build_train_step(
 
         if grad_accum > 1:
             xs, ys = _microbatches(tokens, targets)
-            stacked_sh = NamedSharding(mesh, P(("dp",)))
+            stacked_sh = NamedSharding(mesh, P(plan.stack_axes))
 
             def body(carry, xy):
                 g_acc, loss_acc, aux_acc = carry
@@ -382,7 +403,7 @@ def build_train_step(
 
             zeros_g = jax.tree_util.tree_map(
                 lambda p: jax.lax.with_sharding_constraint(
-                    jnp.zeros((plan.dp,) + p.shape, jnp.float32),
+                    jnp.zeros((plan.total,) + p.shape, jnp.float32),
                     stacked_sh,
                 ),
                 state.params,
@@ -413,6 +434,11 @@ def build_train_step(
         )
         grads, new_residual, gnorm = sync_grads(
             g_stacked, mesh, plan, residual=residual
+        )
+        grads = jax.tree_util.tree_map(
+            lambda g, sh: jax.lax.with_sharding_constraint(g, sh),
+            grads,
+            grad_sh,
         )
         if residual is None:
             new_residual = state.grad_residual

@@ -43,11 +43,43 @@ module replaces it with an explicit, schedulable sync layer:
   ``parallel/topology.LinkModel`` when ``grad_bucket_mb`` is 0
   ("auto") instead of one global target.
 
-Scope: the explicit path engages on pure-DP meshes (``dp > 1`` and
-every other axis 1). fsdp/tp/sp meshes keep GSPMD's native schedule —
-their collectives are entangled with the sharded matmuls themselves
-and XLA already pipelines them; the monolithic-sync problem this
-module solves is specific to the replicated-param DP/grad-accum loop.
+- **Model-sharded meshes** (``resolve_sync_mode``): the explicit path
+  is no longer pure-DP-only.
+
+  - ``dp x fsdp`` (ZeRO): each bucket is reduce-scattered **into the
+    fsdp shard layout** — one reduce-scatter over the fsdp axis (no
+    all-gather twin: params/optimizer state are fsdp-sharded, so the
+    full bucket is never reassembled over fsdp), then the dp-axis
+    sync (flat, int8+error-feedback, or two-level ICI/DCN — all of
+    the above compose on the dp axis) runs on the ``1/fsdp`` chunk.
+    Strictly fewer wire bytes than the monolithic all-reduce
+    (``explicit_wire_bytes() < gspmd_allreduce_bytes()``), and at
+    dp=1 exactly the classic ZeRO half. HBM envelope caveat: the
+    manual grad region gathers the full param tree per device for
+    compute and holds the full local grad tree (fp32 under
+    grad_accum) until the bucket walk scatters it — a pure-dp-shaped
+    *transient* peak, not GSPMD-fsdp's per-layer streamed gathers
+    (params/optimizer state between steps stay fsdp-sharded either
+    way). Models that need fsdp to fit at all should keep the GSPMD
+    schedule; the dry-runner's HBM gate compiles the real program,
+    so overflowing explicit candidates are pruned in search instead
+    of OOMing at runtime.
+  - ``dp x tp/sp``: the bucketed dp-axis sync runs under a
+    *partial-manual* ``shard_map`` (manual over dp only) so tp/sp
+    stay GSPMD axes and the sharded matmuls keep their native
+    schedule; each bucket syncs with one independent ``psum`` over dp
+    that XLA can overlap with compute. (The RS+AG decomposition is
+    not used here: XLA 0.4.x's partitioner cannot mix manual-subgroup
+    reduce-scatter/all-gather with auto axes.) int8 compression is
+    forced off on these plans — the error-feedback residual would
+    inherit unstable auto-axis shardings across steps and invalidate
+    AOT executables.
+
+  Meshes with ``pp`` or ``ep`` degrees, and 3D ``dp x fsdp x tp``
+  factorizations, keep GSPMD's native schedule; the fallback is
+  logged once per mesh (``note_gspmd_fallback``) and surfaced as
+  ``PipelineStats.grad_sync_path`` instead of only in HLO.
+
 ``resolve_plan`` is the single gating decision both the step builder
 and the trainer consult.
 """
@@ -86,6 +118,56 @@ class Bucket:
 
 
 @dataclass(frozen=True)
+class SyncMode:
+    """Which explicit-sync schedule a mesh qualifies for (the gate's
+    verdict, shared by the step builder, the trainer and the cost
+    model). ``kind``: "dp" (classic pure-DP), "zero" (dp x fsdp —
+    reduce-scatter into the fsdp shard layout), "tp" (dp x tp/sp —
+    bucketed dp sync under a partial-manual shard_map with the model
+    axes left to GSPMD)."""
+
+    kind: str
+    dp: int
+    fsdp: int = 1
+    # model axes (>1) left to GSPMD on the "tp" path
+    auto_axes: Tuple[str, ...] = ()
+    # product of the auto axes' degrees: grads of model-sharded params
+    # are already 1/model_shard per device, so per-device wire bytes
+    # scale down by it
+    model_shard: int = 1
+
+
+def resolve_sync_mode(axis_sizes: dict) -> Optional[SyncMode]:
+    """THE mesh gate (every caller routes through here so the step
+    builder, trainer and cost model cannot drift): a SyncMode when the
+    explicit sync path supports this mesh, else None (GSPMD default
+    schedule). pp/ep meshes and 3D dp x fsdp x tp factorizations stay
+    GSPMD; callers that *requested* the explicit path should surface
+    the fallback via ``note_gspmd_fallback``."""
+    dp = int(axis_sizes.get("dp", 1))
+    fsdp = int(axis_sizes.get("fsdp", 1))
+    tp = int(axis_sizes.get("tp", 1))
+    sp = int(axis_sizes.get("sp", 1))
+    if int(axis_sizes.get("pp", 1)) > 1 or int(axis_sizes.get("ep", 1)) > 1:
+        return None
+    if fsdp > 1:
+        if tp > 1 or sp > 1:
+            return None  # 3D mesh: grads entangled across model axes
+        return SyncMode("zero", dp=dp, fsdp=fsdp)
+    if dp > 1 and (tp > 1 or sp > 1):
+        auto = tuple(
+            a for a in ("tp", "sp") if int(axis_sizes.get(a, 1)) > 1
+        )
+        # model_shard counts only axes that shard PARAMS (tp): sp
+        # shards activations/sequence, so param grads are replicated
+        # over sp and each device still ships the full 1/tp payload
+        return SyncMode("tp", dp=dp, auto_axes=auto, model_shard=tp)
+    if dp > 1:
+        return SyncMode("dp", dp=dp)
+    return None
+
+
+@dataclass(frozen=True)
 class BucketPlan:
     buckets: Tuple[Bucket, ...]
     leaf_shapes: Tuple[Tuple[int, ...], ...]
@@ -97,6 +179,14 @@ class BucketPlan:
     # reduce-scatter over ICI, cross-slice all-reduce of the
     # slice-accumulated shards over DCN, slice-local all-gather
     slices: int = 1
+    # fsdp degree (> 1 = the ZeRO path: buckets are reduce-scattered
+    # into the fsdp shard layout first, the dp legs ride the chunk)
+    fsdp: int = 1
+    # model axes left to GSPMD (the "tp" path: sync_grads runs manual
+    # over dp only and each bucket all-reduces with one psum)
+    auto_axes: Tuple[str, ...] = ()
+    # product of the auto axes' degrees (per-device wire accounting)
+    model_shard: int = 1
 
     @property
     def num_buckets(self) -> int:
@@ -107,19 +197,33 @@ class BucketPlan:
         return self.slices > 1
 
     @property
+    def zero(self) -> bool:
+        return self.fsdp > 1
+
+    @property
+    def total(self) -> int:
+        """Data degree of the sync (the N the mean divides by)."""
+        return self.dp * self.fsdp
+
+    @property
+    def stack_axes(self) -> Tuple[str, ...]:
+        """Mesh axes the stacked local-grad lead dim is sharded over
+        (and the residual's row axis)."""
+        return ("dp", "fsdp") if self.zero else ("dp",)
+
+    @property
     def dp_ici(self) -> int:
         """Per-slice dp degree (the ICI factor of the dp axis)."""
         return self.dp // self.slices
 
     def shard_elems(self, bucket: Bucket) -> int:
         """Per-device length of what this bucket's error-feedback
-        residual covers: the slice-local shard for two-level (int8
-        quantizes the DCN leg), the full padded vector for flat."""
-        return (
-            bucket.padded // self.dp_ici
-            if self.two_level
-            else bucket.padded
-        )
+        residual covers — exactly what int8 quantizes: the fsdp chunk
+        on ZeRO plans (the dp legs ride it), narrowed to the
+        slice-local DCN shard for two-level, the full padded vector
+        for flat pure-DP."""
+        base = bucket.padded // self.fsdp
+        return base // self.dp_ici if self.two_level else base
 
     @property
     def raw_bytes(self) -> int:
@@ -129,14 +233,18 @@ class BucketPlan:
 
     @property
     def wire_bytes(self) -> int:
-        """Wire bytes of one sync on THIS plan's path."""
+        """Wire bytes of one sync on THIS plan's path (payload
+        accounting — the ratio against ``raw_bytes`` is the
+        compression win; ``explicit_wire_bytes`` is the ring-adjusted
+        per-device twin)."""
         if self.compress == "int8":
-            if self.two_level:
-                # only the DCN shard is quantized; the ICI legs stay
-                # fp32 (padded x 4 for RS + gather is the flat cost)
+            if self.two_level or self.zero:
+                # only the innermost quantized leg ships int8 (the
+                # DCN shard / the dp legs' fsdp chunk); the outer
+                # fp32 legs bill at padded x 4
                 return sum(
                     b.padded * 4
-                    + b.padded // self.dp_ici * _INT8_BYTES
+                    + self.shard_elems(b) * _INT8_BYTES
                     + _SCALE_BYTES
                     for b in self.buckets
                 )
@@ -146,25 +254,79 @@ class BucketPlan:
             )
         return self.raw_bytes
 
+    # -- ring-adjusted per-device accounting ---------------------------
+    def gspmd_allreduce_bytes(self) -> int:
+        """Per-device ring bytes of the monolithic fp32 all-reduce
+        GSPMD's default schedule moves over the data axes per sync —
+        the fallback this plan replaces. Model-sharded grads are
+        already ``1/model_shard`` per device."""
+        N = self.total
+        if N <= 1:
+            return 0
+        ring = 2.0 * (N - 1) / N
+        return int(
+            sum(ring * b.padded * 4 for b in self.buckets)
+            / self.model_shard
+        )
+
+    def explicit_wire_bytes(self) -> int:
+        """Per-device ring bytes of THIS plan's schedule per sync.
+        The ZeRO path is strictly below ``gspmd_allreduce_bytes``: the
+        fsdp reduce-scatter has no all-gather twin, and the dp legs
+        ride only the ``1/fsdp`` chunk."""
+        total = 0.0
+        for b in self.buckets:
+            payload = b.padded * 4.0 / self.model_shard
+            if self.zero:
+                F = self.fsdp
+                # reduce-scatter into the fsdp shard layout; params /
+                # optimizer state are fsdp-sharded, so no gather leg
+                total += (F - 1) / F * payload
+                payload /= F
+            if self.dp <= 1:
+                continue
+            c = (
+                _INT8_BYTES / 4.0
+                if self.compress == "int8" and not self.auto_axes
+                else 1.0
+            )
+            if self.auto_axes:
+                # bucketed per-bucket all-reduce (psum) over dp
+                total += 2.0 * (self.dp - 1) / self.dp * payload
+            elif self.two_level:
+                per = self.dp_ici
+                total += 2.0 * (per - 1) / per * payload
+                total += (
+                    2.0 * (self.slices - 1) / self.slices
+                    * (payload / per) * c
+                )
+            else:
+                total += 2.0 * (self.dp - 1) / self.dp * payload * c
+        return int(total)
+
     # -- cross-slice (DCN) accounting: totals over all devices/sync ----
     def dcn_bytes_flat(self) -> int:
         """Cross-slice bytes the FLAT schedule moves per sync: a ring
         reduce-scatter + all-gather over dp devices laid out as
         ``slices`` contiguous blocks crosses a slice boundary on
         ``slices`` of its dp edges, each of 2(dp-1) rounds carrying
-        padded/dp fp32 elements per edge."""
+        payload/dp fp32 elements per edge (payload = the fsdp chunk on
+        ZeRO plans — the dp legs ride it)."""
         if not self.two_level:
             return 0
         return sum(
-            int(2 * (self.dp - 1) * self.slices * b.padded * 4 / self.dp)
+            int(
+                2 * (self.dp - 1) * self.slices
+                * (b.padded // self.fsdp) * 4 / self.dp
+            )
             for b in self.buckets
         )
 
     def dcn_bytes_twolevel(self) -> int:
         """Cross-slice bytes the two-level schedule moves per sync:
-        every device all-reduces only its slice-local shard across
-        slices (ring factor 2(S-1)/S), int8-compressed when the plan
-        compresses."""
+        every device all-reduces only its slice-local shard (of the
+        fsdp chunk, on ZeRO plans) across slices (ring factor
+        2(S-1)/S), int8-compressed when the plan compresses."""
         if not self.two_level:
             return 0
         S = self.slices
@@ -173,11 +335,11 @@ class BucketPlan:
         )
         total = 0
         for b in self.buckets:
-            shard = b.padded // self.dp_ici
+            shard = b.padded // self.fsdp // self.dp_ici
             per_dev = 2.0 * (S - 1) / S * shard * per_elem
             if self.compress == "int8":
                 per_dev += _SCALE_BYTES
-            total += int(per_dev * self.dp)
+            total += int(per_dev * self.total)
         return total
 
     def describe(self) -> str:
@@ -188,8 +350,20 @@ class BucketPlan:
             if self.two_level
             else ""
         )
+        if self.zero:
+            axes = f"{self.dp}-way dp x {self.fsdp}-way fsdp (ZeRO " \
+                f"reduce-scatter, {self.explicit_wire_bytes() >> 10} " \
+                f"KiB/dev vs {self.gspmd_allreduce_bytes() >> 10} KiB " \
+                f"all-reduce)"
+        elif self.auto_axes:
+            axes = (
+                f"{self.dp}-way dp under GSPMD "
+                f"{'x'.join(self.auto_axes)} (bucketed psum)"
+            )
+        else:
+            axes = f"{self.dp}-way dp"
         return (
-            f"{self.num_buckets} buckets over {self.dp}-way dp, "
+            f"{self.num_buckets} buckets over {axes}, "
             f"{self.raw_bytes >> 20} MiB raw -> "
             f"{self.wire_bytes >> 20} MiB wire ({self.compress}){lvl}"
         )
@@ -201,6 +375,9 @@ def plan_buckets(
     bucket_bytes: int = 4 << 20,
     compress: str = "none",
     slices: int = 1,
+    fsdp: int = 1,
+    auto_axes: Tuple[str, ...] = (),
+    model_shard: int = 1,
 ) -> BucketPlan:
     """Greedy size-targeted partition of the grad tree (leaf order =
     tree flatten order, which matches the order backward produces
@@ -210,7 +387,10 @@ def plan_buckets(
 
     A leaf larger than ``bucket_bytes`` gets its own bucket; the plan
     never splits a leaf (keeps unflattening trivial and keeps each
-    leaf's error-feedback residual in one bucket).
+    leaf's error-feedback residual in one bucket). ``fsdp > 1`` plans
+    the ZeRO schedule (padding covers the fsdp scatter too);
+    ``auto_axes`` marks a dp x tp/sp plan (bucketed psum over dp,
+    compression rejected — see ``resolve_plan``).
     """
     import jax
 
@@ -219,11 +399,16 @@ def plan_buckets(
             f"unknown grad compression {compress!r} "
             "(expected 'none' or 'int8')"
         )
-    if dp < 1:
-        raise ValueError(f"dp must be >= 1, got {dp}")
+    if dp < 1 or fsdp < 1:
+        raise ValueError(f"dp/fsdp must be >= 1, got {dp}/{fsdp}")
     if slices < 1 or dp % slices:
         raise ValueError(
             f"slices={slices} must divide dp={dp} (and be >= 1)"
+        )
+    if auto_axes and (compress != "none" or fsdp > 1):
+        raise ValueError(
+            "a dp x tp/sp plan supports neither int8 compression nor "
+            "an fsdp leg (the residual/scatter would cross GSPMD axes)"
         )
     leaves = jax.tree_util.tree_leaves(shapes_tree)
     shapes = tuple(tuple(int(d) for d in l.shape) for l in leaves)
@@ -232,12 +417,13 @@ def plan_buckets(
     start = 0
     cur_elems = 0
     cur_bytes = 0
+    pad_to = dp * fsdp  # every scatter stage must divide evenly
 
     def _close(stop: int):
         nonlocal start, cur_elems, cur_bytes
         if stop == start:
             return
-        padded = -(-cur_elems // dp) * dp
+        padded = -(-cur_elems // pad_to) * pad_to
         buckets.append(
             Bucket(
                 index=len(buckets),
@@ -269,19 +455,35 @@ def plan_buckets(
         dp=dp,
         compress=compress,
         slices=slices,
+        fsdp=fsdp,
+        auto_axes=tuple(auto_axes),
+        model_shard=model_shard,
     )
 
 
-def _qualifying_dp(axis_sizes: dict) -> int:
-    """The ONE mesh gate (every caller routes through here so the
-    step builder, trainer and cost model cannot drift): the dp degree
-    when the mesh is pure DP (dp > 1, every other axis 1), else 0."""
-    dp = int(axis_sizes.get("dp", 1))
-    others = max(
-        int(axis_sizes.get(a, 1))
-        for a in ("fsdp", "tp", "sp", "ep", "pp")
+# once-per-mesh fallback visibility (satellite of ISSUE 8): a mesh
+# that loses the explicit path used to fall back silently by design —
+# now the choice is logged once per process per mesh and recorded as
+# ``PipelineStats.grad_sync_path`` by the trainer
+_GSPMD_FALLBACK_LOGGED: set = set()
+
+
+def note_gspmd_fallback(axis_sizes: dict, reason: str = "") -> None:
+    """Log ONCE per process per mesh when a strategy that requested
+    the explicit sync path runs GSPMD's default schedule instead."""
+    from dlrover_tpu.common.log import default_logger as logger
+
+    key = tuple(sorted((k, int(v)) for k, v in axis_sizes.items()))
+    if key in _GSPMD_FALLBACK_LOGGED:
+        return
+    _GSPMD_FALLBACK_LOGGED.add(key)
+    sizes = {k: int(v) for k, v in axis_sizes.items() if int(v) > 1}
+    logger.info(
+        f"grad_sync: mesh {sizes or {'dp': 1}} keeps the GSPMD default "
+        f"schedule{' (' + reason + ')' if reason else ''}; the explicit "
+        f"bucketed path supports pure-dp, dp x fsdp and dp x tp/sp "
+        f"meshes (grad_sync_path=gspmd)"
     )
-    return dp if dp > 1 and others == 1 else 0
 
 
 def resolve_bucket_bytes(
@@ -290,13 +492,14 @@ def resolve_bucket_bytes(
     slices: int = 1,
     compress: str = "none",
     link_model=None,
+    fsdp: int = 1,
 ) -> int:
     """Bucket-size target in bytes. ``grad_bucket_mb > 0`` is the
     explicit global knob (historical behavior). ``0`` means **auto**:
     size each bucket so its wire time on the link it actually crosses
     is ~``topology.BUCKET_TARGET_COMM_MS`` — the DCN leg for two-level
-    plans (a bucket's cross-slice payload is ``1/dp_ici`` of its
-    elements, ``1/4`` again under int8, so the full-bucket target
+    plans (a bucket's cross-slice payload is ``1/(fsdp * dp_ici)`` of
+    its elements, ``1/4`` again under int8, so the full-bucket target
     scales back up by those factors), the ICI ring otherwise."""
     if grad_bucket_mb > 0:
         return grad_bucket_mb << 20
@@ -306,7 +509,7 @@ def resolve_bucket_bytes(
     topology.note_fallback_use(model)
     if slices > 1:
         dcn_payload = topology.bucket_bytes_for(model, "dcn")
-        scale = dp // slices
+        scale = (dp // slices) * fsdp
         if compress == "int8":
             scale *= 4  # the DCN shard ships int8, the target is fp32
         b = dcn_payload * scale
@@ -318,8 +521,8 @@ def resolve_bucket_bytes(
     )
 
 
-def _plan_for_cfg(
-    cfg, dp: int, grad_compress: str, grad_bucket_mb: int,
+def _plan_for_mode(
+    cfg, mode: SyncMode, grad_compress: str, grad_bucket_mb: int,
     params_shape=None, slices: int = 1,
 ) -> BucketPlan:
     if params_shape is None:
@@ -330,14 +533,36 @@ def _plan_for_cfg(
         params_shape = jax.eval_shape(
             lambda: init_params(jax.random.PRNGKey(0), cfg)
         )
+    if mode.kind == "tp" and grad_compress != "none":
+        # the residual would inherit unstable auto-axis shardings
+        # across steps (invalidating AOT executables); run the
+        # explicit path uncompressed instead of falling back entirely
+        from dlrover_tpu.common.log import default_logger as logger
+
+        logger.info(
+            "grad_sync: int8 compression is not supported on dp x "
+            "tp/sp meshes; running the explicit bucketed sync at fp32"
+        )
+        grad_compress = "none"
+    if mode.kind == "tp":
+        # the tp path syncs each bucket with one flat psum (see
+        # _sync_one_bucket) — a two-level plan would mis-size auto
+        # buckets for a DCN shard that never exists, mislabel
+        # describe()/dcn accounting, and break the legs probe
+        slices = 1
+    slices = slices if 1 < slices < mode.dp else 1
     return plan_buckets(
         params_shape,
-        dp=dp,
+        dp=mode.dp,
         bucket_bytes=resolve_bucket_bytes(
-            grad_bucket_mb, dp=dp, slices=slices, compress=grad_compress
+            grad_bucket_mb, dp=mode.dp, slices=slices,
+            compress=grad_compress, fsdp=mode.fsdp,
         ),
         compress=grad_compress,
         slices=slices,
+        fsdp=mode.fsdp,
+        auto_axes=mode.auto_axes,
+        model_shard=mode.model_shard,
     )
 
 
@@ -356,16 +581,16 @@ def plan_for_mesh(
     not carry the MeshConfig's hybrid factorization, so the step
     builder threads it — ``MeshConfig.dp_slices()`` upstream)."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    dp = _qualifying_dp(sizes)
-    if not dp:
+    mode = resolve_sync_mode(sizes)
+    if mode is None:
         return None
-    if slices > 1 and dp % slices:
+    if slices > 1 and mode.dp % slices:
         raise ValueError(
-            f"slices={slices} does not divide dp={dp}"
+            f"slices={slices} does not divide dp={mode.dp}"
         )
-    return _plan_for_cfg(
-        cfg, dp, grad_compress, grad_bucket_mb, params_shape,
-        slices=slices if 1 < slices < dp else 1,
+    return _plan_for_mode(
+        cfg, mode, grad_compress, grad_bucket_mb, params_shape,
+        slices=slices,
     )
 
 
@@ -378,20 +603,24 @@ def resolve_plan(
     path applies to ``strategy``, else None (GSPMD default schedule).
 
     Engages iff ``comm_overlap`` (or int8 ``grad_compress``, which
-    requires the explicit path) is requested AND the mesh is pure DP.
-    Model-sharded meshes fall back silently — candidate search stamps
-    the opt names onto every candidate, and an fsdp candidate must
-    still build. A hybrid dp axis (``MeshConfig.dp_slices() > 1``)
-    plans the two-level ICI/DCN schedule.
+    requires the explicit path) is requested AND the mesh qualifies
+    (``resolve_sync_mode``: pure-dp, dp x fsdp, or dp x tp/sp).
+    pp/ep and 3D meshes fall back with a once-per-mesh log
+    (``note_gspmd_fallback``) — candidate search stamps the opt names
+    onto every candidate, and such a candidate must still build. A
+    hybrid dp axis (``MeshConfig.dp_slices() > 1``) plans the
+    two-level ICI/DCN schedule on the dp legs.
     """
     if not strategy.resolved_comm_overlap():
         return None
-    dp = _qualifying_dp(strategy.mesh.axis_sizes())
-    if not dp:
+    sizes = strategy.mesh.axis_sizes()
+    mode = resolve_sync_mode(sizes)
+    if mode is None:
+        note_gspmd_fallback(sizes)
         return None
-    return _plan_for_cfg(
+    return _plan_for_mode(
         cfg,
-        dp,
+        mode,
         strategy.resolved_grad_compress(),
         strategy.grad_bucket_mb,
         params_shape,
@@ -451,22 +680,22 @@ def _slice_groups(dp: int, slices: int) -> Tuple[list, list]:
     return ici, dcn
 
 
-def _sync_one_bucket_2level(
-    flat, residual, plan: "BucketPlan", legs: str = "all"
-):
-    """Two-level per-device bucket body for a hybrid dp axis
-    (``plan.slices`` DCN-connected slices of ``plan.dp_ici`` ICI-local
-    devices each): slice-local reduce-scatter over ICI, cross-slice
-    all-reduce of only the slice-accumulated *shard* over DCN, then a
-    slice-local all-gather. Every device ships ``padded/dp_ici``
-    elements across slices instead of the full bucket riding the ring
-    through every slice boundary — the DCN leg (where bytes are
-    scarcest) shrinks by the per-slice degree, and the int8 path
-    quantizes exactly that leg, carrying error feedback on the shard.
+def _dp_leg_2level(x, residual, plan: "BucketPlan", legs: str = "all"):
+    """Two-level dp-axis sync of one per-device vector (a full bucket
+    on pure-dp plans, the fsdp chunk on ZeRO plans) for a hybrid dp
+    axis (``plan.slices`` DCN-connected slices of ``plan.dp_ici``
+    ICI-local devices each): slice-local reduce-scatter over ICI,
+    cross-slice all-reduce of only the slice-accumulated *shard* over
+    DCN, then a slice-local all-gather. Every device ships
+    ``len(x)/dp_ici`` elements across slices instead of the full
+    vector riding the ring through every slice boundary — the DCN leg
+    (where bytes are scarcest) shrinks by the per-slice degree, and
+    the int8 path quantizes exactly that leg, carrying error feedback
+    on the shard. Returns the dp-SUM (not mean) and the new residual.
 
     ``legs="ici"`` skips the cross-slice all-reduce (the per-link
     timing probe subtracts this from the full sync to attribute wall
-    time to the DCN leg); the result is then only the slice-local mean
+    time to the DCN leg); the result is then only the slice-local sum
     and the residual rides through unchanged.
     """
     import jax
@@ -477,24 +706,26 @@ def _sync_one_bucket_2level(
     # level 1 (ICI): reduce-scatter within the slice — each device ends
     # holding the slice-LOCAL sum of its shard
     shard = jax.lax.psum_scatter(
-        flat, "dp", scatter_dimension=0, tiled=True,
+        x, "dp", scatter_dimension=0, tiled=True,
         axis_index_groups=ici_groups,
     )
     new_residual = residual
     if legs == "ici":
         total = shard
     elif plan.compress == "int8":
-        x = shard + residual if residual is not None else shard
-        # ONE shared scale across the whole dp axis (pmax over "dp"):
-        # every DCN group must quantize at the same step for the int32
-        # sum to be meaningful, and a single bucket-wide scale keeps
-        # the wire cost at one fp32 regardless of group count
-        scale = jax.lax.pmax(jnp.max(jnp.abs(x)), "dp") / 127.0
+        xx = shard + residual if residual is not None else shard
+        # ONE shared scale across all participants (pmax): every DCN
+        # group must quantize at the same step for the int32 sum to be
+        # meaningful, and a single bucket-wide scale keeps the wire
+        # cost at one fp32 regardless of group count
+        scale = jax.lax.pmax(
+            jnp.max(jnp.abs(xx)), plan.stack_axes
+        ) / 127.0
         scale = jnp.maximum(scale, jnp.float32(1e-20))
-        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        q = jnp.clip(jnp.round(xx / scale), -127, 127).astype(jnp.int8)
         # error feedback on the SHARD (what the DCN leg quantized) —
         # the ICI legs stay exact fp32 and contribute no error
-        new_residual = x - q.astype(jnp.float32) * scale
+        new_residual = xx - q.astype(jnp.float32) * scale
         # level 2 (DCN): int32 sum of S slice shards — S * 127 << 2^31
         summed = jax.lax.psum(
             q.astype(jnp.int32), "dp", axis_index_groups=dcn_groups
@@ -505,59 +736,86 @@ def _sync_one_bucket_2level(
         total = jax.lax.psum(
             shard, "dp", axis_index_groups=dcn_groups
         )
-    # level 3 (ICI): gather the globally-summed shards back to a full
-    # replicated bucket within each slice
+    # level 3 (ICI): gather the dp-summed shards back to the full
+    # per-device vector within each slice
     full = jax.lax.all_gather(
         total, "dp", tiled=True, axis_index_groups=ici_groups
     )
-    mean = full / dp
-    return mean, new_residual, jnp.sum(mean * mean)
+    return full, new_residual
 
 
-def _sync_one_bucket(
-    flat, residual, plan: "BucketPlan", legs: str = "all"
-):
-    """Per-device body for one bucket (inside ``shard_map``, manual
-    over dp): returns (mean-reduced replicated vector, new residual,
-    sum of squares of the synced vector).
-
-    The collective is the bandwidth-optimal reduce-scatter +
-    all-gather decomposition of the all-reduce: two phases XLA can
-    pipeline independently across buckets, and the exact collective
-    pair an fsdp extension would keep (dropping the gather). Plans
-    whose dp axis spans DCN slices route to the hierarchical schedule
-    (``_sync_one_bucket_2level``).
-    """
+def _dp_leg_flat(x, residual, plan: "BucketPlan"):
+    """Flat dp-axis sync of one per-device vector: the
+    bandwidth-optimal reduce-scatter + all-gather decomposition of
+    the all-reduce — two phases XLA can pipeline independently across
+    buckets. Returns the dp-SUM (not mean) and the new residual."""
     import jax
     import jax.numpy as jnp
 
-    if plan.two_level:
-        return _sync_one_bucket_2level(flat, residual, plan, legs=legs)
-    dp, compress = plan.dp, plan.compress
-    if compress == "int8":
-        x = flat + residual if residual is not None else flat
+    if plan.compress == "int8":
+        xx = x + residual if residual is not None else x
         # shared scale: every device must quantize at the same step or
         # the int32 sum is meaningless. pmax is 4 bytes on the wire.
-        scale = jax.lax.pmax(jnp.max(jnp.abs(x)), "dp") / 127.0
+        scale = jax.lax.pmax(
+            jnp.max(jnp.abs(xx)), plan.stack_axes
+        ) / 127.0
         scale = jnp.maximum(scale, jnp.float32(1e-20))
-        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        q = jnp.clip(jnp.round(xx / scale), -127, 127).astype(jnp.int8)
         # error feedback: what quantization dropped THIS step rides
         # into the next step's pre-quantization grads, so the noise
         # cancels across steps instead of biasing the trajectory
-        new_residual = x - q.astype(jnp.float32) * scale
+        new_residual = xx - q.astype(jnp.float32) * scale
         # int32 accumulation: dp * 127 << 2^31 at any real dp
         summed = jax.lax.psum_scatter(
             q.astype(jnp.int32), "dp", scatter_dimension=0, tiled=True
         )
         full = jax.lax.all_gather(summed, "dp", tiled=True)
-        mean = full.astype(jnp.float32) * (scale / dp)
-    else:
-        summed = jax.lax.psum_scatter(
-            flat, "dp", scatter_dimension=0, tiled=True
+        return full.astype(jnp.float32) * scale, new_residual
+    summed = jax.lax.psum_scatter(
+        x, "dp", scatter_dimension=0, tiled=True
+    )
+    return jax.lax.all_gather(summed, "dp", tiled=True), None
+
+
+def _sync_one_bucket(
+    flat, residual, plan: "BucketPlan", legs: str = "all"
+):
+    """Per-device body for one bucket (inside ``sync_grads``'s
+    shard_map): returns (mean-reduced vector, new residual, sum of
+    squares of the synced bucket).
+
+    Three schedules, composed from the plan:
+
+    - **ZeRO leg** (``plan.zero``): the bucket is reduce-scattered
+      over fsdp FIRST — each device keeps only its fsdp chunk, which
+      is exactly the shard layout the fsdp-sharded params/optimizer
+      consume, so there is NO fsdp all-gather twin. The dp legs below
+      then ride the ``1/fsdp`` chunk.
+    - **dp leg**: flat RS+AG (``_dp_leg_flat``), the two-level
+      ICI/DCN schedule for a hybrid dp axis (``_dp_leg_2level``), or
+      — on dp x tp/sp plans (``plan.auto_axes``) — one ``psum`` per
+      bucket (XLA 0.4.x cannot partition manual-subgroup RS/AG when
+      auto axes are present; a bucketed all-reduce keeps the
+      independent-collective overlap property).
+    - the mean divides by ``plan.total`` (dp x fsdp) — exact at
+      power-of-two degrees, which is what keeps the fp32 path
+      bit-par with GSPMD.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    x = flat
+    if plan.zero:
+        x = jax.lax.psum_scatter(
+            x, "fsdp", scatter_dimension=0, tiled=True
         )
-        full = jax.lax.all_gather(summed, "dp", tiled=True)
-        mean = full / dp
-        new_residual = None
+    if plan.auto_axes:
+        full, new_residual = jax.lax.psum(x, "dp"), residual
+    elif plan.two_level:
+        full, new_residual = _dp_leg_2level(x, residual, plan, legs)
+    else:
+        full, new_residual = _dp_leg_flat(x, residual, plan)
+    mean = full / plan.total
     return mean, new_residual, jnp.sum(mean * mean)
 
 
@@ -572,12 +830,20 @@ def sync_grads(
     new residual tuple or None, global grad norm).
 
     ``stacked_grads``: the tree of *local* (unsynchronized) grads with
-    a leading dp axis of size ``plan.dp``, each leaf sharded
-    ``P(('dp',))`` (``models.train`` builds these under a full-manual
-    ``shard_map``). ``residual``: per-bucket ``(dp, padded)`` fp32
-    error-feedback state, or None (int8 then runs EF-less for this
-    call — structure-preserving, so AOT executables stay valid; the
-    trainer opts in via ``ensure_residual``).
+    a leading data axis of size ``plan.total``, each leaf sharded
+    ``P(plan.stack_axes)`` (``models.train`` builds these under
+    ``shard_map`` — full-manual for dp/ZeRO plans, manual over dp only
+    for dp x tp/sp plans). ``residual``: per-bucket
+    ``(total, shard_elems)`` fp32 error-feedback state, or None (int8
+    then runs EF-less for this call — structure-preserving, so AOT
+    executables stay valid; the trainer opts in via
+    ``ensure_residual``).
+
+    On ZeRO plans each synced bucket leaves the shard_map as a flat
+    vector **sharded over fsdp** (``P(('fsdp',))``) — the fsdp
+    all-gather GSPMD would emit never happens; the leaves are sliced
+    back out under GSPMD, which reshards them into each param's own
+    fsdp layout with local-ish movement instead of a full gather.
 
     The grad norm falls out of the bucket walk (sum of squares of each
     synced bucket, padding is zero) — callers must NOT run a second
@@ -594,8 +860,8 @@ def sync_grads(
     res_in = tuple(residual) if ef else ()
 
     def body(leaves_in, res_in):
-        local = [l[0] for l in leaves_in]  # drop the size-1 dp slot
-        out_parts: List = []
+        local = [l[0] for l in leaves_in]  # drop the size-1 lead slot
+        flats: List = []
         new_res: List = []
         sumsq = jnp.float32(0.0)
         for b in plan.buckets:
@@ -605,13 +871,21 @@ def sync_grads(
                 flat, r, plan, legs=_legs
             )
             sumsq = sumsq + ss
-            out_parts.extend(_unflatten_bucket(mean, b, plan))
+            flats.append(mean)
             if ef:
                 new_res.append(nr[None])
-        return tuple(out_parts), tuple(new_res), sumsq[None]
+        return tuple(flats), tuple(new_res), sumsq[None]
 
-    stacked = P(("dp",))
-    synced, new_res, sumsq = shard_map(
+    stacked = P(plan.stack_axes)
+    # ZeRO buckets come out sharded over fsdp (no gather leg); dp and
+    # tp plans return the dp-replicated full bucket
+    bucket_out = P(("fsdp",)) if plan.zero else P()
+    kw = {}
+    if plan.auto_axes:
+        # manual over dp only; tp/sp stay GSPMD ("auto") axes so the
+        # sharded matmuls around this sync keep their native schedule
+        kw["axis_names"] = ("dp",)
+    flats, new_res, sumsq = shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -619,36 +893,47 @@ def sync_grads(
             tuple(stacked for _ in res_in),
         ),
         out_specs=(
-            tuple(P() for _ in leaves),
+            tuple(bucket_out for _ in plan.buckets),
             tuple(stacked for _ in res_in),
             stacked,
         ),
         check_vma=False,
+        **kw,
     )(tuple(leaves), res_in)
+    out_parts: List = []
+    for b, flat in zip(plan.buckets, flats):
+        out_parts.extend(_unflatten_bucket(flat, b, plan))
+    # each device's sumsq covers the full bucket (dp/tp plans) or its
+    # fsdp chunk (ZeRO — the chunks partition the bucket, so summing
+    # over all total devices still counts every element dp times)
     gnorm = jnp.sqrt(jnp.sum(sumsq) / plan.dp)
     return (
-        jax.tree_util.tree_unflatten(treedef, synced),
+        jax.tree_util.tree_unflatten(treedef, out_parts),
         new_res if ef else None,
         gnorm,
     )
 
 
 def zero_residual(plan: BucketPlan, mesh=None) -> Tuple:
-    """Fresh error-feedback state: one ``(dp, shard_elems)`` fp32
-    zeros per bucket (``shard_elems`` = the full padded vector on flat
-    plans, the slice-local DCN shard on two-level plans — EF covers
-    exactly what quantization touches), sharded over dp when a mesh is
-    given (each device carries only its own row)."""
+    """Fresh error-feedback state: one ``(total, shard_elems)`` fp32
+    zeros per bucket (``shard_elems`` = what int8 quantizes per
+    device: the full padded vector on flat plans, the fsdp chunk on
+    ZeRO plans, the slice-local DCN shard on two-level — EF covers
+    exactly what quantization touches), sharded over the plan's stack
+    axes when a mesh is given (each device carries only its own
+    row)."""
     import jax
     import jax.numpy as jnp
 
     out = []
     for b in plan.buckets:
-        z = jnp.zeros((plan.dp, plan.shard_elems(b)), jnp.float32)
+        z = jnp.zeros((plan.total, plan.shard_elems(b)), jnp.float32)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            z = jax.device_put(z, NamedSharding(mesh, P(("dp",))))
+            z = jax.device_put(
+                z, NamedSharding(mesh, P(plan.stack_axes))
+            )
         out.append(z)
     return tuple(out)
 
@@ -662,10 +947,10 @@ def residual_spec(plan: BucketPlan, mesh) -> Tuple:
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    sh = NamedSharding(mesh, P(("dp",)))
+    sh = NamedSharding(mesh, P(plan.stack_axes))
     return tuple(
         jax.ShapeDtypeStruct(
-            (plan.dp, plan.shard_elems(b)), jnp.float32, sharding=sh
+            (plan.total, plan.shard_elems(b)), jnp.float32, sharding=sh
         )
         for b in plan.buckets
     )
@@ -714,18 +999,36 @@ def comm_bytes_per_device(
     ``compress`` overrides the strategy's resolved mode — callers
     pricing the GSPMD *fallback* of a compressed strategy must pass
     "none" explicitly (the opts-carried knob cannot be neutralized by
-    ``dc_replace`` on the field alone)."""
+    ``dc_replace`` on the field alone).
+
+    When the strategy takes the explicit path on a model-sharded mesh
+    the bytes follow that schedule: the ZeRO plan's fsdp
+    reduce-scatter has no gather twin and its dp legs ride the
+    ``1/fsdp`` chunk; a dp x tp/sp plan all-reduces grads that are
+    already ``1/model_shard`` per device (and never compresses)."""
     m = strategy.mesh
     n = m.dp * m.fsdp
     if n <= 1:
         return 0.0
-    ring = 2.0 * (n - 1) / n
     payload = float(n_param_bytes)
     if compress is None:
         compress = strategy.resolved_grad_compress()
+    mode = resolve_sync_mode(m.axis_sizes())
+    explicit = mode is not None and strategy.resolved_comm_overlap()
+    if explicit and mode.kind == "tp":
+        ring = 2.0 * (mode.dp - 1) / mode.dp
+        return ring * payload / mode.model_shard  # never compressed
+    c = 1.0
     if compress == "int8":
-        payload *= _INT8_BYTES / float(grad_itemsize)
-    return ring * payload
+        c = _INT8_BYTES / float(grad_itemsize)
+    if explicit and mode.kind == "zero":
+        F = mode.fsdp
+        total = (F - 1) / F * payload  # ZeRO RS, fp32, no gather
+        if mode.dp > 1:
+            total += 2.0 * (mode.dp - 1) / mode.dp * (payload / F) * c
+        return total
+    ring = 2.0 * (n - 1) / n
+    return ring * payload * c
 
 
 def comm_time_per_device_s(
@@ -748,6 +1051,13 @@ def comm_time_per_device_s(
       exists to beat);
     - otherwise: the flat ring at the measured ICI rate.
 
+    - dp x fsdp (explicit ZeRO path): the fsdp reduce-scatter (no
+      gather twin) rides ICI at that axis's measured rate, then the
+      dp legs — flat, compressed, or two-level — ride the ``1/fsdp``
+      chunk;
+    - dp x tp/sp (explicit path): the bucketed dp all-reduce moves
+      grads that are already ``1/model_shard`` per device.
+
     Per-collective latency (one ring's worth of hops) is added from
     the model so tiny syncs don't price as free."""
     from dlrover_tpu.parallel import topology
@@ -766,28 +1076,68 @@ def comm_time_per_device_s(
     else:
         c = 1.0
     slices = m.dp_slices()
-    # same gate as the step builder: the two-level / compressed
-    # explicit schedule only runs when comm_overlap resolved on AND
-    # the mesh is pure DP — a comm_overlap=False hybrid mesh runs
+    # same gate as the step builder: the explicit schedule only runs
+    # when comm_overlap resolved on AND the mesh qualifies
+    # (resolve_sync_mode) — a comm_overlap=False hybrid mesh runs
     # GSPMD's monolithic all-reduce and must be billed as the flat
     # ring over DCN (the honest worst case), not the cheap two-level
     # cost it never gets
-    explicit = bool(
-        _qualifying_dp(m.axis_sizes())
-    ) and strategy.resolved_comm_overlap()
+    mode = resolve_sync_mode(m.axis_sizes())
+    explicit = mode is not None and strategy.resolved_comm_overlap()
+
+    def _axis_rate(axis: str):
+        """(sec/byte, latency) of one collective over ``axis`` — an
+        axis listed WHOLE in dcn_axes rides DCN (the hybrid dp case,
+        dp_slices() > 1, is handled by the two-level split below, not
+        here), everything else its measured ICI rate."""
+        whole_dcn = axis in m.dcn_axes and not (
+            axis == "dp" and slices > 1
+        )
+        if whole_dcn:
+            return model.sec_per_dcn_byte(), model.dcn_lat_s
+        return model.sec_per_axis_byte(axis), model.ici_lat_s
+
+    def _dp_legs(chunk: float, dp: int) -> float:
+        """Seconds of the dp-axis sync of a per-device ``chunk``."""
+        if dp <= 1:
+            return 0.0
+        if slices > 1:
+            per = dp // slices
+            # ICI legs stay full precision; only the DCN shard
+            # compresses
+            return (
+                2.0 * (per - 1) / per * chunk
+                * model.sec_per_axis_byte("dp")
+                + 2 * per * model.ici_lat_s
+                + 2.0 * (slices - 1) / slices * (chunk / per) * c
+                * model.sec_per_dcn_byte()
+                + 2 * slices * model.dcn_lat_s
+            )
+        rate, lat = _axis_rate("dp")
+        return 2.0 * (dp - 1) / dp * chunk * c * rate + 2 * dp * lat
+
+    if explicit and mode.kind == "zero":
+        F = mode.fsdp
+        rate, lat = _axis_rate("fsdp")
+        fsdp_s = (F - 1) / F * payload * rate + F * lat
+        return fsdp_s + _dp_legs(payload / F, mode.dp)
+    if explicit and mode.kind == "tp":
+        # tp plans never compress and sync with one flat psum per
+        # bucket over the WHOLE dp axis — if dp spans DCN anywhere
+        # (whole-axis or hybrid), that ring crosses it and must be
+        # billed at DCN rate (there is no two-level split on this
+        # path; plans force slices=1)
+        dp = mode.dp
+        if "dp" in m.dcn_axes:
+            rate, lat = model.sec_per_dcn_byte(), model.dcn_lat_s
+        else:
+            rate, lat = _axis_rate("dp")
+        return (
+            2.0 * (dp - 1) / dp * (payload / mode.model_shard) * rate
+            + 2 * dp * lat
+        )
     if explicit and slices > 1:
-        per = m.dp // slices
-        # ICI legs stay full precision; only the DCN shard compresses
-        ici_s = (
-            2.0 * (per - 1) / per * payload * model.sec_per_ici_byte()
-            + 2 * per * model.ici_lat_s
-        )
-        dcn_s = (
-            2.0 * (slices - 1) / slices * (payload / per) * c
-            * model.sec_per_dcn_byte()
-            + 2 * slices * model.dcn_lat_s
-        )
-        return ici_s + dcn_s
+        return _dp_legs(payload, mode.dp)
     ring = 2.0 * (n - 1) / n
     crosses_dcn = any(a in m.dcn_axes for a in ("dp", "fsdp"))
     sec_per_byte = (
@@ -840,10 +1190,10 @@ def _measure_sync(
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    sh = NamedSharding(mesh, P(("dp",)))
+    sh = NamedSharding(mesh, P(plan.stack_axes))
     stacked = [
         jax.device_put(
-            jnp.zeros((plan.dp,) + shape, jnp.dtype(dt)), sh
+            jnp.zeros((plan.total,) + shape, jnp.dtype(dt)), sh
         )
         for shape, dt in zip(plan.leaf_shapes, plan.leaf_dtypes)
     ]

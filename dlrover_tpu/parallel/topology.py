@@ -103,6 +103,14 @@ class LinkModel:
                 return bw
         return self.ici_gbps
 
+    def sec_per_axis_byte(self, axis: str) -> float:
+        """Per-ICI-axis pricing: different mesh axes can ride
+        different numbers of physical links, and the probe measures
+        each axis with size > 1 (e.g. a dp x fsdp mesh carries both a
+        "dp" and an "fsdp" entry). Falls back to the conservative
+        bottleneck ``ici_gbps`` for unmeasured axes."""
+        return 1.0 / max(self.axis_gbps(axis) * 1e9, 1.0)
+
     @property
     def ordering_ok(self) -> bool:
         """The sanity invariant: chip fabric >= cross-slice network >=

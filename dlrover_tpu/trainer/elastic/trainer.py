@@ -100,12 +100,15 @@ class TrainerConfig:
     # (docs/elastic-resize.md: the speculative-compile budget knob)
     spec_compile_budget_s: float = 120.0
     # -- overlap-scheduled gradient sync (parallel/grad_sync.py) -------
-    # bucketed per-bucket reduce-scatter under shard_map on pure-DP
-    # meshes: independent collectives XLA can overlap with backward
-    # compute, and grad_accum syncs once per optimizer step
+    # bucketed per-bucket collectives under shard_map (pure-dp RS+AG,
+    # dp x fsdp ZeRO reduce-scatter into the shard layout, dp x tp/sp
+    # bucketed dp sync under the GSPMD submesh): independent
+    # collectives XLA can overlap with backward compute, and
+    # grad_accum syncs once per optimizer step
     comm_overlap: bool = False
     # "none" | "int8": int8 collective payloads with error feedback
-    # (implies comm_overlap's explicit sync path)
+    # (implies comm_overlap's explicit sync path; dp/fsdp plans only —
+    # tp plans run uncompressed)
     grad_compress: str = "none"
     # target sync bucket size, MiB; 0 = auto-size per link from the
     # measured topology.LinkModel (DCN-leg target on multi-slice
@@ -513,10 +516,17 @@ class ElasticTrainer:
 
         plan = resolve_plan(self.cfg, self.accel.strategy)
         self._grad_sync_plan = plan
+        stats = self.pipeline_stats
+        # the chosen path is visible state, not an HLO-only fact: the
+        # bench and the metrics registry (grad_sync_explicit gauge via
+        # fold_pipeline_stats) can now see a mesh losing the fast path
+        stats.grad_sync_path = "explicit" if plan is not None else "gspmd"
         if plan is None:
+            # resolve_plan already emitted the once-per-mesh fallback
+            # log when the explicit path was requested — the single
+            # gate owns that visibility
             return
         self.state = ensure_residual(self.state, plan, self.mesh)
-        stats = self.pipeline_stats
         stats.grad_bytes_raw = plan.raw_bytes
         stats.grad_bytes_wire = plan.wire_bytes
         stats.comm_overlap_pct = estimate_overlap_pct(
@@ -1090,20 +1100,20 @@ class ElasticTrainer:
                 )
 
     # -- elastic resize (fast path) ------------------------------------
-    def _strategy_for(self, n_devices: int) -> Strategy:
-        """Strategy for a resized world. Model-parallel axes (tp/sp/ep/
-        pp) are divisibility choices of the MODEL and keep their sizes;
-        the data axes (dp, fsdp) absorb the device delta. When the
-        current shape cannot scale to ``n_devices`` (non-divisible
-        counts — e.g. 6 of 8 hosts), falls back to full candidate
-        enumeration, and raises a clear ValueError when no valid mesh
-        exists at all (never a crash deep inside ``build_mesh``)."""
+    def _strategy_for_exact(self, n_devices: int) -> Optional[Strategy]:
+        """Strategy using EXACTLY ``n_devices``, or None. Model-
+        parallel axes (tp/sp/ep/pp) are divisibility choices of the
+        MODEL and keep their sizes; the data axes (dp, fsdp) absorb
+        the device delta. When the current shape cannot scale, falls
+        back to full candidate enumeration."""
         from dataclasses import replace as dc_replace
 
         s = self.accel.strategy
         m = s.mesh
         fixed = m.tp * m.sp * m.ep * m.pp
-        if n_devices > 0 and n_devices % fixed == 0:
+        if n_devices <= 0:
+            return None
+        if n_devices % fixed == 0:
             rem = n_devices // fixed
             if m.fsdp == 1:
                 dp, fsdp = rem, 1
@@ -1135,13 +1145,7 @@ class ElasticTrainer:
             if c.mesh.pp == 1
         ]
         if not cands:
-            raise ValueError(
-                f"no valid mesh factorization for {n_devices} devices "
-                f"at batch={self.tcfg.batch_size}, "
-                f"seq={self.tcfg.seq_len}: the resize target must let "
-                f"dp*fsdp divide the batch or satisfy the model's "
-                f"axis-divisibility rules"
-            )
+            return None
         return dc_replace(
             cands[0],
             dtype=s.dtype,
@@ -1154,6 +1158,39 @@ class ElasticTrainer:
             comm_overlap=s.comm_overlap,
             grad_compress=s.grad_compress,
             grad_bucket_mb=s.grad_bucket_mb,
+        )
+
+    def _strategy_for(self, n_devices: int) -> Strategy:
+        """Strategy for a resized world, degrading gracefully: a
+        non-divisible count (e.g. 6 of 8 devices at batch 8) no longer
+        fails the resize with a ValueError — the largest valid mesh
+        <= ``n_devices`` wins and the surplus ranks sit idle;
+        ``resize`` trims the device list, logs the warning and sets
+        the ``dlrover_resize_idle_ranks`` gauge (NOT set here — this
+        is also the speculative-compile path, and a hypothetical
+        candidate must not corrupt the live metric). The descending
+        scan is pure-Python candidate enumeration (no compiles), so
+        even an exhaustive miss costs milliseconds. Raises a clear
+        ValueError only when NO device count down to 1 admits a valid
+        mesh (never a crash deep inside ``build_mesh``)."""
+        for n in range(n_devices, 0, -1):
+            s = self._strategy_for_exact(n)
+            if s is None:
+                continue
+            if n < n_devices:
+                logger.info(
+                    f"no valid mesh factorization uses all "
+                    f"{n_devices} devices at batch="
+                    f"{self.tcfg.batch_size}; degrading to "
+                    f"{s.mesh.axis_sizes()} on {n} devices"
+                )
+            return s
+        raise ValueError(
+            f"no valid mesh factorization for any count <= {n_devices} "
+            f"devices at batch={self.tcfg.batch_size}, "
+            f"seq={self.tcfg.seq_len}: the resize target must let "
+            f"dp*fsdp divide the batch or satisfy the model's "
+            f"axis-divisibility rules"
         )
 
     def resize(
@@ -1196,8 +1233,14 @@ class ElasticTrainer:
                 "resize fast path requires a pp=1 current strategy "
                 "(pipeline state has its own layout); restart instead"
             )
+        idle_ranks = 0
         if strategy is None:
             strategy = self._strategy_for(len(devices))
+            if strategy.mesh.num_devices < len(devices):
+                # graceful degradation: the largest valid mesh won;
+                # the surplus ranks sit idle this incarnation
+                idle_ranks = len(devices) - strategy.mesh.num_devices
+                devices = devices[: strategy.mesh.num_devices]
         if strategy.mesh.num_devices != len(devices):
             raise ValueError(
                 f"strategy mesh needs {strategy.mesh.num_devices} "
@@ -1208,6 +1251,21 @@ class ElasticTrainer:
                 "resize fast path supports pp=1, non-offload "
                 "strategies; restart for pipeline/offload changes"
             )
+        # stat/gauge writes only after every validation that can still
+        # abort this resize — a raise above must not leave dashboards
+        # claiming idle ranks for a world that was never built
+        if idle_ranks:
+            logger.warning(
+                f"resize: degrading to {strategy.mesh.num_devices} "
+                f"of {strategy.mesh.num_devices + idle_ranks} devices "
+                f"({strategy.mesh.axis_sizes()}), leaving "
+                f"{idle_ranks} rank(s) idle"
+            )
+        self.pipeline_stats.resize_idle_ranks = idle_ranks
+        self._registry.gauge(
+            "dlrover_resize_idle_ranks",
+            "devices left idle by resize degradation",
+        ).set(float(idle_ranks))
         # stale scale predictions are worthless now — and the resize
         # owns the compile budget
         if self._spec_compiler is not None:
@@ -1440,7 +1498,11 @@ class ElasticTrainer:
                     f"candidate ({e})"
                 )
                 continue
-            task = self._speculative_task(cand, all_devices[:n])
+            # a degraded candidate uses fewer devices than predicted —
+            # lower for the mesh it will actually build
+            task = self._speculative_task(
+                cand, all_devices[: cand.mesh.num_devices]
+            )
             if task is not None:
                 tasks.append(task)
         if not tasks:

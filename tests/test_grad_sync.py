@@ -338,12 +338,13 @@ class TestTrainStepSync:
                 plan.num_buckets
             )
 
-    def test_non_pure_dp_mesh_falls_back(self):
-        """fsdp candidates must still build when comm_overlap is
-        stamped across the whole candidate list."""
+    def test_unsupported_mesh_falls_back(self):
+        """pp/ep candidates must still build when comm_overlap is
+        stamped across the whole candidate list (fsdp and tp meshes
+        now take the explicit path — tests/test_hybrid_sync.py)."""
         cfg = _fp32_tiny()
         mesh = build_mesh(
-            MeshConfig(fsdp=2), devices=jax.devices()[:2]
+            MeshConfig(pp=2), devices=jax.devices()[:2]
         )
         tx = optax.adamw(1e-2)
         state, _ = init_sharded_state(
@@ -562,14 +563,33 @@ class TestStrategyPlumbing:
         assert resolve_plan(
             cfg, Strategy(mesh=MeshConfig(dp=2))
         ) is None  # not requested
+        # pp/ep and 3D meshes keep the GSPMD schedule
         assert resolve_plan(
             cfg,
-            Strategy(mesh=MeshConfig(dp=2, fsdp=2), comm_overlap=True),
-        ) is None  # not pure DP
+            Strategy(mesh=MeshConfig(dp=2, pp=2), comm_overlap=True),
+        ) is None
+        assert resolve_plan(
+            cfg,
+            Strategy(
+                mesh=MeshConfig(dp=2, fsdp=2, tp=2), comm_overlap=True
+            ),
+        ) is None
         plan = resolve_plan(
             cfg, Strategy(mesh=MeshConfig(dp=2), comm_overlap=True)
         )
         assert isinstance(plan, BucketPlan) and plan.dp == 2
+        # dp x fsdp now plans the ZeRO schedule; dp x tp the bucketed
+        # dp sync under the tp submesh (details: test_hybrid_sync.py)
+        zp = resolve_plan(
+            cfg,
+            Strategy(mesh=MeshConfig(dp=2, fsdp=2), comm_overlap=True),
+        )
+        assert zp is not None and zp.zero and zp.fsdp == 2
+        tpp = resolve_plan(
+            cfg,
+            Strategy(mesh=MeshConfig(dp=2, tp=2), comm_overlap=True),
+        )
+        assert tpp is not None and tpp.auto_axes == ("tp",)
 
 
 class TestDryRunnerCommCost:
@@ -620,19 +640,19 @@ class TestDryRunnerCommCost:
         assert r.comm_bytes_per_device == 0.0
         assert r.comm_exposed_s == 0.0
 
-    def test_non_pure_dp_fallback_priced_full_precision(self):
-        """An fsdp candidate carrying the compress knob as an opt name
+    def test_unsupported_mesh_fallback_priced_full_precision(self):
+        """A pp candidate carrying the compress knob as an opt name
         falls back to GSPMD full-precision sync at runtime — the cost
         model must price it that way, not at int8 wire bytes it never
         gets."""
         from dlrover_tpu.accel.strategy import Strategy
 
         plain = self._report(
-            Strategy(mesh=MeshConfig(dp=2, fsdp=2))
+            Strategy(mesh=MeshConfig(dp=2, fsdp=2, tp=2))
         )
         compressed_opts = self._report(
             Strategy(
-                mesh=MeshConfig(dp=2, fsdp=2),
+                mesh=MeshConfig(dp=2, fsdp=2, tp=2),
                 opts=("grad_compress",),
             )
         )
@@ -640,6 +660,26 @@ class TestDryRunnerCommCost:
             compressed_opts.comm_bytes_per_device
             == plain.comm_bytes_per_device
         )
+
+    def test_explicit_fsdp_priced_below_gspmd_allreduce(self):
+        """An fsdp candidate on the explicit path is priced with the
+        ZeRO schedule (reduce-scatter, no gather twin, dp legs on the
+        chunk) — strictly below the monolithic all-reduce its GSPMD
+        twin pays."""
+        from dlrover_tpu.accel.strategy import Strategy
+
+        gspmd = self._report(Strategy(mesh=MeshConfig(dp=2, fsdp=2)))
+        explicit = self._report(
+            Strategy(
+                mesh=MeshConfig(dp=2, fsdp=2), comm_overlap=True
+            )
+        )
+        assert 0 < explicit.comm_bytes_per_device
+        assert (
+            explicit.comm_bytes_per_device
+            < gspmd.comm_bytes_per_device
+        )
+        assert explicit.comm_exposed_s < gspmd.comm_exposed_s
 
 
 # -- residual lifecycle -----------------------------------------------------
@@ -809,7 +849,8 @@ class TestKnobPlumbing:
             _model_cfg=tiny(num_layers=1),
         )
         # 6 % dp4 != 0 -> fast path rejected -> enumeration fallback
-        out = ElasticTrainer._strategy_for(fake, 4)
+        out = ElasticTrainer._strategy_for_exact(fake, 4)
+        assert out is not None
         assert out.comm_overlap is True
         assert out.grad_compress == "int8"
         assert out.grad_bucket_mb == 2

@@ -355,6 +355,28 @@ class TestReshard:
         )
         assert merged["sharded"] is out["sharded"]
 
+    def test_report_carries_axis_changes_and_stitching(self):
+        """Per-dimension reshard visibility (ISSUE 8 satellite): the
+        report names which mesh axes changed degree, and counts the
+        target shards assembled from multiple sources (fsdp 4->2:
+        every target shard concatenates two old shards)."""
+        from dlrover_tpu.ckpt.reshard import reshard_state
+
+        old = build_mesh(MeshConfig(fsdp=4), jax.devices()[:4])
+        new = build_mesh(MeshConfig(fsdp=2), jax.devices()[:2])
+        x = np.arange(32, dtype=np.float32).reshape(8, 4)
+        state = {"w": jax.device_put(x, _named_sharding(old, "fsdp"))}
+        spec = {
+            "w": jax.ShapeDtypeStruct(
+                (8, 4), jnp.float32,
+                sharding=_named_sharding(new, "fsdp"),
+            )
+        }
+        _, report = reshard_state(state, spec)
+        assert report.axis_changes == {"fsdp": (4, 2)}
+        assert report.stitched_shards == 2  # both target shards
+        assert "fsdp 4->2" in report.describe_axis_changes()
+
     def test_shape_change_is_a_clear_error(self):
         from dlrover_tpu.ckpt.reshard import reshard_state
 
@@ -368,6 +390,101 @@ class TestReshard:
         }
         with pytest.raises(ValueError, match="model change"):
             reshard_state(state, spec)
+
+
+class TestReshardAxisChange:
+    """ISSUE 8 satellite: axis-change stitching beyond the dp/fsdp
+    absorb — tp-degree grow/shrink and non-pow2 dp x tp transitions,
+    bitwise-parity with a shm save/restore round-trip (mirrors the
+    existing 4->6 DP test)."""
+
+    def _tp_tree(self, mesh):
+        """Model-shaped leaves: a tp-column-sharded matmul weight, a
+        tp-row-sharded output proj, a replicated norm scale. Dims
+        divide by every tp degree used (2, 3, 4)."""
+        rng = np.random.default_rng(11)
+        return {
+            "wq": jax.device_put(
+                rng.standard_normal((8, 24)).astype(np.float32),
+                _named_sharding(mesh, None, "tp"),
+            ),
+            "wo": jax.device_put(
+                rng.standard_normal((24, 8)).astype(np.float32),
+                _named_sharding(mesh, "tp", None),
+            ),
+            "scale": jax.device_put(
+                rng.standard_normal((16,)).astype(np.float32),
+                _named_sharding(mesh),
+            ),
+            "batchrow": jax.device_put(
+                rng.standard_normal((12, 4)).astype(np.float32),
+                _named_sharding(mesh, ("dp", "fsdp")),
+            ),
+        }
+
+    def _tp_spec(self, tree, mesh):
+        specs = {
+            "wq": _named_sharding(mesh, None, "tp"),
+            "wo": _named_sharding(mesh, "tp", None),
+            "scale": _named_sharding(mesh),
+            "batchrow": _named_sharding(mesh, ("dp", "fsdp")),
+        }
+        return {
+            k: jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=specs[k])
+            for k, v in tree.items()
+        }
+
+    def _roundtrip_via_shm_records(self, state, spec):
+        from dlrover_tpu.ckpt.sharding import (
+            host_shard_records,
+            restore_state,
+        )
+
+        records = host_shard_records(state)
+        by_path = {}
+        for r in records:
+            by_path.setdefault(r.path, []).append(r)
+        return restore_state(spec, lambda p: by_path.get(p, []))
+
+    @pytest.mark.parametrize(
+        "old_mc,old_n,new_mc,new_n",
+        [
+            # tp grow: dp2xtp2 -> dp2xtp4 (each new shard is a slice)
+            (MeshConfig(dp=2, tp=2), 4, MeshConfig(dp=2, tp=4), 8),
+            # tp shrink: tp4 -> tp2 (multi-source concat per shard)
+            (MeshConfig(dp=2, tp=4), 8, MeshConfig(dp=2, tp=2), 4),
+            # non-pow2 dp x tp transitions: 6 = 2x3 -> 3x2 reshapes
+            # BOTH axes at once
+            (MeshConfig(dp=2, tp=3), 6, MeshConfig(dp=3, tp=2), 6),
+            (MeshConfig(dp=3, tp=2), 6, MeshConfig(dp=2, tp=3), 6),
+        ],
+    )
+    def test_bitwise_parity_with_shm_roundtrip(
+        self, old_mc, old_n, new_mc, new_n
+    ):
+        from dlrover_tpu.ckpt.reshard import reshard_state
+
+        old = build_mesh(old_mc, jax.devices()[:old_n])
+        new = build_mesh(new_mc, jax.devices()[:new_n])
+        state = self._tp_tree(old)
+        spec = self._tp_spec(state, new)
+        resharded, report = reshard_state(state, spec)
+        expected = self._roundtrip_via_shm_records(state, spec)
+        for path in state:
+            a = np.asarray(resharded[path])
+            b = np.asarray(expected[path])
+            assert a.tobytes() == b.tobytes(), path
+            assert resharded[path].sharding == spec[path].sharding
+        assert not report.fallback_paths
+        assert report.host_bytes == 0
+        assert "tp" in report.axis_changes
+        old_tp = old_mc.tp
+        new_tp = new_mc.tp
+        assert report.axis_changes["tp"] == (old_tp, new_tp)
+        if new_tp < old_tp:
+            # a tp shrink concatenates old shards: stitching must
+            # actually have run
+            assert report.stitched_shards > 0
 
 
 class TestMeshCandidates:
@@ -494,8 +611,12 @@ class TestTrainerResize:
             assert t._last_candidates == [2, 6, 999]
             assert t._spec_compiler is not None
             assert t._spec_compiler.wait_idle(120.0)
-            with pytest.raises(ValueError, match="no valid mesh"):
-                t._strategy_for(6)
+            # satellite: a non-divisible count no longer raises — the
+            # largest valid mesh <= n wins (6 can't shard batch 8; 4
+            # can) and the surplus ranks would sit idle
+            assert t._strategy_for_exact(6) is None
+            degraded = t._strategy_for(6)
+            assert degraded.mesh.num_devices == 4
             m1 = t.evaluate(max_batches=1)
             fn_a = t._eval_step_fn
             assert fn_a is not None

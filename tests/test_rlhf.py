@@ -113,6 +113,10 @@ class TestGAE:
 
 
 class TestPPO:
+    # slow tier (budget): a ~14s convergence A/B; the PPO machinery
+    # (advantages, ratios, clipping, rescoring) keeps tier-1 unit
+    # coverage in the rest of this class
+    @pytest.mark.slow
     def test_reward_improves(self, cfg):
         """PPO on a programmatic reward (emit token 7) must raise the
         expected reward of rollouts — the whole engine end to end."""
@@ -247,6 +251,10 @@ class TestRewardModel:
             exp.logprobs, np.asarray(rescored), rtol=1e-5, atol=1e-6
         )
 
+    # slow tier (budget): ~15s reward->PPO convergence A/B;
+    # test_learns_preferences keeps the reward model's held-out
+    # generalization in tier-1 and the seam is API-covered above
+    @pytest.mark.slow
     def test_trained_reward_drives_ppo(self, cfg):
         """The trained reward model plugs into the PPO engine behind the
         same reward_fn seam, and PPO moves rollouts toward the preferred

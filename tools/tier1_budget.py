@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Tier-1 wall-clock budget check (pre-PR gate).
+
+The tier-1 suite runs under a hard ``timeout`` (ROADMAP.md: 870 s) and
+has tipped over it twice (PR 6, PR 7), each time getting trimmed
+reactively *after* CI went red. This tool makes the budget a local,
+proactive check: run the suite once with ``--durations``, feed the log
+in, and it reports projected suite time against the budget with a
+configurable headroom margin — exiting nonzero BEFORE a PR lands a
+suite that will blow the timeout.
+
+Usage (the documented pre-PR check — time the run yourself, because
+this environment's pytest suppresses the final ``N passed in Xs``
+summary line, which is also why the tier-1 verify counts dots):
+
+    set -o pipefail; start=$(date +%s)
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \\
+        --durations=25 -p no:cacheprovider 2>&1 | tee /tmp/t1.log
+    python tools/tier1_budget.py /tmp/t1.log \\
+        --wall-seconds $(( $(date +%s) - start ))
+
+    # knobs: --budget 870 --headroom 0.85 --top 15
+
+Exit codes: 0 = within budget x headroom; 1 = projected over; 2 = no
+usable total (no summary line parsed and no ``--wall-seconds`` given).
+
+What it parses:
+
+- total suite wall time: ``--wall-seconds`` when given (always wins —
+  the only reliable source here), else the pytest summary line
+  (``== 562 passed, 3 skipped in 512.34s ==``, bare ``-q`` and
+  ``(0:08:32)`` long forms included) on environments that print one;
+- ``--durations`` lines (``12.34s call tests/test_x.py::test_y``) for
+  the top offenders, aggregated per test id across call/setup/teardown
+  so the report names the tests to trim or mark ``slow`` when the
+  budget is tight.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+# ROADMAP.md tier-1 verify: `timeout -k 10 870 ... pytest tests/ ...`
+DEFAULT_BUDGET_S = 870.0
+# projected time above budget x headroom fails: the margin absorbs CI
+# machine variance and the timeout's own -k grace
+DEFAULT_HEADROOM = 0.85
+
+_SUMMARY_RE = re.compile(
+    r"((?:\d+ \w+[,)]?,? ?)+) ?in (\d+(?:\.\d+)?)s(?: \([0-9:]+\))?"
+)
+_DURATION_RE = re.compile(
+    r"^(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)"
+)
+
+
+def parse_log(
+    text: str,
+) -> Tuple[Optional[float], Dict[str, float], str]:
+    """(total suite seconds, test-id -> aggregated duration seconds,
+    the raw summary tail). Total is None when no summary line parses
+    (a crashed/killed run has no trustworthy number)."""
+    total: Optional[float] = None
+    tail = ""
+    durations: Dict[str, float] = defaultdict(float)
+    for line in text.splitlines():
+        m = _DURATION_RE.match(line.strip())
+        if m:
+            durations[m.group(3)] += float(m.group(1))
+            continue
+        m = _SUMMARY_RE.search(line)
+        if m:
+            total = float(m.group(2))
+            tail = m.group(1).strip()
+    return total, dict(durations), tail
+
+
+def report(
+    total: Optional[float],
+    durations: Dict[str, float],
+    budget_s: float,
+    headroom: float,
+    top: int,
+    out=sys.stdout,
+) -> int:
+    threshold = budget_s * headroom
+    if total is None:
+        print(
+            "tier1_budget: no usable suite total — this environment's "
+            "pytest suppresses the summary line, so time the run "
+            "yourself and pass --wall-seconds (see the module "
+            "docstring for the full recipe)",
+            file=out,
+        )
+        return 2
+    pct = 100.0 * total / budget_s
+    verdict = "OK" if total <= threshold else "OVER"
+    print(
+        f"tier1 suite: {total:.1f}s of {budget_s:.0f}s budget "
+        f"({pct:.0f}%), threshold {threshold:.0f}s "
+        f"(headroom {headroom:.0%}) -> {verdict}",
+        file=out,
+    )
+    offenders: List[Tuple[str, float]] = sorted(
+        durations.items(), key=lambda kv: -kv[1]
+    )[:top]
+    if offenders:
+        covered = sum(d for _, d in offenders)
+        print(
+            f"top {len(offenders)} offenders "
+            f"({covered:.1f}s, {100.0 * covered / total:.0f}% of the "
+            f"suite):",
+            file=out,
+        )
+        for test_id, dur in offenders:
+            print(f"  {dur:8.2f}s  {test_id}", file=out)
+    else:
+        print(
+            "no --durations lines found (add --durations=25 to the "
+            "pytest invocation for the offender report)",
+            file=out,
+        )
+    if total > threshold:
+        over = total - threshold
+        print(
+            f"projected over by {over:.1f}s: trim or @pytest.mark.slow "
+            f"the offenders above before opening the PR",
+            file=out,
+        )
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=(
+            "check a tier-1 pytest log against the suite's wall-clock "
+            "budget (pre-PR gate; see module docstring)"
+        )
+    )
+    ap.add_argument(
+        "log",
+        nargs="?",
+        default="-",
+        help="pytest log file ('-' or omitted = stdin)",
+    )
+    ap.add_argument(
+        "--budget",
+        type=float,
+        default=DEFAULT_BUDGET_S,
+        help=f"suite timeout in seconds (default {DEFAULT_BUDGET_S:.0f},"
+        " the ROADMAP tier-1 `timeout`)",
+    )
+    ap.add_argument(
+        "--headroom",
+        type=float,
+        default=DEFAULT_HEADROOM,
+        help="fail above budget x headroom (default "
+        f"{DEFAULT_HEADROOM})",
+    )
+    ap.add_argument(
+        "--top",
+        type=int,
+        default=15,
+        help="offenders to list (default 15)",
+    )
+    ap.add_argument(
+        "--wall-seconds",
+        type=float,
+        default=None,
+        help="measured suite wall time; overrides (and is the "
+        "reliable substitute for) the log's summary line",
+    )
+    args = ap.parse_args(argv)
+    if args.log == "-":
+        text = sys.stdin.read()
+    else:
+        with open(args.log) as f:
+            text = f.read()
+    total, durations, _ = parse_log(text)
+    if args.wall_seconds is not None:
+        total = args.wall_seconds
+    return report(
+        total, durations, args.budget, args.headroom, args.top
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2396,6 +2396,151 @@ def run_forensics_bench(jax, results: dict, smoke: bool = False):
         shutil.rmtree(flight_tmp, ignore_errors=True)
 
 
+def run_brain_bench(jax, results: dict, smoke: bool = False):
+    """Brain cluster-scheduler closed-loop leg (ISSUE 10): 3 simulated
+    jobs with unequal scaling curves on the local backend, one Brain
+    with the ClusterScheduler over real gRPC, each job's PlanExecutor
+    driving a real ``JobAutoScaler.scale_to`` — the full
+    telemetry→decision→execution→feedback loop. Gates:
+
+    - **(a) convergence**: the closed loop's aggregate goodput-weighted
+      throughput must beat the best static equal split of the same chip
+      budget (``brain_agg_goodput_closed`` vs
+      ``brain_agg_goodput_equal_split``) — a scheduler that cannot beat
+      "give everyone the same" is not earning its resize downtime;
+    - **(b) latency**: ``brain_decision_to_resized_ms`` (median over
+      executed slices, measured plan-emit wall time → scale_to done,
+      over real gRPC) must be reported;
+    - **(c) accounting**: every emitted plan slice ends acked-or-expired
+      (``brain_plans_unresolved`` == 0, ``brain_plans_acked`` > 0) —
+      silent drops are invisible exactly when the loop is broken.
+
+    The simulated jobs report ``goodput_pct`` on their samples exactly
+    the way real masters do (JobMetricCollector → persist_metrics), so
+    the scheduler exercises the PR-7 goodput rows, not a parallel
+    bookkeeping path. One job deliberately never polls its executor for
+    the first rounds so plan expiry is exercised, then resumes.
+    """
+    import statistics
+
+    from dlrover_tpu.brain.plan_exec import PlanExecutor
+    from dlrover_tpu.brain.service import BrainClient, start_brain_service
+    from dlrover_tpu.common import comm
+    from dlrover_tpu.master.job_auto_scaler import JobAutoScaler
+    from dlrover_tpu.master.job_manager import JobManager
+    from dlrover_tpu.master.scaler import CallbackScaler
+
+    total_chips = 12
+    start_n = 4  # the best static equal split of 12 over 3 jobs
+    # true (hidden) scaling curves: near-linear / knee / flat — the
+    # heterogeneity the equal split cannot serve
+    curves = {"bench-lin": 0.95, "bench-knee": 0.55, "bench-flat": 0.20}
+
+    def true_speed(job: str, n: int) -> float:
+        return 10.0 * max(0, n) ** curves[job]
+
+    server, servicer, addr = start_brain_service(
+        scheduler=True, total_chips=total_chips
+    )
+    sched = servicer.scheduler
+    sched.stop()  # drive passes manually: deterministic rounds
+    sched.min_dwell_s = 0.0  # sim rounds are seconds apart, not minutes
+    sched.hysteresis_frac = 0.01
+    jobs = {}
+    try:
+        for job in curves:
+            jm = JobManager()
+            jm.create_initial_nodes(start_n)
+            auto = JobAutoScaler(
+                jm,
+                scaler=CallbackScaler(lambda plan: None),
+                target_nodes=start_n,
+            )
+            cli = BrainClient(addr, job)
+            jobs[job] = (auto, cli, PlanExecutor(cli, auto))
+
+        rounds, skip_polls = (8, 2) if smoke else (12, 3)
+        for rnd in range(rounds):
+            for job, (auto, cli, _ex) in jobs.items():
+                cli.persist_metrics(
+                    comm.JobMetricsSample(
+                        timestamp=time.time(),
+                        alive_nodes=auto.target,
+                        steps_per_sec=true_speed(job, auto.target),
+                        goodput_pct=99.0,
+                    )
+                )
+            sched.run_pass()
+            for job, (_auto, _cli, ex) in jobs.items():
+                # bench-flat goes dark for the first rounds: its slices
+                # must EXPIRE (visibly), not silently vanish
+                if job == "bench-flat" and rnd < skip_polls:
+                    continue
+                ex.poll_once()
+        # a master that dies before ever polling leaves a pending slice
+        # behind: emit one for a job with no executor, age every still-
+        # pending slice past the TTL, and expire — the accounting gate:
+        # the table must converge to acked-or-expired, never silently
+        # dropped rows
+        servicer.record_cluster_plan(
+            servicer.next_plan_version(),
+            [
+                {
+                    "job": "bench-zombie",
+                    "worker_count": 2,
+                    "prev_count": 4,
+                    "reason": "master died before ack (expiry leg)",
+                }
+            ],
+            time.time(),
+        )
+        with servicer._lock:
+            servicer._conn.execute(
+                "UPDATE cluster_plans SET ts = ts - ? "
+                "WHERE status='pending'",
+                (sched.plan_ttl_s + 1,),
+            )
+            servicer._conn.commit()
+        servicer.expire_stale_plans(time.time() - sched.plan_ttl_s)
+
+        alloc = {job: auto.target for job, (auto, _c, _e) in jobs.items()}
+        agg_closed = sum(true_speed(j, n) for j, n in alloc.items())
+        agg_equal = sum(true_speed(j, start_n) for j in curves)
+        latencies = [
+            lat
+            for (_a, _c, ex) in jobs.values()
+            for (_v, _n, lat) in ex.executed
+        ]
+        counts = servicer.plan_status_counts()
+        results["brain_allocation"] = dict(sorted(alloc.items()))
+        results["brain_total_chips"] = total_chips
+        results["brain_agg_goodput_closed"] = round(agg_closed, 2)
+        results["brain_agg_goodput_equal_split"] = round(agg_equal, 2)
+        results["brain_goodput_gain_pct"] = round(
+            100.0 * (agg_closed / agg_equal - 1.0), 2
+        )
+        results["brain_decision_to_resized_ms"] = (
+            round(statistics.median(latencies), 2) if latencies else None
+        )
+        results["brain_plans_emitted"] = sum(counts.values())
+        results["brain_plans_acked"] = counts.get("acked", 0)
+        results["brain_plans_expired"] = counts.get("expired", 0)
+        results["brain_plans_superseded"] = counts.get("superseded", 0)
+        results["brain_plans_unresolved"] = counts.get("pending", 0)
+        # the feedback rows the next pass plans against, visible the
+        # same way tools/brain_ctl.py shows them
+        results["brain_outcome_rows"] = sum(
+            1
+            for r in servicer.plan_history()
+            if r["decision_to_resized_ms"] is not None
+        )
+    finally:
+        for _auto, cli, _ex in jobs.values():
+            cli.close()
+        server.stop(grace=1)
+        servicer.close()
+
+
 def run_smoke() -> int:
     """Fast CPU-only pass over the pipeline + resize keys (CI wiring:
     overlap and resize-fast-path regressions must fail loudly without a
@@ -2445,6 +2590,10 @@ def run_smoke() -> int:
         run_forensics_bench(jax, results, smoke=True)
     except Exception as e:
         results["forensics_error"] = repr(e)
+    try:
+        run_brain_bench(jax, results, smoke=True)
+    except Exception as e:
+        results["brain_error"] = repr(e)
     print(json.dumps(results))
     sys.stdout.flush()
     sys.stderr.flush()
@@ -2542,6 +2691,23 @@ def run_smoke() -> int:
         and results.get("flight_crash_injected") is True
         and results.get("flight_bundle_ok") is True
         and results.get("flight_trace_valid") is True
+        # the brain cluster-scheduler gates (ISSUE 10): the closed
+        # telemetry->decision->execution loop must converge to a
+        # better aggregate goodput than the best static equal split,
+        # report its decision->resized latency, and leave every
+        # emitted plan slice acked-or-expired — a plan silently
+        # dropped is invisible exactly when the loop is broken
+        and "brain_error" not in results
+        and results.get("brain_agg_goodput_closed") is not None
+        and (
+            results["brain_agg_goodput_closed"]
+            > results["brain_agg_goodput_equal_split"]
+        )
+        and results.get("brain_decision_to_resized_ms") is not None
+        and results.get("brain_plans_unresolved") == 0
+        and (results.get("brain_plans_acked") or 0) > 0
+        and (results.get("brain_plans_expired") or 0) > 0
+        and (results.get("brain_outcome_rows") or 0) > 0
     )
     os._exit(0 if ok else 1)
 
@@ -2708,6 +2874,11 @@ def main() -> int:
     except Exception as e:
         results["goodput_closure_error_pct"] = None
         results["forensics_error"] = repr(e)
+    try:
+        run_brain_bench(jax, results)
+    except Exception as e:
+        results["brain_agg_goodput_closed"] = None
+        results["brain_error"] = repr(e)
     try:
         run_mfu(jax, results)
     except Exception as e:

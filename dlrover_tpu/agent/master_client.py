@@ -118,21 +118,40 @@ class MasterClient:
             f"master {self._master_addr} unreachable: {err!r}"
         )
 
-    def get(self, message, retries: int = 3, rpc_timeout: Optional[float] = None):
+    def get(
+        self,
+        message,
+        retries: int = 3,
+        rpc_timeout: Optional[float] = None,
+        retry_budget_s: float = 60.0,
+    ):
         return self._call(
-            self._get_rpc, message, retries=retries, rpc_timeout=rpc_timeout
+            self._get_rpc,
+            message,
+            retries=retries,
+            rpc_timeout=rpc_timeout,
+            retry_budget_s=retry_budget_s,
         )
 
-    def report(self, message, retries: int = 3, idempotent: bool = True):
+    def report(
+        self,
+        message,
+        retries: int = 3,
+        idempotent: bool = True,
+        retry_budget_s: float = 60.0,
+    ):
         """``idempotent=False`` declares that replaying the message on a
         lost *response* would double-apply it server-side (counter adds,
         joins with side effects): such reports get exactly one attempt —
         the caller owns recovery — instead of each call site hand-rolling
-        a ``retries=1`` with a comment."""
+        a ``retries=1`` with a comment. ``retry_budget_s`` bounds the
+        total retry time (see ``_call``) — callers on a cadence (the
+        Brain metrics reporter) pass a budget matching it."""
         return self._call(
             self._report_rpc,
             message,
             retries=retries if idempotent else 1,
+            retry_budget_s=retry_budget_s,
         )
 
     # -- data sharding -------------------------------------------------
@@ -355,6 +374,16 @@ class MasterClient:
     def get_job_metrics(self, last_n: int = 0) -> comm.JobMetrics:
         resp = self.get(comm.JobMetricsRequest(last_n=last_n))
         return resp if resp else comm.JobMetrics()
+
+    def request_scale(self, count: int, node_type: str = "worker") -> bool:
+        """Ask the master to scale its worker group to ``count``
+        (tools/operator seam; executed through the auto-scaler's
+        ``scale_to`` → warm resize path). False when the master has no
+        auto-scaler wired."""
+        resp = self.report(
+            comm.ScaleRequest(node_type=node_type, count=count)
+        )
+        return bool(resp and resp.done)
 
     # -- paral config / misc -------------------------------------------
     def get_paral_config(self) -> comm.ParallelConfig:

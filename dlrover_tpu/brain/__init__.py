@@ -17,3 +17,17 @@ from dlrover_tpu.brain.service import (  # noqa: F401
     BrainServicer,
     start_brain_service,
 )
+
+
+def __getattr__(name):
+    # lazy: scheduler/plan_exec pull in obs + daemon machinery that
+    # plain datastore users (tools reading a store) don't need upfront
+    if name in ("ClusterScheduler", "fit_scaling_curve", "solve_allocation"):
+        from dlrover_tpu.brain import scheduler as _s
+
+        return getattr(_s, name)
+    if name == "PlanExecutor":
+        from dlrover_tpu.brain.plan_exec import PlanExecutor
+
+        return PlanExecutor
+    raise AttributeError(name)

@@ -32,6 +32,7 @@ servicer implements (``job_metrics`` / ``fleet_size_curve`` /
 from __future__ import annotations
 
 import time
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Protocol, Tuple
 
 from dlrover_tpu.common import comm
@@ -292,6 +293,48 @@ def bad_node_exclusion(
     return tuple(sorted(bad))
 
 
+@dataclass
+class JobVerdicts:
+    """The cluster-evidence verdicts about one job, produced once and
+    consumed by BOTH decision entry points: ``run_algorithms`` (the
+    per-job ``optimize()`` RPC) and the ``ClusterScheduler`` pass
+    (brain/scheduler.py) — one code path, two consumers, so the
+    scheduler can never disagree with ``optimize()`` about what the
+    evidence says."""
+
+    hot: Optional[ResourcePlan] = None
+    underperformance: str = ""
+    exclude: Tuple[str, ...] = ()
+
+
+def job_verdicts(
+    ds: Datastore,
+    job: str,
+    samples: Optional[List[comm.JobMetricsSample]] = None,
+    node_unit: int = 1,
+    now: Optional[float] = None,
+    cluster: str = "default",
+    exclude: Optional[Tuple[str, ...]] = None,
+) -> JobVerdicts:
+    """Run the verdict suite over one job. ``exclude`` lets a caller
+    that already computed the cluster-wide bad-node list (the scheduler
+    computes it once per pass, not once per job) pass it through."""
+    samples = ds.job_metrics(job) if samples is None else samples
+    return JobVerdicts(
+        hot=hot_node_adjust(
+            ds, job, samples, node_unit=node_unit, now=now
+        ),
+        underperformance=underperformance_check(
+            ds, job, samples=samples
+        ),
+        exclude=(
+            bad_node_exclusion(ds, now=now, cluster=cluster)
+            if exclude is None
+            else exclude
+        ),
+    )
+
+
 def run_algorithms(
     ds: Datastore,
     job: str,
@@ -330,12 +373,19 @@ def run_algorithms(
             p for p in (plan.reason, init.reason) if p
         )
 
-    hot = hot_node_adjust(ds, job, samples, node_unit=node_unit, now=now)
-    if hot is not None and (plan.worker_count or 0) < (
-        hot.worker_count or 0
+    # the shared verdict suite (also the ClusterScheduler's input —
+    # job_verdicts is the ONE place these judgments are made)
+    v = job_verdicts(
+        ds, job, samples=samples, node_unit=node_unit, now=now,
+        cluster=cluster,
+    )
+    if v.hot is not None and (plan.worker_count or 0) < (
+        v.hot.worker_count or 0
     ):
-        plan.worker_count = hot.worker_count
-        plan.reason = "; ".join(p for p in (plan.reason, hot.reason) if p)
+        plan.worker_count = v.hot.worker_count
+        plan.reason = "; ".join(
+            p for p in (plan.reason, v.hot.reason) if p
+        )
 
     oom = oom_adjust(ds, job, now=now, samples=samples)
     if oom is not None and (plan.worker_memory_mb or 0) < (
@@ -344,10 +394,11 @@ def run_algorithms(
         plan.worker_memory_mb = oom.worker_memory_mb
         plan.reason = "; ".join(p for p in (plan.reason, oom.reason) if p)
 
-    sick = underperformance_check(ds, job, samples=samples)
-    if sick:
-        logger.warning(f"brain: job {job} {sick}")
-        plan.reason = "; ".join(p for p in (plan.reason, sick) if p)
+    if v.underperformance:
+        logger.warning(f"brain: job {job} {v.underperformance}")
+        plan.reason = "; ".join(
+            p for p in (plan.reason, v.underperformance) if p
+        )
 
-    plan.exclude_nodes = bad_node_exclusion(ds, now=now, cluster=cluster)
+    plan.exclude_nodes = v.exclude
     return plan

@@ -1,0 +1,150 @@
+"""Master-side executor of Brain cluster plans.
+
+The execution half of the closed loop (brain/scheduler.py is the
+decision half): each job's master runs one ``PlanExecutor`` that polls
+its slice of the cluster plan over the existing ``BrainClient`` channel
+(redeliver-until-acked, mirroring the PR-7 master→worker command
+pattern), verifies the scheduler's crc sign-off, translates the slice
+into the existing ``ScalePlan`` machinery by calling
+``JobAutoScaler.scale_to`` — which drives whichever platform scaler the
+master was built with (``LocalProcessScaler``, k8s ``PodScaler`` /
+``ElasticJobScaler``, ``RayActorScaler``) and, worker-side, the PR-2/8
+warm-resize fast path — and reports the realized outcome
+(decision→resized latency, current fleet goodput) back into the Brain
+datastore.
+
+Failure semantics:
+
+- a lost poll response or a failed outcome report leaves ``ack``
+  unadvanced → the Brain redelivers the slice next poll; re-executing
+  ``scale_to`` at the same count is an idempotent no-op;
+- a slice whose signature does not verify is rejected (logged, counted
+  in ``dlrover_brain_plans_rejected_total``) and acked so a corrupt row
+  cannot poison-loop the executor — the Brain side still sees it as
+  delivered, and the missing outcome row is the operator's tell;
+- ``decision→resized`` is measured as (execute-done wall time −
+  ``issued_ts``), i.e. it INCLUDES the poll interval and any clock skew
+  between Brain and master — it is the honest end-to-end latency the
+  scheduler's cadence must beat, not just the scale call's cost.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Deque, Optional, Tuple
+
+from dlrover_tpu.common.daemon import PollingDaemon
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class PlanExecutor(PollingDaemon):
+    def __init__(
+        self,
+        brain_client,
+        auto_scaler,
+        goodput_fn: Optional[Callable[[], float]] = None,
+        interval: float = 5.0,
+        registry=None,
+    ):
+        super().__init__("brain-plan-executor", interval)
+        self._client = brain_client
+        self._auto = auto_scaler
+        # () -> current fleet goodput_pct (the PR-7
+        # TelemetryAggregator.fleet_goodput number) for the realized-
+        # outcome feedback row
+        self._goodput_fn = goodput_fn
+        self._ack = 0
+        # (version, worker_count, decision_to_resized_ms) of the most
+        # recent slice executions (bounded: a master lives for weeks;
+        # a redelivered slice appends again — that second latency IS
+        # the end-to-end cost of that delivery) — tests and stats read it
+        self.executed: Deque[Tuple[int, int, float]] = deque(maxlen=256)
+        if registry is None:
+            from dlrover_tpu.obs.metrics import default_registry
+
+            registry = default_registry()
+        self._c_rejected = registry.counter(
+            "dlrover_brain_plans_rejected_total",
+            "cluster plan slices that failed signature verification",
+        )
+
+    @property
+    def acked_version(self) -> int:
+        return self._ack
+
+    def _tick(self):
+        self.poll_once()
+
+    def poll_once(self) -> Optional[int]:
+        """One poll→verify→execute→report cycle. Returns the executed
+        plan version, or None when nothing was pending (or the Brain
+        was unreachable — the redelivery contract makes that safe to
+        swallow here)."""
+        from dlrover_tpu.brain.scheduler import plan_signature
+
+        try:
+            s = self._client.poll_cluster_plan(ack_version=self._ack)
+        except Exception as e:
+            logger.warning(f"cluster plan poll failed: {e!r}")
+            return None
+        if s is None or not s.version:
+            return None
+        if (
+            plan_signature(
+                s.version, s.job_name, s.worker_count, s.issued_ts
+            )
+            != s.sig
+        ):
+            logger.error(
+                f"cluster plan v{s.version} for {s.job_name} failed "
+                f"signature verification; rejecting (not executing)"
+            )
+            self._c_rejected.inc()
+            # ack past it: redelivering a corrupt row forever would
+            # wedge the channel; the absent outcome row is the audit
+            self._ack = max(self._ack, s.version)
+            return None
+        if s.worker_count <= 0:
+            # the signature proves integrity, not sanity: a signed
+            # zero/negative count would evict the job (violating the
+            # scheduler's starvation-floor contract) or make scale_to
+            # raise on every redelivery until the slice expires
+            logger.error(
+                f"cluster plan v{s.version} for {s.job_name} asks for "
+                f"{s.worker_count} workers; rejecting (not executing)"
+            )
+            self._c_rejected.inc()
+            self._ack = max(self._ack, s.version)
+            return None
+        if s.exclude_hosts:
+            self._auto.set_exclude_hosts(s.exclude_hosts)
+        self._auto.scale_to(s.worker_count)
+        latency_ms = max(0.0, (time.time() - s.issued_ts) * 1e3)
+        goodput = 0.0
+        if self._goodput_fn is not None:
+            try:
+                goodput = float(self._goodput_fn() or 0.0)
+            except Exception:
+                goodput = 0.0
+        self.executed.append((s.version, s.worker_count, latency_ms))
+        logger.info(
+            f"executed cluster plan v{s.version}: "
+            f"{s.prev_count}->{s.worker_count} workers "
+            f"({latency_ms:.0f} ms decision->resized; {s.reason})"
+        )
+        try:
+            self._client.report_plan_outcome(
+                s.version,
+                worker_count=s.worker_count,
+                decision_to_resized_ms=latency_ms,
+                realized_goodput_pct=goodput,
+            )
+            self._ack = max(self._ack, s.version)
+        except Exception as e:
+            # ack NOT advanced: the Brain redelivers, scale_to at the
+            # same count is a no-op, and the outcome lands on the retry
+            logger.warning(
+                f"plan outcome report failed (will redeliver): {e!r}"
+            )
+        return s.version

@@ -4,7 +4,12 @@ from __future__ import annotations
 
 import sqlite3
 import threading
-from typing import List
+import time as _time_mod
+from typing import List, Optional
+
+
+def _now() -> float:
+    return _time_mod.time()
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.log import default_logger as logger
@@ -55,6 +60,31 @@ CREATE TABLE IF NOT EXISTS cluster_config (
     key TEXT NOT NULL,
     value TEXT NOT NULL,
     PRIMARY KEY (cluster, key)
+);
+CREATE TABLE IF NOT EXISTS cluster_plans (
+    version INTEGER NOT NULL,
+    job TEXT NOT NULL,
+    ts REAL NOT NULL,
+    worker_count INTEGER NOT NULL,
+    prev_count INTEGER DEFAULT 0,
+    reason TEXT DEFAULT '',
+    exclude_hosts TEXT DEFAULT '',
+    sig INTEGER DEFAULT 0,
+    status TEXT DEFAULT 'pending',
+    status_ts REAL DEFAULT 0,
+    PRIMARY KEY (version, job)
+);
+CREATE INDEX IF NOT EXISTS cluster_plans_job
+    ON cluster_plans (job, status);
+CREATE TABLE IF NOT EXISTS plan_outcomes (
+    version INTEGER NOT NULL,
+    job TEXT NOT NULL,
+    ts REAL NOT NULL,
+    worker_count INTEGER DEFAULT 0,
+    decision_to_resized_ms REAL DEFAULT 0,
+    resized_to_training_ms REAL DEFAULT 0,
+    realized_goodput_pct REAL DEFAULT 0,
+    PRIMARY KEY (version, job)
 );
 """
 
@@ -129,6 +159,8 @@ class BrainServicer:
                 self.record_job_end(message)
             elif isinstance(message, comm.BrainNodeEventReport):
                 self.record_node_event(message)
+            elif isinstance(message, comm.PlanOutcomeReport):
+                self.record_plan_outcome(message)
             else:
                 response.success = False
                 response.message = f"unknown {type(message).__name__}"
@@ -158,6 +190,17 @@ class BrainServicer:
                 )
                 response.data = comm.serialize_message(
                     comm.JobMetrics(samples=samples)
+                )
+            elif isinstance(message, comm.ClusterScalePlanRequest):
+                plan = self.cluster_plan_slice(
+                    message.job_name, message.ack_version
+                )
+                response.data = comm.serialize_message(
+                    plan
+                    if plan is not None
+                    else comm.ClusterScalePlanSlice(
+                        job_name=message.job_name
+                    )
                 )
             else:
                 response.success = False
@@ -249,6 +292,223 @@ class BrainServicer:
                 (now - _NODE_EVENT_RETENTION_S,),
             )
             self._conn.commit()
+
+    # -- cluster plan table (the ClusterScheduler's output and the
+    # masters' redeliver-until-acked poll surface; brain/scheduler.py) -
+    def next_plan_version(self) -> int:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT COALESCE(MAX(version), 0) FROM cluster_plans"
+            ).fetchone()
+        return int(row[0]) + 1
+
+    def latest_plan_version(self) -> int:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT COALESCE(MAX(version), 0) FROM cluster_plans"
+            ).fetchone()
+        return int(row[0])
+
+    def record_cluster_plan(
+        self, version: int, slices: List[dict], now: float
+    ):
+        """Persist one versioned plan (one row per changed job), crc-
+        signed per slice. Older still-pending slices for the same jobs
+        are superseded — a master must only ever see the newest
+        statement about itself."""
+        from dlrover_tpu.brain.scheduler import plan_signature
+
+        with self._lock:
+            for s in slices:
+                self._conn.execute(
+                    "UPDATE cluster_plans SET status='superseded', "
+                    "status_ts=? WHERE job=? AND status='pending'",
+                    (now, s["job"]),
+                )
+                self._conn.execute(
+                    "INSERT INTO cluster_plans VALUES "
+                    "(?,?,?,?,?,?,?,?,?,?)",
+                    (
+                        version, s["job"], now, s["worker_count"],
+                        s.get("prev_count", 0), s.get("reason", ""),
+                        ",".join(s.get("exclude_hosts", ())),
+                        plan_signature(
+                            version, s["job"], s["worker_count"], now
+                        ),
+                        "pending", 0.0,
+                    ),
+                )
+            self._conn.commit()
+
+    def cluster_plan_slice(
+        self, job: str, ack_version: int = 0
+    ) -> Optional[comm.ClusterScalePlanSlice]:
+        """The newest pending slice for ``job`` with version >
+        ``ack_version`` (None when nothing is pending). The ack marks
+        everything up to it acked — the worker-command pattern: a poll
+        is a pure read, the NEXT poll's ack is what clears, so a lost
+        response redelivers instead of dropping."""
+        with self._lock:
+            if ack_version:
+                self._conn.execute(
+                    "UPDATE cluster_plans SET status='acked', "
+                    "status_ts=? WHERE job=? AND version<=? "
+                    "AND status='pending'",
+                    (_now(), job, ack_version),
+                )
+                self._conn.commit()
+            row = self._conn.execute(
+                "SELECT version, worker_count, prev_count, reason, "
+                "exclude_hosts, sig, ts FROM cluster_plans "
+                "WHERE job=? AND status='pending' AND version>? "
+                "ORDER BY version DESC LIMIT 1",
+                (job, ack_version),
+            ).fetchone()
+        if row is None:
+            return None
+        return comm.ClusterScalePlanSlice(
+            version=int(row[0]),
+            job_name=job,
+            worker_count=int(row[1]),
+            prev_count=int(row[2] or 0),
+            reason=row[3] or "",
+            exclude_hosts=[h for h in (row[4] or "").split(",") if h],
+            issued_ts=float(row[6]),
+            sig=int(row[5] or 0),
+        )
+
+    def record_plan_outcome(self, r: comm.PlanOutcomeReport):
+        """Realized-outcome feedback row + the plan's sign-off (status
+        → acked). Replay-safe: the PK upsert makes a retried report a
+        no-op."""
+        now = _now()
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO plan_outcomes VALUES "
+                "(?,?,?,?,?,?,?)",
+                (
+                    r.version, r.job_name, now, r.worker_count,
+                    r.decision_to_resized_ms, r.resized_to_training_ms,
+                    r.realized_goodput_pct,
+                ),
+            )
+            self._conn.execute(
+                "UPDATE cluster_plans SET status='acked', status_ts=? "
+                "WHERE job=? AND version=? AND status='pending'",
+                (now, r.job_name, r.version),
+            )
+            self._conn.commit()
+
+    def expire_stale_plans(self, cutoff_ts: float) -> int:
+        """Pending slices issued before ``cutoff_ts`` expire (their
+        master never acked — dead, partitioned, or predating the
+        executor). The table converges to acked-or-expired: a silently
+        dropped plan would be invisible exactly when the loop is
+        broken."""
+        with self._lock:
+            cur = self._conn.execute(
+                "UPDATE cluster_plans SET status='expired', "
+                "status_ts=? WHERE status='pending' AND ts < ?",
+                (_now(), cutoff_ts),
+            )
+            self._conn.commit()
+        return cur.rowcount or 0
+
+    def plan_status_counts(self) -> dict:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT status, COUNT(*) FROM cluster_plans "
+                "GROUP BY status"
+            ).fetchall()
+        return {r[0]: int(r[1]) for r in rows}
+
+    def last_planned_count(self, job: str) -> int:
+        """The newest acked slice's worker count — the scheduler's
+        notion of the job's CURRENT allocation (0 = never planned;
+        callers fall back to the latest sample's alive_nodes)."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT worker_count FROM cluster_plans WHERE job=? "
+                "AND status='acked' ORDER BY version DESC LIMIT 1",
+                (job,),
+            ).fetchone()
+        return int(row[0]) if row else 0
+
+    def last_plan_ts_by_job(self) -> dict:
+        """job -> ts of its newest emitted slice (any status): seeds
+        min-dwell across a Brain restart."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT job, MAX(ts) FROM cluster_plans GROUP BY job"
+            ).fetchall()
+        return {r[0]: float(r[1]) for r in rows}
+
+    def latest_outcome_latencies(self) -> dict:
+        """job -> newest reported decision->resized latency (ms)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT job, decision_to_resized_ms FROM plan_outcomes "
+                "o WHERE version = (SELECT MAX(version) FROM "
+                "plan_outcomes WHERE job = o.job)"
+            ).fetchall()
+        return {r[0]: float(r[1] or 0.0) for r in rows}
+
+    def plan_history(self, job: str = "") -> List[dict]:
+        """Plan slices (newest first) joined with their outcome rows —
+        the ``tools/brain_ctl.py plans`` view."""
+        query = (
+            "SELECT p.version, p.job, p.ts, p.worker_count, "
+            "p.prev_count, p.reason, p.status, o.decision_to_resized_ms, "
+            "o.realized_goodput_pct FROM cluster_plans p "
+            "LEFT JOIN plan_outcomes o "
+            "ON o.version = p.version AND o.job = p.job"
+        )
+        args: tuple = ()
+        if job:
+            query += " WHERE p.job = ?"
+            args = (job,)
+        query += " ORDER BY p.version DESC, p.job"
+        with self._lock:
+            rows = self._conn.execute(query, args).fetchall()
+        return [
+            {
+                "version": int(r[0]),
+                "job": r[1],
+                "ts": float(r[2]),
+                "worker_count": int(r[3]),
+                "prev_count": int(r[4] or 0),
+                "reason": r[5] or "",
+                "status": r[6],
+                "decision_to_resized_ms": (
+                    float(r[7]) if r[7] is not None else None
+                ),
+                "realized_goodput_pct": (
+                    float(r[8]) if r[8] is not None else None
+                ),
+            }
+            for r in rows
+        ]
+
+    def active_jobs(self, since_ts: float) -> List[str]:
+        """Jobs with a metrics sample newer than ``since_ts`` that have
+        not ended since (a job resubmitted under the same name streams
+        rows newer than its end_ts and counts as active again)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT m.job, MAX(m.ts) AS last_ts FROM job_metrics m "
+                "WHERE m.ts >= ? GROUP BY m.job",
+                (since_ts,),
+            ).fetchall()
+            ends = dict(
+                self._conn.execute(
+                    "SELECT job, end_ts FROM job_end"
+                ).fetchall()
+            )
+        return sorted(
+            r[0]
+            for r in rows
+            if float(ends.get(r[0]) or 0.0) < float(r[1])
+        )
 
     # -- per-cluster configuration (multi-tenant config records, the
     # reference's config tables in the Brain MySQL datastore) ---------
@@ -368,17 +628,37 @@ class BrainServicer:
         )
 
     def close(self):
+        sched = getattr(self, "scheduler", None)
+        if sched is not None:
+            sched.stop()
         with self._lock:
             self._conn.close()
 
 
 def start_brain_service(
-    port: int = 0, db_path: str = ":memory:"
+    port: int = 0,
+    db_path: str = ":memory:",
+    scheduler: bool = False,
+    total_chips: Optional[int] = None,
+    node_unit: int = 1,
 ):
-    """Returns (grpc_server, servicer, addr)."""
+    """Returns (grpc_server, servicer, addr). ``scheduler=True`` (or
+    the ``DLROVER_TPU_CLUSTER_CHIPS`` env naming a budget) also starts
+    the closed-loop ``ClusterScheduler`` daemon over this datastore;
+    the daemon handle lands on ``servicer.scheduler``."""
+    import os as _os
+
     from dlrover_tpu.master.servicer import create_master_service
 
     servicer = BrainServicer(db_path=db_path)
+    servicer.scheduler = None
+    if scheduler or _os.getenv("DLROVER_TPU_CLUSTER_CHIPS"):
+        from dlrover_tpu.brain.scheduler import ClusterScheduler
+
+        servicer.scheduler = ClusterScheduler(
+            servicer, total_chips=total_chips, node_unit=node_unit
+        )
+        servicer.scheduler.start()
     port = port or comm.find_free_port()
     server = create_master_service(port, servicer)
     logger.info(f"brain serving on 127.0.0.1:{port} (db={db_path})")
@@ -387,17 +667,44 @@ def start_brain_service(
 
 class BrainClient:
     """Client + the two adaptor callables masters plug in (parity:
-    dlrover/python/brain/client.py BrainClient)."""
+    dlrover/python/brain/client.py BrainClient).
 
-    def __init__(self, addr: str, job_name: str, timeout: float = 10.0):
+    Retry policy (the PR-5 ``MasterClient._call`` treatment): the
+    series/decision legs — ``persist_metrics`` / ``optimize`` /
+    ``get_job_metrics`` / ``poll_cluster_plan`` /
+    ``report_plan_outcome`` — retry with full-jitter backoff under a
+    per-call ``retry_budget_s``, so a flaky Brain link degrades to
+    bounded latency instead of a dropped sample. The mirror/event legs
+    — ``report_node_event`` / ``report_job_end`` — stay single-attempt
+    fire-and-forget: their callers already run them on daemon threads
+    exactly because a dead Brain must never stall relaunch or job
+    exit, and a retried event is worth less than the thread it blocks.
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        job_name: str,
+        timeout: float = 10.0,
+        retries: int = 3,
+        retry_budget_s: float = 20.0,
+    ):
         from dlrover_tpu.agent.master_client import MasterClient
 
         self._client = MasterClient(addr, timeout=timeout)
         self._job = job_name
+        self._retries = max(1, retries)
+        self._retry_budget_s = retry_budget_s
+
+    @property
+    def job_name(self) -> str:
+        return self._job
 
     def persist_metrics(self, sample: comm.JobMetricsSample):
         return self._client.report(
-            comm.BrainMetricsReport(job_name=self._job, sample=sample)
+            comm.BrainMetricsReport(job_name=self._job, sample=sample),
+            retries=self._retries,
+            retry_budget_s=self._retry_budget_s,
         )
 
     def report_job_end(
@@ -407,13 +714,14 @@ class BrainClient:
         worker_memory_mb: int = 0,
     ):
         """Terminal summary — makes this job part of the history future
-        cold-starts fit from."""
+        cold-starts fit from. Fire-and-forget: single attempt."""
         return self._client.report(
             comm.BrainJobEndReport(
                 job_name=self._job, exit_reason=exit_reason,
                 worker_count=worker_count,
                 worker_memory_mb=worker_memory_mb,
-            )
+            ),
+            retries=1,
         )
 
     def report_node_event(
@@ -425,19 +733,24 @@ class BrainClient:
         cpu_percent: float = 0.0,
     ):
         """oom / failed / hot incidents — feeds OOM-adjust and
-        cluster-level bad-node detection."""
+        cluster-level bad-node detection. Fire-and-forget: single
+        attempt (the mirror leg must never hold its daemon thread
+        through a backoff tail)."""
         return self._client.report(
             comm.BrainNodeEventReport(
                 job_name=self._job, node_id=node_id, hostname=hostname,
                 event=event, memory_mb=memory_mb, cpu_percent=cpu_percent,
-            )
+            ),
+            retries=1,
         )
 
     def optimize(self, node_unit: int = 1) -> ResourcePlan:
         resp = self._client.get(
             comm.BrainOptimizeRequest(
                 job_name=self._job, node_unit=node_unit
-            )
+            ),
+            retries=self._retries,
+            retry_budget_s=self._retry_budget_s,
         )
         if not resp:
             return ResourcePlan()
@@ -450,9 +763,54 @@ class BrainClient:
 
     def get_job_metrics(self, last_n: int = 0) -> List[comm.JobMetricsSample]:
         resp = self._client.get(
-            comm.BrainJobMetricsRequest(job_name=self._job, last_n=last_n)
+            comm.BrainJobMetricsRequest(job_name=self._job, last_n=last_n),
+            retries=self._retries,
+            retry_budget_s=self._retry_budget_s,
         )
         return resp.samples if resp else []
+
+    # -- cluster scheduler channel (brain/scheduler.py) -----------------
+    def poll_cluster_plan(
+        self, ack_version: int = 0
+    ) -> Optional[comm.ClusterScalePlanSlice]:
+        """This job's slice of the newest pending cluster plan, or
+        None. ``ack_version`` is the highest version the caller
+        durably executed — the Brain clears up to it and redelivers
+        anything newer (redeliver-until-acked)."""
+        resp = self._client.get(
+            comm.ClusterScalePlanRequest(
+                job_name=self._job, ack_version=ack_version
+            ),
+            retries=self._retries,
+            retry_budget_s=self._retry_budget_s,
+        )
+        if resp is None or not getattr(resp, "version", 0):
+            return None
+        return resp
+
+    def report_plan_outcome(
+        self,
+        version: int,
+        worker_count: int = 0,
+        decision_to_resized_ms: float = 0.0,
+        resized_to_training_ms: float = 0.0,
+        realized_goodput_pct: float = 0.0,
+    ):
+        """Realized outcome of an executed slice — the plan's sign-off
+        and the feedback row the scheduler's next pass reads.
+        Idempotent upsert server-side, so it gets the retried leg."""
+        return self._client.report(
+            comm.PlanOutcomeReport(
+                job_name=self._job,
+                version=version,
+                worker_count=worker_count,
+                decision_to_resized_ms=decision_to_resized_ms,
+                resized_to_training_ms=resized_to_training_ms,
+                realized_goodput_pct=realized_goodput_pct,
+            ),
+            retries=self._retries,
+            retry_budget_s=self._retry_budget_s,
+        )
 
     # -- master integration seams --------------------------------------
     def reporter(self):

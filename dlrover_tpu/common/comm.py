@@ -386,6 +386,56 @@ class BrainJobMetricsRequest(Message):
     last_n: int = 0
 
 
+# -- Brain cluster scheduler (closed-loop multi-job allocation) -------------
+@dataclass
+class ClusterScalePlanRequest(Message):
+    """Master → Brain poll for this job's slice of the cluster plan.
+
+    ``ack_version`` is the highest plan version the master has durably
+    EXECUTED: the Brain marks versions up to it acked and redelivers
+    anything newer still pending — the PR-7 worker-command
+    redeliver-until-acked pattern, so a lost response re-executes an
+    idempotent ``scale_to`` instead of silently dropping the plan."""
+
+    job_name: str = ""
+    ack_version: int = 0
+
+
+@dataclass
+class ClusterScalePlanSlice(Message):
+    """One job's slice of a versioned cluster plan. ``version == 0``
+    means "no pending plan". ``sig`` is the scheduler's sign-off
+    (crc32 over the version/job/count/ts tuple) — executors verify it
+    before acting so a corrupted or spoofed row cannot resize a job."""
+
+    version: int = 0
+    job_name: str = ""
+    worker_count: int = 0
+    prev_count: int = 0
+    reason: str = ""
+    # cluster-level bad-node exclusion riding the plan (the scheduler's
+    # bad_node_exclusion verdict at emission time)
+    exclude_hosts: List[str] = field(default_factory=list)
+    issued_ts: float = 0.0
+    sig: int = 0
+
+
+@dataclass
+class PlanOutcomeReport(Message):
+    """Master → Brain realized-outcome feedback for an executed plan
+    slice: decision→resized latency plus the goodput the job actually
+    ran at afterwards — the row that lets the scheduler's next pass see
+    the result of its last one. Recording it is the plan's sign-off
+    (status → acked)."""
+
+    job_name: str = ""
+    version: int = 0
+    worker_count: int = 0
+    decision_to_resized_ms: float = 0.0
+    resized_to_training_ms: float = 0.0
+    realized_goodput_pct: float = 0.0
+
+
 @dataclass
 class JobMetrics(Message):
     samples: List[JobMetricsSample] = field(default_factory=list)

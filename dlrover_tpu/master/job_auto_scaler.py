@@ -79,6 +79,18 @@ class JobAutoScaler(PollingDaemon):
         return self._scaler is not None
 
     @property
+    def target(self) -> int:
+        """Current target worker count (the size ``scale_to`` last
+        converged on) — read by the Brain plan executor and stats."""
+        return self._target
+
+    def set_exclude_hosts(self, hosts) -> None:
+        """Public seam onto the platform scaler's anti-affinity list
+        (Brain bad-node exclusion riding a cluster plan slice)."""
+        if self._scaler is not None:
+            self._scaler.set_exclude_hosts(tuple(hosts))
+
+    @property
     def stragglers(self) -> list:
         """Worker ids flagged by the last straggler-detection pass."""
         return list(self._straggler_ranks)

@@ -139,7 +139,25 @@ class LocalJobMaster:
             paral_config_service=self.paral_config_service,
             metric_collector=self.metric_collector,
             telemetry=self.telemetry,
+            auto_scaler=self.auto_scaler,
         )
+        # Brain cluster-scheduler execution leg: poll this job's slice
+        # of the cluster plan and run it through scale_to -> warm
+        # resize, reporting decision->resized latency + realized
+        # goodput back (brain/plan_exec.py)
+        self.plan_executor = None
+        if self._brain_client is not None:
+            from dlrover_tpu.brain.plan_exec import PlanExecutor
+
+            self.plan_executor = PlanExecutor(
+                self._brain_client,
+                self.auto_scaler,
+                goodput_fn=lambda: (
+                    (self.telemetry.fleet_goodput() or {}).get(
+                        "goodput_pct", 0.0
+                    )
+                ),
+            )
         # straggler auto-profile: a newly-flagged worker gets ONE
         # `profile` command per episode, so the flag ships with
         # jax.profiler evidence (obs/flight_recorder.ProfilerCapture)
@@ -180,6 +198,11 @@ class LocalJobMaster:
         # maintained only by the event/relaunch path.
         if self.auto_scaler.has_scaler:
             self.auto_scaler.start()
+            # the plan executor shares the ghost-node rationale above:
+            # executing a cluster plan without a platform scaler would
+            # fabricate table entries nothing launches
+            if self.plan_executor is not None:
+                self.plan_executor.start()
         self.metric_collector.start()
         logger.info(f"local master serving on {self.addr}")
 
@@ -303,6 +326,8 @@ class LocalJobMaster:
         stale), the case a real master death produces."""
         self._stopped.set()
         self.auto_scaler.stop()
+        if self.plan_executor is not None:
+            self.plan_executor.stop()
         self.metric_collector.stop()
         if self._state_saver is not None:
             self._state_saver.stop(final_snapshot=final_snapshot)

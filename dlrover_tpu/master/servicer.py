@@ -46,6 +46,7 @@ class MasterServicer:
         paral_config_service=None,
         metric_collector=None,
         telemetry=None,
+        auto_scaler=None,
     ):
         self._task_manager = task_manager
         self._job_manager = job_manager
@@ -59,6 +60,9 @@ class MasterServicer:
         # obs/aggregate.TelemetryAggregator: per-worker step times,
         # straggler detection, hang attribution
         self._telemetry = telemetry
+        # JobAutoScaler: the ScaleRequest entry (tools/operator-driven
+        # explicit resizes through the same scale_to seam Brain plans use)
+        self._auto_scaler = auto_scaler
         self._lock = threading.Lock()
         self._node_addrs: dict = {}  # node_type -> {rank: addr}
         self._ckpt_steps: dict = {}  # node_id -> latest in-memory ckpt step
@@ -446,6 +450,18 @@ class MasterServicer:
             with self._lock:
                 self._ckpt_steps[message.node_id] = message.step
             return True
+        if isinstance(message, comm.ScaleRequest):
+            # has_scaler gate: a scalerless master executing scale_to
+            # would fabricate node entries nothing launches (the ghost-
+            # node problem local_master.py gates its daemons on)
+            if (
+                self._auto_scaler is None
+                or not self._auto_scaler.has_scaler
+                or message.count < 0
+            ):
+                return comm.SyncResult(done=False)
+            self._auto_scaler.scale_to(message.count)
+            return comm.SyncResult(done=True)
         raise ValueError(f"unknown report message: {type(message).__name__}")
 
     def _join_rendezvous(

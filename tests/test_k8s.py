@@ -789,3 +789,187 @@ def test_recreated_same_name_job_gets_fresh_master():
     assert new_uid != old_uid
     job = api.get_custom_object("default", "elasticjobs", "x")
     assert job["status"]["phase"] == "Starting"
+
+
+class TestSchedulerPlanK8sExecution:
+    """ISSUE 10 satellite: the Brain cluster scheduler's emitted plan
+    driving the k8s execution leg — PodScaler and ElasticJobScaler
+    converge a scheduler slice through JobAutoScaler.scale_to,
+    including set_exclude_hosts interaction and the
+    relaunch-vs-scale-down call ordering."""
+
+    def _brain(self, chips=8):
+        from dlrover_tpu.brain.service import start_brain_service
+
+        server, servicer, addr = start_brain_service(
+            scheduler=True, total_chips=chips
+        )
+        servicer.scheduler.stop()
+        servicer.scheduler.min_dwell_s = 0.0
+        servicer.scheduler.hysteresis_frac = 0.0
+        return server, servicer, addr
+
+    def _job(self, addr, job, scaler, start_n):
+        from dlrover_tpu.brain.plan_exec import PlanExecutor
+        from dlrover_tpu.brain.service import BrainClient
+        from dlrover_tpu.master.job_auto_scaler import JobAutoScaler
+        from dlrover_tpu.master.job_manager import JobManager
+
+        jm = JobManager()
+        jm.create_initial_nodes(start_n)
+        auto = JobAutoScaler(jm, scaler=scaler, target_nodes=start_n)
+        client = BrainClient(addr, job)
+        return auto, client, PlanExecutor(client, auto)
+
+    def _seed(self, servicer, grows, shrinks):
+        """Two jobs: `grows` scales near-linearly, `shrinks` is past
+        its knee — the scheduler moves chips from one to the other."""
+        from dlrover_tpu.common import comm
+
+        for job, b in ((grows, 0.95), (shrinks, 0.2)):
+            servicer.persist_metrics(
+                job,
+                comm.JobMetricsSample(
+                    timestamp=time.time(),
+                    alive_nodes=4,
+                    steps_per_sec=10 * 4**b,
+                    goodput_pct=99.0,
+                ),
+            )
+
+    def test_pod_scaler_executes_scheduler_plan(self):
+        from dlrover_tpu.common import comm
+
+        server, servicer, addr = self._brain()
+        api = FakeK8sApi()
+        scaler = PodScaler(api, "kgrow", master_addr="10.0.0.1:5000")
+        auto, client, executor = self._job(addr, "kgrow", scaler, 4)
+        try:
+            # cluster evidence condemns a host before the plan lands
+            for job in ("other-a", "other-b"):
+                servicer.record_node_event(
+                    comm.BrainNodeEventReport(
+                        job_name=job, hostname="cursed", event="failed"
+                    )
+                )
+            self._seed(servicer, grows="kgrow", shrinks="kshrink")
+            v = servicer.scheduler.run_pass()
+            assert v is not None
+            assert executor.poll_once() == v
+            assert auto.target > 4
+            # the new ranks exist as pods, each carrying the Brain's
+            # anti-affinity (set_exclude_hosts ran before scale)
+            new_pods = [
+                p
+                for name, p in api.pods.items()
+                if int(p["metadata"]["labels"][
+                    "elastic.dlrover-tpu.org/rank-index"
+                ]) >= 4
+            ]
+            assert len(new_pods) == auto.target - 4
+            for pod in new_pods:
+                expr = pod["spec"]["affinity"]["nodeAffinity"][
+                    "requiredDuringSchedulingIgnoredDuringExecution"
+                ]["nodeSelectorTerms"][0]["matchExpressions"][0]
+                assert expr["operator"] == "NotIn"
+                assert expr["values"] == ["cursed"]
+            # outcome feedback signed off
+            assert servicer.plan_history("kgrow")[0]["status"] == "acked"
+        finally:
+            client.close()
+            server.stop(grace=1)
+            servicer.close()
+
+    def test_pod_scaler_scale_down_deletes_no_creates(self):
+        server, servicer, addr = self._brain()
+        api = FakeK8sApi()
+        scaler = PodScaler(api, "kshr")
+        auto, client, executor = self._job(addr, "kshr", scaler, 4)
+        try:
+            # materialize the initial world so deletions are observable
+            scaler.scale(
+                ScalePlan(launch_nodes=auto._job_manager.get_nodes())
+            )
+            assert len(api.pods) == 4
+            self._seed(servicer, grows="kother", shrinks="kshr")
+            v = servicer.scheduler.run_pass()
+            assert executor.poll_once() == v
+            assert auto.target < 4
+            # scale-down: highest ranks removed, survivors untouched
+            assert len(api.pods) == auto.target
+            ranks = sorted(
+                int(p["metadata"]["labels"][
+                    "elastic.dlrover-tpu.org/rank-index"
+                ])
+                for p in api.pods.values()
+            )
+            assert ranks == list(range(auto.target))
+        finally:
+            client.close()
+            server.stop(grace=1)
+            servicer.close()
+
+    def test_pod_scaler_relaunch_deletes_before_create(self):
+        """Relaunch (remove+create in ONE plan) must delete the dead
+        pod before creating its replacement — create-first would race
+        the doomed pod for the host's capacity."""
+
+        class _OrderedApi(FakeK8sApi):
+            def __init__(self):
+                super().__init__()
+                self.calls = []
+
+            def create_pod(self, namespace, body):
+                self.calls.append(("create", body["metadata"]["name"]))
+                return super().create_pod(namespace, body)
+
+            def delete_pod(self, namespace, name):
+                self.calls.append(("delete", name))
+                return super().delete_pod(namespace, name)
+
+        api = _OrderedApi()
+        scaler = PodScaler(api, "krel")
+        old, new = _node(0), _node(7, rank=0)
+        scaler.scale(ScalePlan(launch_nodes=[old]))
+        api.calls.clear()
+        scaler.relaunch_node(old, new)
+        assert api.calls == [
+            ("delete", "krel-worker-0"),
+            ("create", "krel-worker-7"),
+        ]
+
+    def test_elasticjob_scaler_executes_scheduler_plan(self):
+        """The operator path: the scheduler slice becomes a ScalePlan
+        CR carrying replica counts, explicit pod lists AND the
+        exclude-hosts the operator renders as anti-affinity."""
+        from dlrover_tpu.common import comm
+
+        server, servicer, addr = self._brain()
+        api = FakeK8sApi()
+        scaler = ElasticJobScaler(api, "kcr")
+        auto, client, executor = self._job(addr, "kcr", scaler, 4)
+        try:
+            for job in ("oa", "ob"):
+                servicer.record_node_event(
+                    comm.BrainNodeEventReport(
+                        job_name=job, hostname="bad-host", event="oom"
+                    )
+                )
+            self._seed(servicer, grows="kcr", shrinks="kother")
+            v = servicer.scheduler.run_pass()
+            assert executor.poll_once() == v
+            plans = api.list_custom_objects("default", "scaleplans")
+            assert plans, "no ScalePlan CR written"
+            spec = plans[-1]["spec"]
+            assert spec["ownerJob"] == "kcr"
+            assert (
+                spec["replicaResourceSpecs"]["worker"]["replicas"]
+                == auto.target
+            )
+            created = {p["rankIndex"] for p in spec["createPods"]}
+            assert created == set(range(4, auto.target))
+            assert spec["excludeHosts"] == ["bad-host"]
+        finally:
+            client.close()
+            server.stop(grace=1)
+            servicer.close()

@@ -63,3 +63,78 @@ class TestRayPlatform:
 
         parts = shlex.split(sub["entrypoint"])
         assert parts[-1] == "my run"  # space-containing arg intact
+
+
+class TestSchedulerPlanRayExecution:
+    """ISSUE 10: the same Brain cluster plan drives the ray backend —
+    scheduler slice → PlanExecutor → JobAutoScaler.scale_to →
+    RayActorScaler converging named actors."""
+
+    def test_ray_scaler_executes_scheduler_plan(self):
+        import time
+
+        from dlrover_tpu.brain.plan_exec import PlanExecutor
+        from dlrover_tpu.brain.service import (
+            BrainClient,
+            start_brain_service,
+        )
+        from dlrover_tpu.common import comm
+        from dlrover_tpu.master.job_auto_scaler import JobAutoScaler
+        from dlrover_tpu.master.job_manager import JobManager
+
+        server, servicer, addr = start_brain_service(
+            scheduler=True, total_chips=8
+        )
+        servicer.scheduler.stop()
+        servicer.scheduler.min_dwell_s = 0.0
+        servicer.scheduler.hysteresis_frac = 0.0
+        api = FakeRayApi()
+        scaler = RayActorScaler(
+            api, "rgrow", training_cmd=["t.py"],
+            master_addr="10.0.0.1:5000",
+        )
+        jm = JobManager()
+        jm.create_initial_nodes(2)
+        auto = JobAutoScaler(jm, scaler=scaler, target_nodes=2)
+        client = BrainClient(addr, "rgrow")
+        executor = PlanExecutor(client, auto)
+        try:
+            for job, b, n in (("rgrow", 0.95, 2), ("rother", 0.2, 4)):
+                servicer.persist_metrics(
+                    job,
+                    comm.JobMetricsSample(
+                        timestamp=time.time(),
+                        alive_nodes=n,
+                        steps_per_sec=10 * n**b,
+                        goodput_pct=99.0,
+                    ),
+                )
+            v = servicer.scheduler.run_pass()
+            assert v is not None
+            assert executor.poll_once() == v
+            assert auto.target > 2
+            # the new ranks run as named actors with the launcher cmd
+            assert len(api.actors) == auto.target - 2
+            some = next(iter(api.actors.values()))
+            assert "--master-addr=10.0.0.1:5000" in some["cmd"]
+            assert servicer.plan_history("rgrow")[0]["status"] == "acked"
+
+            # the NEXT plan scales back down: actors are removed
+            servicer.record_cluster_plan(
+                servicer.next_plan_version(),
+                [
+                    {
+                        "job": "rgrow",
+                        "worker_count": 2,
+                        "prev_count": auto.target,
+                        "reason": "test shrink",
+                    }
+                ],
+                time.time(),
+            )
+            assert executor.poll_once() is not None
+            assert auto.target == 2
+        finally:
+            client.close()
+            server.stop(grace=1)
+            servicer.close()

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Brain datastore inspector: cluster state, fitted scaling curves, and
+the cluster-plan history from a Brain SQLite store.
+
+The ClusterScheduler (dlrover_tpu/brain/scheduler.py) makes allocation
+decisions from the ``job_metrics`` / ``node_events`` rows and writes
+them to the ``cluster_plans`` / ``plan_outcomes`` tables; this CLI is
+the operator's window into that loop — what the scheduler believes
+(curves, goodput), what it decided (plans + statuses), and what
+actually happened (realized-outcome feedback rows).
+
+Usage:
+
+    python tools/brain_ctl.py <brain.db> jobs
+    python tools/brain_ctl.py <brain.db> curves [--job JOB]
+    python tools/brain_ctl.py <brain.db> plans  [--job JOB]
+    python tools/brain_ctl.py <brain.db> events [--job JOB]
+    # any subcommand: --json for machine-readable output
+
+Exit codes: 0 = ok; 1 = usage / missing store.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+try:  # script execution (`python tools/brain_ctl.py`) without an
+    import dlrover_tpu  # noqa: F401  # installed package: fall back to
+except ImportError:  # the repo root next to this file
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _open_store(db_path: str):
+    from dlrover_tpu.brain.service import BrainServicer
+
+    return BrainServicer(db_path=db_path)
+
+
+def _job_rows(servicer) -> List[dict]:
+    now = time.time()
+    active = set(servicer.active_jobs(0.0))
+    with servicer._lock:
+        jobs = [
+            r[0]
+            for r in servicer._conn.execute(
+                "SELECT DISTINCT job FROM job_metrics ORDER BY job"
+            ).fetchall()
+        ]
+    out = []
+    for job in jobs:
+        samples = servicer.job_metrics(job, last_n=1)
+        s = samples[-1] if samples else None
+        out.append(
+            {
+                "job": job,
+                "active": job in active,
+                "alive_nodes": s.alive_nodes if s else 0,
+                "steps_per_sec": round(s.steps_per_sec, 3) if s else 0.0,
+                "goodput_pct": round(s.goodput_pct, 2) if s else 0.0,
+                "last_sample_age_s": (
+                    round(now - s.timestamp, 1) if s else None
+                ),
+                "planned_count": servicer.last_planned_count(job) or None,
+            }
+        )
+    return out
+
+
+def _curve_rows(servicer, job: str = "") -> List[dict]:
+    # the SAME window + point-builder the scheduler fits from, so the
+    # operator is shown the curve decisions were actually made with
+    from dlrover_tpu.brain.scheduler import (
+        CURVE_FIT_LAST_N,
+        fit_scaling_curve,
+        observed_points,
+    )
+
+    rows = _job_rows(servicer)
+    out = []
+    for r in rows:
+        if job and r["job"] != job:
+            continue
+        samples = servicer.job_metrics(r["job"], last_n=CURVE_FIT_LAST_N)
+        points = observed_points(samples)
+        curve = fit_scaling_curve(points)
+        cur = r["alive_nodes"] or 1
+        out.append(
+            {
+                "job": r["job"],
+                "points": {
+                    str(n): round(v, 3) for n, v in sorted(points.items())
+                },
+                "a": round(curve.a, 4) if curve else None,
+                "b": round(curve.b, 4) if curve else None,
+                "predict_current": (
+                    round(curve.predict(cur), 3) if curve else None
+                ),
+                "predict_double": (
+                    round(curve.predict(2 * cur), 3) if curve else None
+                ),
+            }
+        )
+    return out
+
+
+def _event_rows(servicer, job: str = "") -> List[dict]:
+    return [
+        {
+            "job": e.job_name,
+            "node_id": e.node_id,
+            "hostname": e.hostname,
+            "event": e.event,
+        }
+        for e in servicer.node_events(job=job)
+    ]
+
+
+def _print_table(rows: List[dict], out):
+    if not rows:
+        print("(no rows)", file=out)
+        return
+    cols = list(rows[0])
+    widths = {
+        c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+        for c in cols
+    }
+    print(
+        "  ".join(c.ljust(widths[c]) for c in cols), file=out
+    )
+    for r in rows:
+        print(
+            "  ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols),
+            file=out,
+        )
+
+
+def main(argv: Optional[List[str]] = None, out=None) -> int:
+    out = out or sys.stdout
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("db", help="path to the Brain SQLite store")
+    p.add_argument(
+        "cmd", choices=("jobs", "curves", "plans", "events"),
+    )
+    p.add_argument("--job", default="", help="restrict to one job")
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = p.parse_args(argv)
+    if not os.path.exists(args.db):
+        print(f"no Brain store at {args.db}", file=sys.stderr)
+        return 1
+    servicer = _open_store(args.db)
+    try:
+        if args.cmd == "jobs":
+            rows = _job_rows(servicer)
+            if args.job:
+                rows = [r for r in rows if r["job"] == args.job]
+        elif args.cmd == "curves":
+            rows = _curve_rows(servicer, job=args.job)
+        elif args.cmd == "plans":
+            rows = servicer.plan_history(job=args.job)
+            for r in rows:
+                r["ts"] = round(r["ts"], 2)
+        else:
+            rows = _event_rows(servicer, job=args.job)
+    finally:
+        servicer.close()
+    if args.json:
+        print(json.dumps(rows, indent=2), file=out)
+    else:
+        _print_table(rows, out)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

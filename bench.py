@@ -2541,6 +2541,51 @@ def run_brain_bench(jax, results: dict, smoke: bool = False):
         servicer.close()
 
 
+def run_chaos_bench(jax, results: dict, smoke: bool = False):
+    """Deterministic chaos leg (``tools/chaos.py``): scripted
+    preemption scenarios with hard recovery gates — ISSUE 11's survival
+    contract as CI.
+
+    - **eviction_during_save**: an eviction notice lands while a
+      chunked save is staged; the graceful drain must emergency-commit
+      the CURRENT step inside the grace window, book the drain to the
+      ``eviction`` goodput category (not ``other``), leave a flight
+      bundle, and a resumed trainer must reproduce the uninterrupted
+      run's losses BITWISE with zero wedged threads;
+    - **sigkill_mid_step**: a real trainer subprocess hard-exits
+      (``node.preempt:kill:@K``) mid-run; the restart must resume from
+      a verified checkpoint losing at most one commit interval of
+      steps and stay loss-continuous over the replayed overlap.
+
+    Keys: ``chaos_evict_*`` / ``chaos_kill_*``; ``--smoke`` exits
+    nonzero when either scenario's gate fails.
+    """
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tools"))
+    try:
+        import chaos
+    finally:
+        sys.path.pop(0)
+
+    r = chaos.run_scenario("eviction_during_save", seed=7)
+    results["chaos_evict_ok"] = bool(r.get("ok"))
+    results["chaos_evict_verified_step"] = r.get("verified_step")
+    results["chaos_evict_loss_bitwise"] = r.get("loss_bitwise")
+    results["chaos_evict_goodput_eviction_s"] = r.get(
+        "goodput_eviction_s"
+    )
+    results["chaos_evict_drain_ms"] = r.get("drain_ms")
+    results["chaos_evict_lost_steps"] = r.get("lost_steps")
+    results["chaos_evict_wedged_threads"] = len(
+        r.get("wedged_threads", [])
+    )
+
+    k = chaos.run_scenario("sigkill_mid_step", seed=7)
+    results["chaos_kill_ok"] = bool(k.get("ok"))
+    results["chaos_kill_lost_steps"] = k.get("lost_steps")
+    results["chaos_kill_commit_interval"] = chaos.COMMIT_INTERVAL
+    results["chaos_kill_loss_bitwise"] = k.get("loss_bitwise")
+
+
 def run_smoke() -> int:
     """Fast CPU-only pass over the pipeline + resize keys (CI wiring:
     overlap and resize-fast-path regressions must fail loudly without a
@@ -2594,6 +2639,10 @@ def run_smoke() -> int:
         run_brain_bench(jax, results, smoke=True)
     except Exception as e:
         results["brain_error"] = repr(e)
+    try:
+        run_chaos_bench(jax, results, smoke=True)
+    except Exception as e:
+        results["chaos_error"] = repr(e)
     print(json.dumps(results))
     sys.stdout.flush()
     sys.stderr.flush()
@@ -2708,6 +2757,23 @@ def run_smoke() -> int:
         and (results.get("brain_plans_acked") or 0) > 0
         and (results.get("brain_plans_expired") or 0) > 0
         and (results.get("brain_outcome_rows") or 0) > 0
+        # the chaos gates (ISSUE 11): an eviction mid-save must end in
+        # a verified resumable checkpoint with BITWISE loss continuity,
+        # the drain booked to the `eviction` goodput category and zero
+        # wedged processes; a hard kill mid-step must lose at most one
+        # commit interval of steps — survival regressing is exactly
+        # what must fail CI loudly
+        and "chaos_error" not in results
+        and results.get("chaos_evict_ok") is True
+        and results.get("chaos_evict_loss_bitwise") is True
+        and (results.get("chaos_evict_goodput_eviction_s") or 0) > 0
+        and results.get("chaos_evict_wedged_threads") == 0
+        and results.get("chaos_kill_ok") is True
+        and results.get("chaos_kill_lost_steps") is not None
+        and (
+            results["chaos_kill_lost_steps"]
+            <= results["chaos_kill_commit_interval"]
+        )
     )
     os._exit(0 if ok else 1)
 
@@ -2879,6 +2945,11 @@ def main() -> int:
     except Exception as e:
         results["brain_agg_goodput_closed"] = None
         results["brain_error"] = repr(e)
+    try:
+        run_chaos_bench(jax, results)
+    except Exception as e:
+        results["chaos_evict_ok"] = None
+        results["chaos_error"] = repr(e)
     try:
         run_mfu(jax, results)
     except Exception as e:

@@ -94,6 +94,12 @@ class MasterClient:
                     self._wrap(message),
                     timeout=rpc_timeout or self._timeout,
                 )
+                # fault point rpc.recv: the RESPONSE leg — the server
+                # applied the request but the reply was lost/garbled.
+                # Must ride the same jittered-retry path as send-leg
+                # failures (non-idempotent reports stay single-attempt
+                # through the retries=1 contract, exactly as designed)
+                faults.fire("rpc.recv")
                 resp: comm.BaseResponse = comm.deserialize_message(resp_bytes)
                 if not resp.success:
                     raise RuntimeError(
@@ -196,6 +202,10 @@ class MasterClient:
         rdzv_name: str = RendezvousName.ELASTIC_TRAINING,
         node_group: int = -1,
     ) -> int:
+        # fault point rendezvous.join: death/flake exactly at the join
+        # report — the window where a preempted node can poison world
+        # assembly (the chaos harness scripts `kill` here)
+        faults.fire("rendezvous.join")
         resp = self.report(
             comm.JoinRendezvousRequest(
                 node_id=self._node_id,
@@ -323,6 +333,31 @@ class MasterClient:
         — the agent's WorkerCommandRelay — dedups by id)."""
         resp = self.get(comm.WorkerCommandRequest(ack_id=ack_id))
         return list(resp.commands) if resp is not None else []
+
+    def report_eviction_notice(
+        self, grace_s: float, drain_ms: float = 0.0, reason: str = ""
+    ):
+        """This node received an eviction/preemption notice (SIGTERM,
+        platform deadline, master ``evict`` command) and is draining.
+        The master books it as a SCHEDULED departure — rendezvous
+        exclusion, pre-armed resize, no relaunch budget burned — rather
+        than a crash. ``drain_ms`` > 0 on the post-drain re-report
+        carries the measured drain latency (Brain dwell pricing).
+
+        Single attempt: the caller (the TrainingMonitor's relay) runs
+        on a daemon tick and retries on its own cadence — a backoff
+        tail here would stall the global-step channel exactly while a
+        time-critical drain is in flight (the BrainClient mirror-leg
+        convention)."""
+        return self.report(
+            comm.EvictionNotice(
+                node_id=self._node_id,
+                grace_s=float(grace_s),
+                drain_ms=float(drain_ms),
+                reason=reason,
+            ),
+            retries=1,
+        )
 
     def report_training_status(self, status: int):
         return self.report(

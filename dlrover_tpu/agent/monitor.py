@@ -130,10 +130,37 @@ class TrainingMonitor(PollingDaemon):
         self._client = client
         self._last_step = -1
         self._last_payload_ts = 0.0
+        # (grace_s, drain_ms) last forwarded as an EvictionNotice —
+        # the notice is re-reported only when it changes (the drain's
+        # final write adds the measured drain_ms)
+        self._last_eviction: tuple = ()
 
     def _tick(self):
         metrics = read_runtime_metrics()
         step = int(metrics.get("global_step", -1))
+        # eviction notice relay: the draining trainer has no RPC
+        # client of its own — the metrics file carries the notice and
+        # this daemon turns it into the master's EvictionNotice (the
+        # proactive-resize trigger). Forwarded FIRST: the whole point
+        # is the master acting while the worker still drains.
+        if metrics.get("eviction_pending"):
+            grace = float(metrics.get("eviction_grace_s", 0.0) or 0.0)
+            drain_ms = float(
+                metrics.get("eviction_drain_ms", 0.0) or 0.0
+            )
+            if (grace, drain_ms) != self._last_eviction:
+                self._last_eviction = (grace, drain_ms)
+                try:
+                    self._client.report_eviction_notice(
+                        grace, drain_ms=drain_ms, reason="worker_drain"
+                    )
+                except Exception as e:
+                    # clear the memo so the next tick retries; the
+                    # notice path must never kill the monitor
+                    self._last_eviction = ()
+                    logger.warning(
+                        f"eviction notice relay failed: {e!r}"
+                    )
         if step > self._last_step:
             self._last_step = step
             self._client.report_global_step(step)

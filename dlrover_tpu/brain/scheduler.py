@@ -93,6 +93,19 @@ HYSTERESIS_FRAC = 0.02
 # per this window (the check itself runs every pass)
 UNDERPERF_REFIRE_S = 600.0
 
+# -- preemption pricing (ROADMAP item-1 residue) -----------------------
+# a job with an `eviction` node event inside this window is
+# eviction-prone: its starvation floor rises one node_unit, so the
+# allocator holds headroom where the platform keeps reclaiming chips
+EVICTION_WINDOW_S = 3600.0
+# dwell is priced from MEASURED downtime, not just the constant: a job
+# pays (resize decision->resized latency + eviction drain latency) per
+# reallocation, and must dwell at least this multiple of that price —
+# a ~3.7 s cold tp resize is drained far less often than a 0.2 s warm
+# dp one (`plan_outcomes` records the latencies; eviction events carry
+# drain_ms in their detail)
+DWELL_DOWNTIME_FACTOR = 30.0
+
 ENV_TOTAL_CHIPS = "DLROVER_TPU_CLUSTER_CHIPS"
 DEFAULT_TOTAL_CHIPS = 8
 
@@ -101,6 +114,18 @@ DEFAULT_TOTAL_CHIPS = 8
 # over the SAME window, so operators see the curve decisions were
 # actually made from)
 CURVE_FIT_LAST_N = 64
+
+
+def parse_drain_ms(detail: str) -> float:
+    """``drain_ms=412`` out of an eviction event's detail string; 0.0
+    when absent/garbled (a notice-only event has no measurement yet)."""
+    for tok in (detail or "").split():
+        if tok.startswith("drain_ms="):
+            try:
+                return float(tok.split("=", 1)[1])
+            except ValueError:
+                return 0.0
+    return 0.0
 
 
 def observed_points(samples) -> Dict[int, float]:
@@ -319,9 +344,57 @@ class ClusterScheduler(PollingDaemon):
             "total cluster plan slices ever emitted",
         )
 
+    # -- preemption pricing --------------------------------------------
+    def _recent_evictions(self, job: str, now: float) -> List:
+        """This job's `eviction` node events inside the pricing window
+        (empty when the datastore predates the event feed)."""
+        try:
+            return list(
+                self._ds.node_events(
+                    job=job,
+                    event="eviction",
+                    since_ts=now - EVICTION_WINDOW_S,
+                )
+            )
+        except Exception:
+            return []
+
+    def dwell_for(
+        self,
+        job: str,
+        now: float,
+        evictions: Optional[List] = None,
+        latencies: Optional[Dict[str, float]] = None,
+    ) -> float:
+        """Per-job min-dwell, priced from MEASURED downtime: the
+        configured floor, raised to ``DWELL_DOWNTIME_FACTOR`` × (the
+        job's latest decision→resized latency + its worst recent
+        eviction drain). A job that pays 4 s per reallocation earns a
+        2-minute-plus dwell; a 0.2 s warm-dp job keeps the floor.
+        ``evictions``/``latencies`` let a pass reuse already-fetched
+        rows instead of re-querying per job."""
+        if latencies is None:
+            try:
+                latencies = self._ds.latest_outcome_latencies()
+            except Exception:
+                latencies = {}
+        downtime_s = latencies.get(job, 0.0) / 1e3
+        if evictions is None:
+            evictions = self._recent_evictions(job, now)
+        drains = [
+            parse_drain_ms(getattr(e, "detail", "")) for e in evictions
+        ]
+        if drains:
+            downtime_s += max(drains) / 1e3
+        return max(self.min_dwell_s, DWELL_DOWNTIME_FACTOR * downtime_s)
+
     # -- inputs --------------------------------------------------------
     def job_state(
-        self, job: str, now: float, exclude: Tuple[str, ...] = ()
+        self,
+        job: str,
+        now: float,
+        exclude: Tuple[str, ...] = (),
+        latencies: Optional[Dict[str, float]] = None,
     ) -> JobState:
         """Everything the allocator needs to know about one job,
         including the unified algorithm verdicts (satellite: hot-node /
@@ -340,17 +413,28 @@ class ClusterScheduler(PollingDaemon):
             if s.goodput_pct > 0:
                 goodput = s.goodput_pct
                 break
+        evictions = self._recent_evictions(job, now)
+        floor = self.starvation_floor
+        if evictions:
+            # eviction-prone: the platform keeps reclaiming this job's
+            # chips — hold one extra unit of headroom so each reclaim
+            # degrades it toward the floor instead of through it
+            floor += self.node_unit
         state = JobState(
             job=job,
             curve=curve,
             current=current,
             goodput_pct=goodput,
-            floor=self.starvation_floor,
+            floor=floor,
             frozen=(
                 now - self._last_change.get(job, -math.inf)
-                < self.min_dwell_s
+                < self.dwell_for(
+                    job, now, evictions=evictions, latencies=latencies
+                )
             ),
         )
+        if evictions:
+            state.verdicts.append("eviction_prone")
         v = job_verdicts(
             self._ds,
             job,
@@ -397,8 +481,15 @@ class ClusterScheduler(PollingDaemon):
             self._ds, now=now,
             cluster=getattr(self._ds, "cluster", "default"),
         )
+        try:
+            # one fetch per pass: dwell pricing reads the same map for
+            # every job (hundreds of jobs = hundreds of redundant
+            # plan_outcomes scans otherwise)
+            latencies = self._ds.latest_outcome_latencies()
+        except Exception:
+            latencies = {}
         jobs = [
-            self.job_state(j, now, exclude=exclude)
+            self.job_state(j, now, exclude=exclude, latencies=latencies)
             for j in self._ds.active_jobs(now - self.active_window_s)
         ]
         version: Optional[int] = None

@@ -51,7 +51,8 @@ CREATE TABLE IF NOT EXISTS node_events (
     hostname TEXT,
     event TEXT NOT NULL,
     memory_mb INTEGER,
-    cpu_percent REAL
+    cpu_percent REAL,
+    detail TEXT DEFAULT ''
 );
 CREATE INDEX IF NOT EXISTS node_events_job ON node_events (job, event);
 CREATE INDEX IF NOT EXISTS node_events_ts ON node_events (ts);
@@ -129,6 +130,15 @@ class BrainServicer:
             self._conn.execute(
                 "ALTER TABLE job_metrics ADD COLUMN "
                 "goodput_pct REAL DEFAULT 0"
+            )
+        except sqlite3.OperationalError:
+            pass  # already present
+        # pre-eviction on-disk stores lack the event detail column
+        # (eviction events carry "grace=..s drain_ms=.." — the measured
+        # drain latency the scheduler's dwell gate prices)
+        try:
+            self._conn.execute(
+                "ALTER TABLE node_events ADD COLUMN detail TEXT DEFAULT ''"
             )
         except sqlite3.OperationalError:
             pass  # already present
@@ -279,10 +289,11 @@ class BrainServicer:
         now = _time.time()
         with self._lock:
             self._conn.execute(
-                "INSERT INTO node_events VALUES (?,?,?,?,?,?,?)",
+                "INSERT INTO node_events VALUES (?,?,?,?,?,?,?,?)",
                 (
                     r.job_name, now, r.node_id, r.hostname, r.event,
                     r.memory_mb, r.cpu_percent,
+                    getattr(r, "detail", "") or "",
                 ),
             )
             # incidents are rare, so per-insert retention is cheap (an
@@ -558,7 +569,7 @@ class BrainServicer:
     ):
         query = (
             "SELECT job, node_id, hostname, event, memory_mb, "
-            "cpu_percent FROM node_events"
+            "cpu_percent, detail FROM node_events"
         )
         clauses, args = [], []
         if job:
@@ -578,6 +589,7 @@ class BrainServicer:
             comm.BrainNodeEventReport(
                 job_name=r[0], node_id=r[1] or 0, hostname=r[2] or "",
                 event=r[3], memory_mb=r[4] or 0, cpu_percent=r[5] or 0.0,
+                detail=r[6] or "",
             )
             for r in rows
         ]
@@ -731,15 +743,18 @@ class BrainClient:
         event: str,
         memory_mb: int = 0,
         cpu_percent: float = 0.0,
+        detail: str = "",
     ):
-        """oom / failed / hot incidents — feeds OOM-adjust and
-        cluster-level bad-node detection. Fire-and-forget: single
-        attempt (the mirror leg must never hold its daemon thread
-        through a backoff tail)."""
+        """oom / failed / hot / eviction incidents — feeds OOM-adjust,
+        cluster-level bad-node detection and the scheduler's
+        eviction-aware floors (``detail`` carries drain latency).
+        Fire-and-forget: single attempt (the mirror leg must never
+        hold its daemon thread through a backoff tail)."""
         return self._client.report(
             comm.BrainNodeEventReport(
                 job_name=self._job, node_id=node_id, hostname=hostname,
                 event=event, memory_mb=memory_mb, cpu_percent=cpu_percent,
+                detail=detail,
             ),
             retries=1,
         )

@@ -282,6 +282,22 @@ class NodeFailureReport(Message):
 
 
 @dataclass
+class EvictionNotice(Message):
+    """A worker received an eviction/preemption notice and is draining
+    (SIGTERM, ``DLROVER_TPU_EVICTION_DEADLINE_S``, master ``evict``
+    command). The master treats this as a SCHEDULED departure: exclude
+    the doomed rank from rendezvous, pre-arm the warm resize, relaunch
+    without burning relaunch budget. Re-reported after the drain with
+    ``drain_ms`` set — the measured drain latency the Brain's dwell
+    gate prices (idempotent: the second report updates the event)."""
+
+    node_id: int = 0
+    grace_s: float = 0.0
+    drain_ms: float = 0.0
+    reason: str = ""
+
+
+@dataclass
 class HeartbeatReport(Message):
     node_id: int = 0
     timestamp: float = 0.0
@@ -375,9 +391,12 @@ class BrainNodeEventReport(Message):
     job_name: str = ""
     node_id: int = 0
     hostname: str = ""
-    event: str = ""  # oom | failed | hot
+    event: str = ""  # oom | failed | hot | eviction | ...
     memory_mb: int = 0
     cpu_percent: float = 0.0
+    # free-form context ("grace=30.0s drain_ms=412"): eviction events
+    # carry the measured drain latency the Brain dwell gate parses
+    detail: str = ""
 
 
 @dataclass
@@ -628,7 +647,11 @@ class WorkerCommand(Message):
     """One master-issued command for a specific worker. Kinds:
 
     - ``flight_dump`` — dump a flight-recorder bundle now;
-    - ``profile`` — capture ``arg`` train steps with jax.profiler.
+    - ``profile`` — capture ``arg`` train steps with jax.profiler;
+    - ``evict`` — enter the graceful-drain state machine with a grace
+      window of ``arg`` seconds (0 = the trainer's configured default):
+      finish the in-flight step, emergency shm checkpoint, flush
+      forensics, exit clean.
 
     Commands ride the existing pull architecture: the agent polls them
     off the master (``WorkerCommandRequest``) and relays them to the

@@ -56,6 +56,11 @@ class NodeExitReason:
     # deliberately removed by a scale-down; the rank may come back later
     # with a fresh relaunch budget
     SCALED_DOWN = "scaled_down"
+    # evicted by the platform (spot/preemptible reclaim): a SCHEDULED
+    # departure — the replacement does not burn relaunch budget, the
+    # gap is booked to the `eviction` goodput category, and the Brain
+    # prices the job's floor/dwell accordingly
+    PREEMPTED = "preempted"
 
 
 class JobExitReason:

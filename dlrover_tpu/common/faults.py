@@ -7,10 +7,17 @@ code declares *fault points* (named sites like ``ckpt.shard_write``),
 and a test/bench/operator arms them with spec strings::
 
     site:kind:prob[:seed]
+    site:kind:@N[:seed]
 
     ckpt.shard_write:torn_write:1.0        # every shard write is torn
     ckpt.persist:enospc:0.5:42             # seeded coin per persist
     rpc.send:delay:0.2;prefetch.pull:io_error:0.1
+    node.preempt:kill:@7                   # die at exactly the 7th step
+
+``@N`` is the chaos-harness trigger form: the spec fires on EXACTLY the
+Nth evaluation of its site (and never again) — "SIGKILL the worker at
+its 7th step boundary" is a scripted, replayable event rather than a
+seeded coin.
 
 activated programmatically (``configure``) or via the
 ``DLROVER_TPU_FAULTS`` env var (read once at first use; tests call
@@ -30,10 +37,13 @@ Fault kinds:
   that landed partially despite the journaled rename — FS lying about
   durability); at fixed-size sites (shm) the tail is zeroed instead;
 - ``bit_flip`` — flip one seeded bit of the payload (bit rot / DMA
-  corruption).
+  corruption);
+- ``kill`` — hard process death (``os._exit(137)``, no atexit, no
+  flushes): a SIGKILL/OOM-killer/hard-preemption stand-in the chaos
+  harness (``tools/chaos.py``) scripts at sites like ``node.preempt``.
 
-Control kinds (``enospc``/``io_error``/``delay``) fire at any site
-through :func:`fire`; data kinds only act at sites that pass their
+Control kinds (``enospc``/``io_error``/``delay``/``kill``) fire at any
+site through :func:`fire`; data kinds only act at sites that pass their
 payload through :func:`corrupt`/:func:`corrupt_array`.
 
 Every triggered fault counts into the PR-4 metrics registry
@@ -64,7 +74,7 @@ ENV_VAR = "DLROVER_TPU_FAULTS"
 # race windows deterministically, not to stall test suites)
 DELAY_S = 0.05
 
-KINDS = ("enospc", "io_error", "delay", "torn_write", "bit_flip")
+KINDS = ("enospc", "io_error", "delay", "torn_write", "bit_flip", "kill")
 _DATA_KINDS = ("torn_write", "bit_flip")
 
 # the registered sites — arming a typo'd site is a hard error, so a
@@ -78,27 +88,34 @@ FAULT_SITES = frozenset(
         "ckpt.persist",  # whole persist pass (saver or sync engine)
         "ckpt.shm_stage",  # device/host bytes → shm segment
         "rpc.send",  # MasterClient._call request leg
+        "rpc.recv",  # MasterClient._call response leg
+        "rendezvous.join",  # agent's join-rendezvous report
         "reshard.gather",  # on-device resize state remap
         "prefetch.pull",  # prefetch producer's source pull
+        "node.preempt",  # trainer step boundary (preemption arrival)
     }
 )
 
 
 @dataclass(frozen=True)
 class FaultSpec:
-    """One armed fault: parsed form of ``site:kind:prob[:seed]``."""
+    """One armed fault: parsed form of ``site:kind:prob[:seed]`` or the
+    scripted ``site:kind:@N[:seed]`` form (``nth`` > 0 ⇒ fire on
+    exactly the Nth evaluation, never again)."""
 
     site: str
     kind: str
     prob: float
     seed: int
+    nth: int = 0
 
     @classmethod
     def parse(cls, raw: str) -> "FaultSpec":
         parts = [p.strip() for p in raw.strip().split(":")]
         if len(parts) not in (3, 4):
             raise ValueError(
-                f"fault spec {raw!r}: want site:kind:prob[:seed]"
+                f"fault spec {raw!r}: want site:kind:prob[:seed] "
+                f"or site:kind:@N[:seed]"
             )
         site, kind = parts[0], parts[1]
         if site != "*" and site not in FAULT_SITES:
@@ -111,27 +128,40 @@ class FaultSpec:
                 f"fault spec {raw!r}: unknown kind {kind!r} "
                 f"(known: {list(KINDS)})"
             )
-        try:
-            prob = float(parts[2])
-        except ValueError:
-            raise ValueError(f"fault spec {raw!r}: bad probability")
-        if not 0.0 <= prob <= 1.0:
-            raise ValueError(
-                f"fault spec {raw!r}: probability must be in [0, 1]"
-            )
+        nth = 0
+        if parts[2].startswith("@"):
+            # scripted trigger: exactly the Nth evaluation of the site
+            try:
+                nth = int(parts[2][1:])
+            except ValueError:
+                raise ValueError(f"fault spec {raw!r}: bad @N trigger")
+            if nth <= 0:
+                raise ValueError(
+                    f"fault spec {raw!r}: @N trigger must be >= 1"
+                )
+            prob = 1.0
+        else:
+            try:
+                prob = float(parts[2])
+            except ValueError:
+                raise ValueError(f"fault spec {raw!r}: bad probability")
+            if not 0.0 <= prob <= 1.0:
+                raise ValueError(
+                    f"fault spec {raw!r}: probability must be in [0, 1]"
+                )
         if len(parts) == 4:
             seed = int(parts[3])
         else:
             # no explicit seed: still deterministic — derive from the
             # spec text so the same spec string replays the same run
             seed = zlib.crc32(raw.strip().encode())
-        return cls(site=site, kind=kind, prob=prob, seed=seed)
+        return cls(site=site, kind=kind, prob=prob, seed=seed, nth=nth)
 
 
 class _Armed:
     """A spec plus its private RNG (the determinism unit)."""
 
-    __slots__ = ("spec", "_rng", "_lock")
+    __slots__ = ("spec", "_rng", "_lock", "_visits")
 
     def __init__(self, spec: FaultSpec):
         import random
@@ -139,9 +169,18 @@ class _Armed:
         self.spec = spec
         self._rng = random.Random(spec.seed)
         self._lock = threading.Lock()
+        self._visits = 0  # evaluations of the site (@N scripting)
 
     def draw(self) -> bool:
         with self._lock:
+            if self.spec.nth:
+                # scripted: exactly the Nth evaluation, never again
+                self._visits += 1
+                if self._visits != self.spec.nth:
+                    return False
+                # consume a draw for downstream seeded decisions
+                self._rng.random()
+                return True
             if self.spec.prob >= 1.0:
                 # still consume a draw so downstream decisions (torn
                 # fraction, flipped bit) stay on the seeded sequence
@@ -251,6 +290,12 @@ class FaultInjector:
             raise OSError(errno.EIO, f"injected I/O error at {site}")
         if kind == "delay":
             time.sleep(DELAY_S)
+        if kind == "kill":
+            # hard process death: no atexit, no finally, no flushes —
+            # the closest in-process stand-in for SIGKILL / OOM-killer /
+            # hard preemption (the chaos harness asserts recovery)
+            logger.warning(f"fault kill: hard exit(137) at {site}")
+            os._exit(137)
 
     def fire(self, site: str):
         """Evaluate the control-kind specs armed for ``site``: raise

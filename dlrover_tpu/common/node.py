@@ -143,6 +143,10 @@ class Node:
         self.paral_config: Dict = {}
         self.reported_status: str = ""
         self.restart_training = False
+        # an eviction notice arrived for this node: its coming death is
+        # a SCHEDULED departure (no relaunch budget burned, booked as
+        # `eviction`, host excluded from the next rendezvous)
+        self.evicting = False
 
     # ------------------------------------------------------------------
     # state machine

@@ -18,6 +18,7 @@ consumes resource-optimizer plans. The TPU job is the allreduce shape
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional
 
 from dlrover_tpu.common.constants import (
@@ -73,6 +74,11 @@ class JobAutoScaler(PollingDaemon):
         # policy (and today's operators, via the log) act on
         self._telemetry = telemetry
         self._straggler_ranks: list = []
+        # eviction pre-arm: (count, expiry) published FIRST in the
+        # speculative-compile candidate list — an eviction notice makes
+        # n - node_unit the single most likely next world size, and the
+        # survivors should hold its executable before the death lands
+        self._prearm: Optional[tuple] = None
 
     @property
     def has_scaler(self) -> bool:
@@ -184,17 +190,64 @@ class JobAutoScaler(PollingDaemon):
         if self._scaler is not None:
             self._scaler.scale(plan)
 
+    # -- eviction pre-arming --------------------------------------------
+    def note_eviction(self, node_id: int, grace_s: float = 0.0):
+        """An eviction notice arrived for ``node_id``: treat the coming
+        death as a SCHEDULED departure. Pre-arm the warm resize — the
+        shrunken world (target − unit) jumps to the head of the
+        speculative-compile candidates, published immediately instead
+        of on the next tick — and open a telemetry maintenance window
+        so the drain's deliberate stall is not attributed as a
+        straggler/hang."""
+        node = next(
+            (
+                n
+                for n in self._job_manager.get_nodes(self._node_type)
+                if n.id == node_id
+            ),
+            None,
+        )
+        if node is not None:
+            node.evicting = True
+        shrunk = max(self._node_unit, self._target - self._node_unit)
+        if shrunk != self._target:
+            # pre-arm outlives the grace window by one poll cycle;
+            # after that the normal predictions take back over
+            self._prearm = (
+                shrunk,
+                time.monotonic() + (grace_s or 30.0) + 60.0,
+            )
+        self.publish_scale_candidates()
+        if self._telemetry is not None and hasattr(
+            self._telemetry, "note_maintenance"
+        ):
+            self._telemetry.note_maintenance((grace_s or 30.0) + 30.0)
+        logger.info(
+            f"eviction pre-arm: node {node_id} draining "
+            f"(grace {grace_s:.0f}s); candidate world {shrunk} "
+            f"published ahead of the death"
+        )
+
     # -- speculative-compile feed ---------------------------------------
     def predicted_scale_candidates(self) -> list:
         """Top-k worker counts the next resize is likely to land on,
-        most likely first: the optimizer's standing recommendation (a
-        plan that WILL execute), then one node-unit in each direction
-        of the current target (failure shrink / headroom growth — the
+        most likely first: an eviction pre-arm (a death that WILL
+        happen), the optimizer's standing recommendation (a plan that
+        WILL execute), then one node-unit in each direction of the
+        current target (failure shrink / headroom growth — the
         unit-quantized moves ``scale_to`` can actually make). The
         current target itself is excluded: workers already hold its
         executable."""
+        prearm = None
+        if self._prearm is not None:
+            count, expiry = self._prearm
+            if time.monotonic() < expiry:
+                prearm = count
+            else:
+                self._prearm = None
         out = []
         for want in (
+            prearm,
             self._last_recommendation,
             self._target + self._node_unit,
             self._target - self._node_unit,
@@ -243,10 +296,20 @@ class JobAutoScaler(PollingDaemon):
         plan = ScalePlan()
         with self._job_manager.scale_lock:
             for node in self._job_manager.get_heartbeat_timeout_nodes():
-                logger.warning(
-                    f"{node.name}: no heartbeat; marking failed for "
-                    f"replacement"
-                )
+                if node.evicting:
+                    # the announced death arrived: a scheduled
+                    # departure, not a crash — the replacement keeps
+                    # its relaunch budget (_create_replacement reads
+                    # this reason)
+                    node.exit_reason = NodeExitReason.PREEMPTED
+                    logger.info(
+                        f"{node.name}: evicted as announced; replacing"
+                    )
+                else:
+                    logger.warning(
+                        f"{node.name}: no heartbeat; marking failed "
+                        f"for replacement"
+                    )
                 node.is_released = True
                 node.update_status(NodeStatus.FAILED)
                 plan.remove_nodes.append(node)
@@ -288,8 +351,13 @@ class JobAutoScaler(PollingDaemon):
         ]
         new_id = self._job_manager.allocate_node_id(self._node_type)
         last = max(prior, key=lambda n: n.id) if prior else None
-        if last is not None and last.exit_reason == NodeExitReason.SCALED_DOWN:
-            last = None  # deliberate removal: come back with a fresh budget
+        if last is not None and last.exit_reason in (
+            NodeExitReason.SCALED_DOWN,
+            NodeExitReason.PREEMPTED,
+        ):
+            # deliberate removal / platform eviction: come back with a
+            # fresh budget — scheduled departures are not crash loops
+            last = None
         if last is not None:
             if (
                 not last.relaunchable
@@ -310,6 +378,12 @@ class JobAutoScaler(PollingDaemon):
                 group_size=self._node_unit,
             )
         self._job_manager.add_node(node)
+        # a replacement IS a relaunch for the listeners' purposes —
+        # e.g. the master clears the dead rank's rendezvous exclusion
+        # so the healthy replacement isn't parked for the full TTL
+        self._job_manager.notify_relaunch(
+            max(prior, key=lambda n: n.id) if prior else None, node
+        )
         return node
 
     def scale_to(self, count: int) -> ScalePlan:
@@ -321,6 +395,13 @@ class JobAutoScaler(PollingDaemon):
             raise ValueError(f"cannot scale to {count}")
         if count % self._node_unit:
             count += self._node_unit - count % self._node_unit
+        # a resize is deliberate maintenance: the fleet-wide pause
+        # while workers drain/reshard must not mint stragglers or aim
+        # forensics dumps at healthy workers (obs/aggregate window)
+        if self._telemetry is not None and hasattr(
+            self._telemetry, "note_maintenance"
+        ):
+            self._telemetry.note_maintenance(60.0)
         plan = ScalePlan()
         plan.node_group[self._node_type] = count
         with self._job_manager.scale_lock:

@@ -43,9 +43,10 @@ class JobManager:
         max_relaunch_count: int = 3,
         brain_reporter: Optional[Callable] = None,
     ):
-        # brain_reporter(node_id, hostname, event, memory_mb): incident
-        # feed for the cluster Brain (BrainClient.report_node_event) —
-        # fire-and-forget, failures never block relaunch
+        # brain_reporter(node_id, hostname, event, memory_mb, detail):
+        # incident feed for the cluster Brain
+        # (BrainClient.report_node_event) — fire-and-forget, failures
+        # never block relaunch
         self._brain_reporter = brain_reporter
         self._lock = threading.Lock()
         # serializes replacement decisions between the servicer's event
@@ -59,6 +60,10 @@ class JobManager:
         self._next_node_id: Dict[str, int] = {}
         self._stopped = False
         self._relaunch_listeners: List[Callable[[Node, Node], None]] = []
+        # eviction listeners: the master wires rendezvous exclusion,
+        # auto-scaler pre-arming and telemetry maintenance here —
+        # cb(node_type, node_id, grace_s, drain_ms)
+        self._eviction_listeners: List[Callable] = []
         # bounded log of non-fatal node incidents (degraded checkpoint
         # mode, recoveries, ...): queryable by operators/tests and
         # mirrored to the Brain when a reporter is wired
@@ -170,18 +175,33 @@ class JobManager:
             return True
         if node.exit_reason == NodeExitReason.FATAL_ERROR:
             return False
+        if node.exit_reason == NodeExitReason.PREEMPTED:
+            # scheduled departures replace regardless of budget — the
+            # budget exists to stop crash loops, and an eviction is
+            # the platform's fault, not the workload's
+            return True
         return node.relaunch_count < node.max_relaunch_count
 
     def _handle_node_failure(self, node: Node):
         if self._speed_monitor:
             self._speed_monitor.remove_running_worker(node.id)
-        self._report_to_brain(
-            node,
-            "oom"
-            if node.exit_reason == NodeExitReason.OOM
-            else "failed",
-            node.config_resource.memory_mb,
-        )
+        if node.evicting:
+            # a death that was ANNOUNCED (eviction notice) is a
+            # scheduled departure, not a crash: no OOM doubling, the
+            # Brain sees `eviction_exit` (not `failed`), and the
+            # replacement keeps the old relaunch budget
+            node.exit_reason = NodeExitReason.PREEMPTED
+            self._report_to_brain(
+                node, "eviction_exit", node.config_resource.memory_mb
+            )
+        else:
+            self._report_to_brain(
+                node,
+                "oom"
+                if node.exit_reason == NodeExitReason.OOM
+                else "failed",
+                node.config_resource.memory_mb,
+            )
         if node.exit_reason == NodeExitReason.OOM:
             # give the replacement more memory (parity: reference doubles
             # memory on OOM relaunch via the resource optimizer)
@@ -209,6 +229,12 @@ class JobManager:
             new_id = self.allocate_node_id(node.type)
             new_node = node.get_relaunch_node_info(new_id)
             new_node.exit_reason = NodeExitReason.RELAUNCHED
+            if node.exit_reason == NodeExitReason.PREEMPTED:
+                # a scheduled departure must not burn relaunch budget:
+                # spot fleets are evicted daily, and three evictions
+                # exhausting max_relaunch_count would turn routine
+                # churn into an unrecoverable rank
+                new_node.relaunch_count = node.relaunch_count
             self.add_node(new_node)
         logger.info(
             f"relaunch {node.name} -> {new_node.name} "
@@ -216,11 +242,22 @@ class JobManager:
         )
         if self._scaler is not None:
             self._scaler.relaunch_node(node, new_node)
-        for cb in self._relaunch_listeners:
-            cb(node, new_node)
+        self.notify_relaunch(node, new_node)
 
     def add_relaunch_listener(self, cb: Callable[[Node, Node], None]):
         self._relaunch_listeners.append(cb)
+
+    def notify_relaunch(self, old: Optional[Node], new_node: Node):
+        """Fire the relaunch listeners — the event-path relaunch AND
+        the auto-scaler's replacement creation both go through here,
+        so a listener (e.g. the master clearing a dead rank's
+        rendezvous exclusion for its healthy replacement) sees every
+        way a rank comes back."""
+        for cb in self._relaunch_listeners:
+            try:
+                cb(old, new_node)
+            except Exception as e:
+                logger.warning(f"relaunch listener failed: {e!r}")
 
     def handle_training_failure(
         self,
@@ -256,6 +293,44 @@ class JobManager:
                 node_type, node_id, event, detail=error_data
             )
 
+    def add_eviction_listener(self, cb: Callable):
+        """``cb(node_type, node_id, grace_s, drain_ms)`` fires on every
+        eviction notice (the master wires rendezvous exclusion, resize
+        pre-arming and telemetry maintenance here)."""
+        self._eviction_listeners.append(cb)
+
+    def handle_eviction_notice(
+        self,
+        node_type: str,
+        node_id: int,
+        grace_s: float = 0.0,
+        drain_ms: float = 0.0,
+        reason: str = "",
+    ):
+        """A worker announced its eviction (SIGTERM / platform deadline
+        / operator): book it as a SCHEDULED departure. The node is
+        marked ``evicting`` — its coming death relaunches without
+        burning budget and reports ``eviction_exit`` to the Brain —
+        and the notice fans out to the listeners that pre-arm the warm
+        resize and exclude the doomed rank from rendezvous. Idempotent:
+        the post-drain re-report (``drain_ms`` > 0) updates the
+        recorded event with the measured drain latency."""
+        node = self.get_node(node_type, node_id)
+        if node is not None:
+            node.evicting = True
+        detail = f"grace={grace_s:.1f}s drain_ms={drain_ms:.0f}"
+        if reason:
+            detail += f" {reason}"
+        self.record_node_event(node_type, node_id, "eviction", detail)
+        logger.warning(
+            f"eviction notice for {node_type}-{node_id}: {detail}"
+        )
+        for cb in self._eviction_listeners:
+            try:
+                cb(node_type, node_id, grace_s, drain_ms)
+            except Exception as e:
+                logger.warning(f"eviction listener failed: {e!r}")
+
     def record_node_event(
         self, node_type: str, node_id: int, event: str, detail: str = ""
     ):
@@ -272,9 +347,11 @@ class JobManager:
             del self._node_events[:-200]
         node = self.get_node(node_type, node_id)
         if node is not None:
-            self._report_to_brain(node, event, 0)
+            self._report_to_brain(node, event, 0, detail=detail)
 
-    def _report_to_brain(self, node: Node, event: str, memory_mb: int):
+    def _report_to_brain(
+        self, node: Node, event: str, memory_mb: int, detail: str = ""
+    ):
         """Mirror one node incident to the Brain. Only with a PHYSICAL
         host identity: falling back to the per-job logical name would
         let two unrelated jobs' "worker-0" incidents condemn a phantom
@@ -284,7 +361,7 @@ class JobManager:
         for ~30s."""
         if self._brain_reporter is None or not node.hostname:
             return
-        args = (node.id, node.hostname, event, memory_mb)
+        args = (node.id, node.hostname, event, memory_mb, detail)
 
         def _report():
             try:

@@ -79,8 +79,10 @@ class LocalJobMaster:
             scaler=scaler,
             brain_reporter=(
                 (
-                    lambda nid, host, ev, mem: self._brain_client
-                    .report_node_event(nid, host, ev, memory_mb=mem)
+                    lambda nid, host, ev, mem, detail="":
+                    self._brain_client.report_node_event(
+                        nid, host, ev, memory_mb=mem, detail=detail
+                    )
                 )
                 if self._brain_client
                 else None
@@ -165,6 +167,21 @@ class LocalJobMaster:
             lambda w: self.servicer.queue_worker_command(
                 w, "profile", arg=3, reason="straggler"
             )
+        )
+        # eviction notices fan out here: exclude the doomed rank from
+        # world assembly, pre-arm the warm resize (speculative n-1
+        # compile on the survivors), and open the telemetry
+        # maintenance window so the deliberate drain stall is never
+        # attributed as a straggler or hang
+        self.job_manager.add_eviction_listener(self._on_eviction_notice)
+        # ...and the rank's HEALTHY replacement must not inherit the
+        # doomed incarnation's exclusion: any relaunch/replacement of
+        # a rank clears it immediately instead of waiting out the TTL
+        self.job_manager.add_relaunch_listener(
+            lambda old, new: [
+                mgr.clear_exclusion(new.rank_index)
+                for mgr in self.rdzv_managers.values()
+            ]
         )
         self._server = None
         self._brain_end_thread: Optional[threading.Thread] = None
@@ -265,8 +282,16 @@ class LocalJobMaster:
                 # for a flight-recorder bundle before the restart kills
                 # the evidence (a fully wedged trainer won't poll the
                 # command file — its own hang watchdog covers that
-                # case; this catches the partially-alive ones)
-                attributed = sorted(self.telemetry.hang_attribution())
+                # case; this catches the partially-alive ones). A
+                # maintenance window (resize / eviction drain) means
+                # the stall is DELIBERATE: dumping "hang" evidence of
+                # healthy drains would forge forensics, so the dump
+                # round is skipped
+                attributed = (
+                    []
+                    if self.telemetry.in_maintenance()
+                    else sorted(self.telemetry.hang_attribution())
+                )
                 for w in attributed:
                     self.servicer.queue_worker_command(
                         w, "flight_dump", reason="hang"
@@ -291,6 +316,41 @@ class LocalJobMaster:
     def scale_to(self, count: int):
         """Explicit resize API (operator / Brain seam)."""
         return self.auto_scaler.scale_to(count)
+
+    def _on_eviction_notice(
+        self, node_type: str, node_id: int, grace_s: float,
+        drain_ms: float,
+    ):
+        """JobManager eviction-listener leg (one notice may re-fire
+        with the measured ``drain_ms`` — every step is idempotent)."""
+        node = self.job_manager.get_node(node_type, node_id)
+        rank = node.rank_index if node is not None else node_id
+        ttl = (grace_s or 30.0) + 60.0
+        for mgr in self.rdzv_managers.values():
+            mgr.exclude_node(rank, ttl_s=ttl)
+        self.auto_scaler.note_eviction(node_id, grace_s=grace_s)
+
+    def evict_worker(
+        self, node_id: int, grace_s: float = 0.0, reason: str = "operator"
+    ):
+        """Master-initiated eviction (operator drain, platform
+        preemption watcher): queue the ``evict`` worker command — the
+        trainer enters its grace-window drain — and book the departure
+        as scheduled on this side immediately. The command arg is an
+        int: fractional windows round UP (``int()`` would turn a 0.9 s
+        window into arg=0 = "use the 30 s default" while the platform
+        kills in under a second); 0 still means the trainer default."""
+        import math
+
+        self.servicer.queue_worker_command(
+            node_id,
+            "evict",
+            arg=(int(math.ceil(grace_s)) if grace_s > 0 else 0),
+            reason=reason,
+        )
+        self.job_manager.handle_eviction_notice(
+            "worker", node_id, grace_s=grace_s, reason=reason
+        )
 
     def _report_job_end(self, exit_reason: str):
         """Terminal summary → Brain (the rows cross-job cold-start fits

@@ -72,6 +72,12 @@ class RendezvousManager:
         self._lastcall_time = 0.0
         self._coordinator_addr = ""
         self._node_groups: Dict[int, int] = {}
+        # doomed ranks (eviction notice received): excluded from world
+        # assembly until the expiry — an evicting node re-joining the
+        # next round would hand the fresh world a member that dies
+        # seconds later. TTL-bounded so the rank's healthy REPLACEMENT
+        # is never locked out.
+        self._excluded_until: Dict[int, float] = {}
 
     # -- configuration -------------------------------------------------
     def update_rdzv_params(
@@ -115,6 +121,16 @@ class RendezvousManager:
         """
         with self._lock:
             now = time.time()
+            if self._excluded(node_rank):
+                # draining under an eviction notice: answer the round
+                # (the agent's poll loop stays happy) but never enter
+                # the waiting set — the next frozen world must not
+                # contain a member already scheduled to die
+                logger.info(
+                    f"rdzv[{self.name}]: rank {node_rank} join parked "
+                    f"(eviction exclusion)"
+                )
+                return self._rdzv_round
             if not self._waiting_nodes:
                 self._start_rdzv_time = now
             self._lastcall_time = now
@@ -130,6 +146,43 @@ class RendezvousManager:
         """Drop a dead node from the waiting list."""
         with self._lock:
             self._waiting_nodes.pop(node_rank, None)
+
+    # -- eviction exclusion --------------------------------------------
+    def exclude_node(self, node_rank: int, ttl_s: float = 60.0):
+        """Keep ``node_rank`` out of world assembly for ``ttl_s``
+        seconds (an eviction notice arrived: the node is draining and
+        must not be frozen into the next world). Already-waiting
+        entries are dropped; joins during the window are accepted but
+        parked (the node keeps its round answer, it just never makes a
+        world)."""
+        with self._lock:
+            self._excluded_until[node_rank] = time.time() + ttl_s
+            self._waiting_nodes.pop(node_rank, None)
+        logger.info(
+            f"rdzv[{self.name}]: rank {node_rank} excluded for "
+            f"{ttl_s:.0f}s (eviction drain)"
+        )
+
+    def clear_exclusion(self, node_rank: int):
+        with self._lock:
+            self._excluded_until.pop(node_rank, None)
+
+    def _excluded(self, node_rank: int) -> bool:
+        """Lock held by caller. Expired entries are pruned lazily."""
+        until = self._excluded_until.get(node_rank)
+        if until is None:
+            return False
+        if time.time() >= until:
+            del self._excluded_until[node_rank]
+            return False
+        return True
+
+    def excluded_ranks(self):
+        with self._lock:
+            now = time.time()
+            return sorted(
+                r for r, t in self._excluded_until.items() if t > now
+            )
 
     def num_nodes_waiting(self) -> int:
         """Nonzero ⇒ agents should restart workers to admit new members.
@@ -159,6 +212,11 @@ class RendezvousManager:
         """Freeze a world that is a multiple of node_unit, preferring the
         lowest node ranks; leftovers stay waiting for the next round."""
         p = self._params
+        # defensive re-purge: an exclusion armed between join and
+        # freeze must still keep the doomed rank out (and a stale
+        # entry must not inflate the readiness count next round)
+        for r in [r for r in self._waiting_nodes if self._excluded(r)]:
+            del self._waiting_nodes[r]
         ranks = sorted(self._waiting_nodes)
         # cap at max_nodes first, THEN round down to a node_unit multiple —
         # a world must never contain a torn slice

@@ -337,6 +337,19 @@ class MasterServicer:
                     message.level,
                 )
             return True
+        if isinstance(message, comm.EvictionNotice):
+            # a scheduled departure, not a crash: the job manager marks
+            # the node evicting and fans out to the listeners that
+            # exclude the rank from rendezvous and pre-arm the resize
+            if self._job_manager:
+                self._job_manager.handle_eviction_notice(
+                    req.node_type or "worker",
+                    message.node_id,
+                    grace_s=message.grace_s,
+                    drain_ms=message.drain_ms,
+                    reason=message.reason,
+                )
+            return True
         if isinstance(message, comm.NodeEventReport):
             if self._job_manager:
                 from dlrover_tpu.common.node import Node

@@ -89,6 +89,27 @@ class TelemetryAggregator:
         # straggler auto-profile: called once per newly-flagged worker
         # (the master wires this to queue a `profile` worker command)
         self._profile_requester: Optional[Callable[[int], None]] = None
+        # deliberate-maintenance window (eviction drain, resize): new
+        # straggler flags and hang forensics are suppressed while the
+        # fleet is DESIGNED to be stalled
+        self._maintenance_until = 0.0
+
+    # -- maintenance window --------------------------------------------
+    def note_maintenance(self, duration_s: float):
+        """Declare the next ``duration_s`` a deliberate maintenance
+        window (a resize or an eviction drain is in flight): straggler
+        attribution must not flag workers for pausing on purpose, and
+        the master's hang path must not aim ``flight_dump`` commands at
+        healthy workers. Windows extend, never shrink."""
+        with self._lock:
+            self._maintenance_until = max(
+                self._maintenance_until,
+                time.monotonic() + float(duration_s),
+            )
+
+    def in_maintenance(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._maintenance_until
 
     # -- ingestion (servicer / speed-monitor hooks) --------------------
     def observe_step_report(
@@ -265,7 +286,11 @@ class TelemetryAggregator:
         """Workers whose p50 step time exceeds ``straggler_ratio`` × the
         fleet median p50. Newly flagged workers are reported to the
         Brain once per flagging episode (recovery clears the flag, so a
-        relapse reports again)."""
+        relapse reports again). During a maintenance window (resize /
+        eviction drain) the pass is a no-op: a deliberate fleet pause
+        must not mint straggler verdicts or auto-profile commands."""
+        if self.in_maintenance():
+            return self.stragglers
         med = self.fleet_median()
         flagged: List[int] = []
         details: Dict[int, float] = {}

@@ -116,6 +116,29 @@ class FlightRecorder:
         self._watchdog: Optional[threading.Thread] = None
         self._watchdog_stop = threading.Event()
         self._hang_dumped_for: Optional[float] = None
+        # deliberate-maintenance window (eviction drain, resize): the
+        # train thread is SUPPOSED to sit in one long span, and a hang
+        # dump of a healthy drain is forged evidence
+        self._suppress_until = 0.0
+
+    # -- deliberate-maintenance suppression ----------------------------
+    def suppress_watchdog(self, duration_s: float):
+        """Declare the next ``duration_s`` a deliberate maintenance
+        window (graceful drain, resize): the hang watchdog must not
+        dump a bundle for a stall the trainer chose. Windows extend,
+        never shrink; ``clear_suppression()`` ends one early."""
+        with self._lock:
+            self._suppress_until = max(
+                self._suppress_until, time.monotonic() + duration_s
+            )
+
+    def clear_suppression(self):
+        with self._lock:
+            self._suppress_until = 0.0
+
+    def watchdog_suppressed(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._suppress_until
 
     # -- identity / events ---------------------------------------------
     def set_identity(self, **fields):
@@ -238,6 +261,14 @@ class FlightRecorder:
         def _run():
             while not self._watchdog_stop.wait(interval_s):
                 try:
+                    if self.watchdog_suppressed():
+                        # deliberate drain/resize window: a long open
+                        # span here is the PLAN, not a hang. A span
+                        # still open past the threshold AFTER the
+                        # window expires dumps then — a wedged resize
+                        # is a real hang
+                        self._hang_dumped_for = None
+                        continue
                     tid = tid_fn() if tid_fn is not None else None
                     hit = self._tracer.last_open_span(tid=tid)
                     if hit is None or hit[1] < hang_dump_after_s:

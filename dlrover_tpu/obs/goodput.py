@@ -17,6 +17,9 @@ subtracted from every lower row, so the partition is disjoint):
 
 | category            | claimed by                                     |
 |---------------------|------------------------------------------------|
+| eviction            | ``eviction_begin()``..``end()`` episodes: the  |
+|                     | grace-window drain after a preemption notice   |
+|                     | (claims the emergency-checkpoint spans inside) |
 | resize_downtime     | ``resize_drain/build/reshard/compile`` spans   |
 | restart_replay      | ``replay_begin()``..``replay_end()`` episodes: |
 |                     | re-earning steps lost to a restart             |
@@ -51,8 +54,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 from dlrover_tpu.obs.trace import SpanTracer, get_tracer
 
 # the closed taxonomy, in priority order (highest claim first);
-# "other" is the remainder and always closes the partition
+# "other" is the remainder and always closes the partition.
+# "eviction" outranks everything: the drain window deliberately runs
+# checkpoint/report work inside it, and that time is the price of the
+# preemption, not of checkpointing policy
 CATEGORIES = (
+    "eviction",
     "resize_downtime",
     "restart_replay",
     "ckpt_block",
@@ -199,6 +206,8 @@ class GoodputLedger:
         self._degraded_closed: List[Tuple[int, int]] = []
         self._replay_since: Optional[int] = None
         self._replay_closed: List[Tuple[int, int]] = []
+        self._eviction_since: Optional[int] = None
+        self._eviction_closed: List[Tuple[int, int]] = []
 
     # -- event-derived categories (PR-5 node events) -------------------
     def degraded_enter(self):
@@ -232,22 +241,39 @@ class GoodputLedger:
                 )
                 self._replay_since = None
 
+    def eviction_begin(self):
+        """Entering the eviction grace-window drain (a preemption
+        notice arrived): every second until ``eviction_end()`` — the
+        finishing step, the emergency checkpoint, the forensics flush —
+        is the preemption's cost, booked above every span category."""
+        with self._lock:
+            if self._eviction_since is None:
+                self._eviction_since = time.monotonic_ns()
+
+    def eviction_end(self):
+        with self._lock:
+            if self._eviction_since is not None:
+                self._eviction_closed.append(
+                    (self._eviction_since, time.monotonic_ns())
+                )
+                self._eviction_since = None
+
     def mark_interval(self, category: str, start_ns: int, end_ns: int):
         """Attribute an explicit monotonic-ns interval (bench probes
         that measure a restore with ``time.perf_counter`` bracket it
         here instead of re-inventing the categories)."""
-        if category not in ("restart_replay", "degraded"):
+        buckets = {
+            "restart_replay": self._replay_closed,
+            "degraded": self._degraded_closed,
+            "eviction": self._eviction_closed,
+        }
+        if category not in buckets:
             raise ValueError(
                 f"mark_interval supports the event-derived categories "
-                f"(restart_replay, degraded), got {category!r}"
+                f"({', '.join(buckets)}), got {category!r}"
             )
         with self._lock:
-            bucket = (
-                self._replay_closed
-                if category == "restart_replay"
-                else self._degraded_closed
-            )
-            bucket.append((int(start_ns), int(end_ns)))
+            buckets[category].append((int(start_ns), int(end_ns)))
 
     # -- collection ----------------------------------------------------
     def _episode_intervals(
@@ -324,6 +350,11 @@ class GoodputLedger:
             per_cat["degraded"].extend(
                 self._episode_intervals(
                     self._degraded_closed, self._degraded_since, a, b
+                )
+            )
+            per_cat["eviction"].extend(
+                self._episode_intervals(
+                    self._eviction_closed, self._eviction_since, a, b
                 )
             )
 

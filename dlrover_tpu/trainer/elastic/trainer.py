@@ -31,6 +31,7 @@ import numpy as np
 from dlrover_tpu.accel.accelerate import AccelerateResult, auto_accelerate
 from dlrover_tpu.accel.strategy import Strategy
 from dlrover_tpu.agent.monitor import report_runtime_metrics
+from dlrover_tpu.common import faults
 from dlrover_tpu.ckpt.checkpointer import FlashCheckpointer, StorageType
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.models.config import TransformerConfig
@@ -114,6 +115,15 @@ class TrainerConfig:
     # measured topology.LinkModel (DCN-leg target on multi-slice
     # meshes, ICI otherwise)
     grad_bucket_mb: int = 4
+    # -- eviction grace-window drain -----------------------------------
+    # default grace window (seconds) for an eviction notice that does
+    # not carry its own (SIGTERM, an `evict` command with arg=0);
+    # DLROVER_TPU_EVICTION_DEADLINE_S overrides at construction
+    eviction_grace_s: float = 30.0
+    # the emergency DISK persist is skipped when less than this remains
+    # of the grace window after the shm commit — the degraded-mode shm
+    # handoff (agent persists shm on restart) already covers it
+    eviction_persist_floor_s: float = 5.0
 
 
 def build_optimizer(
@@ -357,6 +367,35 @@ class ElasticTrainer:
         from dlrover_tpu.agent.monitor import last_command_id
 
         self._last_command_id = last_command_id()
+        # -- eviction grace-window drain -------------------------------
+        # a preemption notice (SIGTERM / env deadline / master `evict`
+        # command) flips the event; the train loop drains at the next
+        # step boundary: finish the step, emergency shm checkpoint,
+        # report + flush forensics, exit clean (docs/fault-injection.md)
+        env_grace = os.getenv("DLROVER_TPU_EVICTION_DEADLINE_S", "")
+        if env_grace:
+            try:
+                self.tcfg.eviction_grace_s = float(env_grace)
+            except ValueError:
+                logger.warning(
+                    f"bad DLROVER_TPU_EVICTION_DEADLINE_S={env_grace!r};"
+                    f" keeping {self.tcfg.eviction_grace_s}s"
+                )
+        self._evict_event = threading.Event()
+        self._evict_deadline: Optional[float] = None  # monotonic
+        self._evict_grace_s = 0.0
+        self._evict_reason = ""
+        self.evicted = False
+        self.eviction_drain_ms = 0.0
+        # event-reporter seam (the PR-5 saver pattern): in the agent
+        # architecture the monitor file carries the notice; in-process
+        # callers (bench, chaos harness, tests) wire this to
+        # MasterClient.report_failure / report_eviction_notice directly
+        self._event_reporter: Optional[Callable[[str, str], None]] = None
+        if env_grace:
+            # a platform that exports the deadline env expects SIGTERM
+            # to mean "drain now" — install the handler automatically
+            self.install_eviction_handler()
         self.state = self.accel.init_fn(jax.random.PRNGKey(0))
         self._grad_sync_plan = None
         # measured link-cost model (parallel/topology.py): probe once
@@ -705,6 +744,239 @@ class ElasticTrainer:
             return False
         return self._ckptr.save_checkpoint(
             self.global_step, self._ckpt_state(), storage
+        )
+
+    # -- eviction grace-window drain -----------------------------------
+    def set_event_reporter(self, reporter: Callable[[str, str], None]):
+        """``reporter(event, detail)`` mirrors trainer incidents (the
+        ``eviction`` node event) to the master — same seam shape as the
+        checkpoint saver's (``MasterClient.report_failure`` at WARNING
+        level, or ``report_eviction_notice``)."""
+        self._event_reporter = reporter
+
+    def install_eviction_handler(self, grace_s: Optional[float] = None):
+        """Register a SIGTERM handler that enters the drain state
+        machine (signal-safe: it only sets flags; all real work happens
+        at the next step boundary on the train thread). Chains to any
+        previous handler. No-op off the main thread — the platform
+        signal lands on the main thread anyway."""
+        import signal
+
+        grace = (
+            float(grace_s)
+            if grace_s is not None
+            else self.tcfg.eviction_grace_s
+        )
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _handler(signum, frame):
+                self.request_eviction(grace, reason="sigterm")
+                if callable(prev) and prev not in (
+                    signal.SIG_IGN, signal.SIG_DFL
+                ):
+                    prev(signum, frame)
+
+            signal.signal(signal.SIGTERM, _handler)
+            logger.info(
+                f"eviction SIGTERM handler installed (grace {grace}s)"
+            )
+        except ValueError:
+            # signal.signal only works on the main thread; a trainer
+            # constructed elsewhere still drains via the command
+            # channel / request_eviction
+            logger.warning(
+                "not on the main thread: SIGTERM eviction handler not "
+                "installed (the `evict` worker command still works)"
+            )
+
+    def request_eviction(
+        self, grace_s: Optional[float] = None, reason: str = "notice"
+    ):
+        """Enter the drain state machine at the next step boundary.
+        Idempotent (the first notice's deadline stands — a second,
+        tighter notice may shorten it but never extend it); safe to
+        call from signal handlers and foreign threads."""
+        grace = (
+            float(grace_s)
+            if grace_s is not None and grace_s > 0
+            else self.tcfg.eviction_grace_s
+        )
+        deadline = time.monotonic() + grace
+        if self._evict_deadline is None or deadline < self._evict_deadline:
+            self._evict_deadline = deadline
+            self._evict_grace_s = grace
+        if not self._evict_event.is_set():
+            self._evict_reason = reason
+            self._evict_event.set()
+            logger.warning(
+                f"eviction notice ({reason}): draining within "
+                f"{grace:.1f}s"
+            )
+
+    @property
+    def eviction_pending(self) -> bool:
+        return self._evict_event.is_set() and not self.evicted
+
+    def _drain_for_eviction(self):
+        """The drain itself, run on the train thread once the in-flight
+        step finished: (1) suppress the hang watchdog — the long stall
+        ahead is deliberate; (2) announce the notice (metrics file +
+        event seam) so the master can pre-arm the resize while we
+        drain; (3) emergency shm checkpoint of the CURRENT step via the
+        ChunkedStager fast path, budgeted to the grace window; (4) DISK
+        persist only if the window comfortably allows (shm handoff
+        covers the tight case); (5) book the whole window to the
+        ``eviction`` goodput category and flush flight recorder +
+        runtime metrics before returning control to the caller."""
+        t0 = time.perf_counter()
+        t0_ns = time.monotonic_ns()
+        deadline = self._evict_deadline or (
+            time.monotonic() + self.tcfg.eviction_grace_s
+        )
+        grace = self._evict_grace_s or self.tcfg.eviction_grace_s
+        step = self.global_step
+        self._goodput.eviction_begin()
+        self._flight.suppress_watchdog(grace + 60.0)
+        self._flight.note_event(
+            "eviction",
+            f"{self._evict_reason}: grace={grace:.1f}s step={step}",
+        )
+        # announce FIRST: the master's proactive resize (rendezvous
+        # exclusion, speculative n-1 compile) runs while we drain
+        if self.tcfg.report_metrics:
+            report_runtime_metrics(
+                step,
+                eviction_pending=1.0,
+                eviction_grace_s=float(grace),
+            )
+        if self._event_reporter is not None:
+            try:
+                self._event_reporter(
+                    "eviction",
+                    f"grace={grace:.1f}s step={step} "
+                    f"reason={self._evict_reason}",
+                )
+            except Exception as e:
+                logger.warning(f"eviction event report failed: {e!r}")
+        # the prefetcher's lookahead dies with us; the checkpoint's
+        # sampler snapshot rewinds it (same contract as _ckpt_state)
+        committed = False
+        persisted = False
+        if self._ckptr is not None:
+            # a half-staged OLDER step holds the shard lock; the
+            # emergency save wants the CURRENT step (nobody saw the
+            # stale stage — abort is safe)
+            self._abort_stager()
+            try:
+                stager = self._ckptr.begin_chunked_save(
+                    step,
+                    self._ckpt_state(),
+                    chunk_bytes=self.tcfg.stage_chunk_mb << 20,
+                )
+                if stager is not None:
+                    # leave a commit-sized margin before the deadline
+                    while (
+                        not stager.done
+                        and time.monotonic() < deadline - 0.5
+                    ):
+                        stager.advance(
+                            budget_s=0.05, stats=self.pipeline_stats
+                        )
+                    if stager.done:
+                        committed = stager.commit(
+                            stats=self.pipeline_stats
+                        )
+                    else:
+                        # the window closed mid-stage: commit() would
+                        # drain the whole backlog UNBOUNDED and the
+                        # platform's kill would land mid-commit —
+                        # losing not just this checkpoint but the
+                        # forensics flush below. Abort; the previous
+                        # committed step stands (bounded loss <= one
+                        # save interval, the same contract as a hard
+                        # kill)
+                        stager.abort()
+                        logger.warning(
+                            f"eviction: emergency stage incomplete at "
+                            f"the deadline; aborted — the previous "
+                            f"committed step stands"
+                        )
+                else:
+                    # saver busy with an uncommitted save: the plain
+                    # memory save path skips-never-blocks too
+                    committed = self.save(StorageType.MEMORY)
+            except Exception as e:
+                logger.error(f"eviction emergency save failed: {e!r}")
+            remaining = deadline - time.monotonic()
+            if committed and not self._ckptr.engine._agent_mode:
+                # the sync (no-agent) engine's commit already wrote
+                # storage — the shm/persist split only exists under an
+                # agent saver
+                persisted = True
+            elif committed and remaining > self.tcfg.eviction_persist_floor_s:
+                try:
+                    persisted = self.save(StorageType.DISK)
+                except Exception as e:
+                    logger.warning(
+                        f"eviction persist skipped ({e!r}); shm "
+                        f"handoff covers it"
+                    )
+            elif committed:
+                logger.info(
+                    f"eviction: {remaining:.1f}s left of the grace "
+                    f"window — skipping the DISK persist (shm handoff "
+                    f"covers it)"
+                )
+        self._close_prefetcher()
+        drain_ms = (time.perf_counter() - t0) * 1e3
+        self.eviction_drain_ms = drain_ms
+        self._goodput.eviction_end()
+        self.evicted = True
+        # flush: goodput + registry + the final runtime-metrics write
+        # (carries the measured drain latency the master forwards to
+        # the Brain's dwell pricing)
+        self._report_metrics(
+            step,
+            {
+                "eviction_pending": 1.0,
+                "eviction_grace_s": float(grace),
+                "eviction_drain_ms": round(drain_ms, 1),
+            },
+        )
+        if self._event_reporter is not None:
+            try:
+                self._event_reporter(
+                    "eviction",
+                    f"grace={grace:.1f}s step={step} "
+                    f"drain_ms={drain_ms:.0f} "
+                    f"committed={int(committed)} "
+                    f"persisted={int(persisted)}",
+                )
+            except Exception as e:
+                logger.warning(f"eviction event report failed: {e!r}")
+        self._flight.note_event(
+            "eviction_drained",
+            f"step={step} drain_ms={drain_ms:.0f} "
+            f"committed={int(committed)} persisted={int(persisted)}",
+        )
+        self._flight.dump(
+            "eviction",
+            extra={
+                "step": step,
+                "grace_s": grace,
+                "drain_ms": drain_ms,
+                "committed": committed,
+                "persisted": persisted,
+                "eviction_interval": [t0_ns, time.monotonic_ns()],
+            },
+            force=True,
+        )
+        logger.warning(
+            f"eviction drain complete at step {step}: "
+            f"{drain_ms:.0f} ms of a {grace:.1f}s window "
+            f"(shm commit={'ok' if committed else 'FAILED'}, "
+            f"persist={'ok' if persisted else 'skipped'})"
         )
 
     # -- loop ----------------------------------------------------------
@@ -1266,6 +1538,12 @@ class ElasticTrainer:
             "dlrover_resize_idle_ranks",
             "devices left idle by resize degradation",
         ).set(float(idle_ranks))
+        # a resize is a DELIBERATE stall: the hang watchdog must not
+        # dump forensics of a cold compile that is working as designed
+        # (cleared on success below; a raise lets the window lapse — a
+        # resize that died mid-world-change masks real hangs for at
+        # most this long)
+        self._flight.suppress_watchdog(600.0)
         # stale scale predictions are worthless now — and the resize
         # owns the compile budget
         if self._spec_compiler is not None:
@@ -1431,6 +1709,7 @@ class ElasticTrainer:
                 self._aot_primed = True
         else:
             self._aot_primed = False
+        self._flight.clear_suppression()
         downtime_ms = (time.perf_counter() - t0) * 1e3
         self.pipeline_stats.resize_count += 1
         self.pipeline_stats.resize_downtime_ms = downtime_ms
@@ -1701,7 +1980,15 @@ class ElasticTrainer:
             self._last_command_id = cid
             kind = c.get("kind", "")
             reason = str(c.get("reason", "") or "master_request")
-            if kind == "flight_dump":
+            if kind == "evict":
+                # the master-side notice channel (platform preemption
+                # watchers, operators, the auto-scaler): arg carries
+                # the grace window, 0 = the trainer's default
+                self.request_eviction(
+                    float(c.get("arg", 0) or 0) or None,
+                    reason=f"master_{reason}",
+                )
+            elif kind == "flight_dump":
                 logger.info(
                     f"master requested flight dump (#{cid}, {reason})"
                 )
@@ -1729,7 +2016,7 @@ class ElasticTrainer:
     def _train_loop(self, num_steps: int, t0, start_step) -> Any:
         import jax
 
-        while self.global_step < num_steps:
+        while self.global_step < num_steps and not self.eviction_pending:
             self.dataloader.load_config()  # master-retuned batch size
             self._apply_lr_scale(self.dataloader.lr_scale)
             # master-predicted next world sizes → background pre-lower
@@ -1741,6 +2028,15 @@ class ElasticTrainer:
             # (modulo the prefetch rewind in _ckpt_state)
             batches = self._epoch_batches(num_steps)
             while True:
+                # step boundary = the preemption arrival point: the
+                # in-flight step is finished, nothing is half-donated.
+                # node.preempt `kill` is the scripted hard death the
+                # chaos harness replays; a pending eviction notice
+                # (SIGTERM / env deadline / `evict` command) enters the
+                # graceful drain instead
+                faults.fire("node.preempt")
+                if self.eviction_pending:
+                    break
                 # on-demand jax.profiler capture (no-op unless a master
                 # `profile` command armed it)
                 self._profiler_capture.on_step_begin()
@@ -1846,7 +2142,16 @@ class ElasticTrainer:
                     raise
                 if step >= num_steps:
                     break
+            if self.eviction_pending:
+                # the prefetcher stays up: the emergency checkpoint's
+                # sampler snapshot rewinds by its buffered lookahead
+                # (_ckpt_state), exactly like a normal save; the drain
+                # closes it afterwards
+                break
             self._close_prefetcher()  # fresh buffer per epoch
+        if self.eviction_pending:
+            jax.block_until_ready(self.state.params)
+            self._drain_for_eviction()
         jax.block_until_ready(self.state.params)
         return self.state
 

@@ -286,8 +286,10 @@ def test_job_manager_feeds_brain_node_events(brain):
     try:
         for cli in (a, b):
             jm = JobManager(
-                brain_reporter=lambda nid, host, ev, mem, _c=cli: (
-                    _c.report_node_event(nid, host, ev, memory_mb=mem)
+                brain_reporter=lambda nid, host, ev, mem, detail="", _c=cli: (
+                    _c.report_node_event(
+                        nid, host, ev, memory_mb=mem, detail=detail
+                    )
                 )
             )
             n = Node("worker", 0)

@@ -1,0 +1,838 @@
+"""Preemption-aware survival (ISSUE 11): eviction grace-window drain,
+master-side scheduled departures, and the Brain's preemption pricing.
+
+The worker leg (drain state machine, emergency checkpoint, `eviction`
+goodput booking) runs on a real tiny trainer; the master leg (notice
+handling, rendezvous exclusion, pre-armed resize, budget-free
+relaunch) and the Brain leg (eviction-aware floors, drain-latency-
+priced dwell) are pure control-plane tests. The full end-to-end kill /
+evict / outage scenarios live in tools/chaos.py and
+tests/test_chaos_harness.py.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.common import comm, faults
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.job_auto_scaler import JobAutoScaler
+from dlrover_tpu.master.job_manager import JobManager, NodeEvent
+from dlrover_tpu.master.paral_config import ParalConfigService
+from dlrover_tpu.master.rdzv_manager import RendezvousManager
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.obs.aggregate import TelemetryAggregator
+from dlrover_tpu.obs.flight_recorder import FlightRecorder
+from dlrover_tpu.obs.goodput import CATEGORIES, GoodputLedger
+from dlrover_tpu.obs.metrics import MetricsRegistry
+from dlrover_tpu.obs.trace import SpanTracer
+
+
+# ---------------------------------------------------------------------------
+# goodput: the `eviction` category
+# ---------------------------------------------------------------------------
+class TestGoodputEviction:
+    def test_category_registered_with_top_priority(self):
+        assert CATEGORIES[0] == "eviction"
+
+    def test_episode_books_seconds(self):
+        led = GoodputLedger(tracer=SpanTracer(enabled=True))
+        led.eviction_begin()
+        time.sleep(0.03)
+        led.eviction_end()
+        rep = led.snapshot()
+        assert rep.seconds["eviction"] >= 0.025
+
+    def test_eviction_outranks_ckpt_spans(self):
+        """Checkpoint work INSIDE the drain window books as eviction
+        (the preemption's price), never double-counted as ckpt_block."""
+        tr = SpanTracer(enabled=True)
+        led = GoodputLedger(tracer=tr)
+        led.eviction_begin()
+        with tr.span("ckpt_save"):
+            time.sleep(0.03)
+        led.eviction_end()
+        rep = led.snapshot()
+        assert rep.seconds["eviction"] >= 0.025
+        assert rep.seconds["ckpt_block"] == pytest.approx(0.0, abs=1e-3)
+        assert rep.closure_error_pct < 1.0
+
+    def test_mark_interval_accepts_eviction(self):
+        led = GoodputLedger(tracer=SpanTracer(enabled=True))
+        time.sleep(0.03)  # the marked interval must lie in the past
+        t = time.monotonic_ns() - 25_000_000
+        led.mark_interval("eviction", t, t + 20_000_000)
+        assert led.snapshot().seconds["eviction"] == pytest.approx(
+            0.020, abs=5e-3
+        )
+
+
+# ---------------------------------------------------------------------------
+# fault layer: @N scripting + kill kind + new sites
+# ---------------------------------------------------------------------------
+class TestScriptedFaults:
+    def teardown_method(self):
+        faults.reset()
+
+    def test_nth_trigger_fires_exactly_once(self):
+        faults.configure("prefetch.pull:io_error:@3")
+        hits = 0
+        for _ in range(6):
+            try:
+                faults.fire("prefetch.pull")
+            except OSError:
+                hits += 1
+        assert hits == 1
+        assert faults.triggered_total() == 1
+
+    def test_nth_replays_on_rearm(self):
+        for _ in range(2):
+            faults.configure("node.preempt:delay:@2")
+            fired_at = []
+            for i in range(4):
+                before = faults.triggered_total()
+                faults.fire("node.preempt")
+                if faults.triggered_total() > before:
+                    fired_at.append(i)
+            assert fired_at == [1]
+            faults.reset()
+
+    def test_kill_kind_and_new_sites_parse(self):
+        for spec in (
+            "node.preempt:kill:@7",
+            "rpc.recv:io_error:0.5:3",
+            "rendezvous.join:kill:1.0",
+        ):
+            parsed = faults.FaultSpec.parse(spec)
+            assert parsed.site in faults.FAULT_SITES
+
+    def test_bad_nth_rejected(self):
+        with pytest.raises(ValueError):
+            faults.FaultSpec.parse("node.preempt:kill:@0")
+        with pytest.raises(ValueError):
+            faults.FaultSpec.parse("node.preempt:kill:@x")
+
+
+# ---------------------------------------------------------------------------
+# watchdog suppression (deliberate drain/resize windows)
+# ---------------------------------------------------------------------------
+class TestWatchdogSuppression:
+    def _recorder(self, tmp_path):
+        tr = SpanTracer(enabled=True)
+        rec = FlightRecorder(
+            base_dir=str(tmp_path),
+            tracer=tr,
+            registry=MetricsRegistry(),
+        )
+        return tr, rec
+
+    def test_suppressed_window_blocks_hang_dump(self, tmp_path):
+        tr, rec = self._recorder(tmp_path)
+        sp = tr.span("ckpt_commit")
+        sp.start_ns -= 200_000_000_000  # fake a 200s-old wedge
+        rec.suppress_watchdog(30.0)
+        try:
+            rec.start_watchdog(hang_dump_after_s=60, interval_s=0.02)
+            time.sleep(0.2)
+            assert rec.dumps == []  # deliberate stall: no forensics
+            # window over: the still-open span IS a hang now
+            rec.clear_suppression()
+            deadline = time.time() + 2
+            while time.time() < deadline and not rec.dumps:
+                time.sleep(0.02)
+            assert len(rec.dumps) == 1
+        finally:
+            rec.stop_watchdog()
+            sp.end()
+
+    def test_windows_extend_never_shrink(self, tmp_path):
+        _, rec = self._recorder(tmp_path)
+        rec.suppress_watchdog(60.0)
+        rec.suppress_watchdog(1.0)  # shorter: must not shrink
+        assert rec.watchdog_suppressed()
+        until = rec._suppress_until
+        assert until >= time.monotonic() + 55
+
+
+# ---------------------------------------------------------------------------
+# telemetry maintenance window (master side of satellite 2)
+# ---------------------------------------------------------------------------
+class TestMaintenanceWindow:
+    def _loaded_aggregator(self):
+        agg = TelemetryAggregator(straggler_ratio=2.0, min_samples=4)
+        for w, ms in ((0, 100.0), (1, 100.0), (2, 900.0)):
+            for _ in range(6):
+                agg.observe_metrics(w, 10, {"step_time_ms": ms})
+        return agg
+
+    def test_no_new_flags_during_maintenance(self):
+        agg = self._loaded_aggregator()
+        agg.note_maintenance(30.0)
+        assert agg.in_maintenance()
+        assert agg.detect_stragglers() == []  # worker 2 NOT minted
+
+    def test_flags_resume_after_window(self):
+        agg = self._loaded_aggregator()
+        agg.note_maintenance(0.0)  # instantly expired
+        assert not agg.in_maintenance()
+        assert agg.detect_stragglers() == [2]
+
+    def test_scale_to_opens_window(self):
+        agg = self._loaded_aggregator()
+        jm = JobManager()
+        jm.create_initial_nodes(2)
+        scaler = JobAutoScaler(jm, target_nodes=2, telemetry=agg)
+        scaler.scale_to(4)
+        assert agg.in_maintenance()
+
+
+# ---------------------------------------------------------------------------
+# master: eviction notice -> scheduled departure
+# ---------------------------------------------------------------------------
+class TestMasterEviction:
+    def test_notice_marks_node_and_fires_listeners(self):
+        jm = JobManager()
+        jm.create_initial_nodes(2)
+        seen = []
+        jm.add_eviction_listener(
+            lambda nt, nid, grace, drain: seen.append((nid, grace))
+        )
+        jm.handle_eviction_notice(
+            "worker", 1, grace_s=25.0, reason="sigterm"
+        )
+        assert jm.get_node("worker", 1).evicting is True
+        assert seen == [(1, 25.0)]
+        events = jm.node_events("eviction")
+        assert len(events) == 1
+        assert "grace=25.0s" in events[0]["detail"]
+
+    def test_announced_death_burns_no_relaunch_budget(self):
+        jm = JobManager()
+        jm.create_initial_nodes(1)
+        brain_events = []
+        jm._brain_reporter = (
+            lambda nid, host, ev, mem, detail="": brain_events.append(ev)
+        )
+        jm.handle_eviction_notice("worker", 0, grace_s=10.0)
+        node = jm.get_node("worker", 0)
+        node.hostname = "spot-host-1"
+        failed = Node("worker", 0)
+        failed.status = NodeStatus.FAILED
+        jm.process_event(NodeEvent("MODIFIED", failed))
+        # the replacement exists and kept the budget
+        replacement = [
+            n
+            for n in jm.get_nodes("worker")
+            if n.id != 0 and n.rank_index == 0
+        ]
+        assert len(replacement) == 1
+        assert replacement[0].relaunch_count == 0  # not burned
+        assert node.exit_reason == NodeExitReason.PREEMPTED
+        # the Brain mirror runs fire-and-forget on a daemon thread
+        deadline = time.time() + 5
+        while "eviction_exit" not in brain_events and time.time() < deadline:
+            time.sleep(0.01)
+        assert "eviction_exit" in brain_events
+
+    def test_preempted_exhausted_budget_still_relaunches(self):
+        jm = JobManager()
+        jm.create_initial_nodes(1)
+        node = jm.get_node("worker", 0)
+        node.relaunch_count = node.max_relaunch_count  # spent
+        node.evicting = True
+        node.update_status(NodeStatus.FAILED)
+        jm._handle_node_failure(node)
+        assert any(
+            n.id != 0 and n.rank_index == 0
+            for n in jm.get_nodes("worker")
+        )
+
+    def test_heartbeat_timeout_of_evicting_node_is_preempted(self):
+        jm = JobManager()
+        jm.create_initial_nodes(2)
+        for n in jm.get_nodes("worker"):
+            n.update_status(NodeStatus.RUNNING)
+            n.heartbeat_time = time.time()
+        scaler = JobAutoScaler(jm, target_nodes=2)
+        jm.handle_eviction_notice("worker", 1, grace_s=5.0)
+        dead = jm.get_node("worker", 1)
+        dead.heartbeat_time = time.time() - 10_000
+        plan = scaler.check_and_scale()
+        assert dead in plan.remove_nodes
+        assert dead.exit_reason == NodeExitReason.PREEMPTED
+        # the replacement came back with a FRESH budget (PREEMPTED is
+        # deliberate, like SCALED_DOWN)
+        new = [n for n in plan.launch_nodes if n.rank_index == 1]
+        assert len(new) == 1 and new[0].relaunch_count == 0
+
+    def test_servicer_dispatches_eviction_notice(self):
+        jm = JobManager()
+        jm.create_initial_nodes(1)
+        servicer = MasterServicer(job_manager=jm)
+        req = comm.BaseRequest(
+            node_id=0,
+            node_type="worker",
+            data=comm.serialize_message(
+                comm.EvictionNotice(
+                    node_id=0, grace_s=12.0, reason="platform"
+                )
+            ),
+        )
+        resp = comm.deserialize_message(
+            servicer.report(comm.serialize_message(req))
+        )
+        assert resp.success
+        assert jm.get_node("worker", 0).evicting is True
+
+    def test_prearm_jumps_candidate_queue(self):
+        jm = JobManager()
+        jm.create_initial_nodes(4)
+        pcs = ParalConfigService()
+        scaler = JobAutoScaler(
+            jm, target_nodes=4, paral_config_service=pcs
+        )
+        scaler.note_eviction(2, grace_s=20.0)
+        cands = scaler.predicted_scale_candidates()
+        assert cands[0] == 3  # target - unit leads the queue
+        # and it was PUBLISHED immediately, not on the next tick
+        cfg = pcs.get_config(0)
+        assert list(cfg.candidate_worker_counts)[0] == 3
+        assert jm.get_node("worker", 2).evicting is True
+
+    def test_prearm_expires(self):
+        jm = JobManager()
+        jm.create_initial_nodes(4)
+        scaler = JobAutoScaler(jm, target_nodes=4)
+        scaler.note_eviction(0, grace_s=20.0)
+        scaler._prearm = (scaler._prearm[0], time.monotonic() - 1)
+        assert scaler.predicted_scale_candidates()[0] != 3 or (
+            scaler._prearm is None
+        )
+
+
+# ---------------------------------------------------------------------------
+# rendezvous exclusion
+# ---------------------------------------------------------------------------
+class TestRendezvousExclusion:
+    def _mgr(self, min_nodes=2, max_nodes=3):
+        mgr = RendezvousManager("test")
+        mgr.update_rdzv_params(min_nodes, max_nodes, 0.0, 1)
+        return mgr
+
+    def test_excluded_rank_never_joins_world(self):
+        mgr = self._mgr()
+        mgr.exclude_node(2, ttl_s=60.0)
+        for r in (0, 1, 2):
+            mgr.join_rendezvous(r, 1)
+        rnd, _, world, _ = mgr.get_comm_world(0)
+        assert sorted(world) == [0, 1]
+        assert 2 not in world
+
+    def test_exclusion_armed_after_join_purges(self):
+        mgr = self._mgr()
+        for r in (0, 1, 2):
+            mgr.join_rendezvous(r, 1)
+        mgr.exclude_node(2, ttl_s=60.0)
+        _, _, world, _ = mgr.get_comm_world(0)
+        assert sorted(world) == [0, 1]
+
+    def test_exclusion_expires_for_replacement(self):
+        mgr = self._mgr()
+        mgr.exclude_node(1, ttl_s=0.01)
+        time.sleep(0.05)
+        for r in (0, 1):
+            mgr.join_rendezvous(r, 1)
+        _, _, world, _ = mgr.get_comm_world(0)
+        assert sorted(world) == [0, 1]
+        assert mgr.excluded_ranks() == []
+
+    def test_clear_exclusion(self):
+        mgr = self._mgr()
+        mgr.exclude_node(0, ttl_s=60.0)
+        mgr.clear_exclusion(0)
+        for r in (0, 1):
+            mgr.join_rendezvous(r, 1)
+        _, _, world, _ = mgr.get_comm_world(0)
+        assert sorted(world) == [0, 1]
+
+    def test_relaunch_clears_exclusion_for_replacement(self):
+        """The healthy replacement inherits the dead node's rank — it
+        must not sit out the exclusion TTL. Covers BOTH comeback
+        paths: the event relaunch and the auto-scaler replacement."""
+        from dlrover_tpu.master.local_master import LocalJobMaster
+
+        master = LocalJobMaster(node_num=2)  # never prepare()d
+        master.job_manager.handle_eviction_notice(
+            "worker", 1, grace_s=30.0
+        )
+        rdzv = list(master.rdzv_managers.values())[0]
+        assert rdzv.excluded_ranks() == [1]
+        # path 1: event relaunch
+        node = master.job_manager.get_node("worker", 1)
+        node.update_status(NodeStatus.FAILED)
+        master.job_manager._handle_node_failure(node)
+        assert rdzv.excluded_ranks() == []
+        # path 2: auto-scaler replacement creation
+        master.job_manager.handle_eviction_notice(
+            "worker", 0, grace_s=30.0
+        )
+        assert rdzv.excluded_ranks() == [0]
+        dead = master.job_manager.get_node("worker", 0)
+        dead.is_released = True
+        dead.update_status(NodeStatus.FAILED)
+        master.auto_scaler.check_and_scale()
+        assert rdzv.excluded_ranks() == []
+
+    def test_evict_worker_rounds_grace_up(self):
+        from dlrover_tpu.master.local_master import LocalJobMaster
+
+        master = LocalJobMaster(node_num=1)
+        master.evict_worker(0, grace_s=0.9)
+        cmds = master.servicer._worker_commands[0]
+        evicts = [c for c in cmds if c.kind == "evict"]
+        # int() would yield arg=0 = "use the 30s default" against a
+        # sub-second platform kill; ceil keeps the window honest
+        assert evicts and evicts[-1].arg == 1
+
+
+# ---------------------------------------------------------------------------
+# monitor relay: metrics file -> EvictionNotice RPC
+# ---------------------------------------------------------------------------
+class TestMonitorRelay:
+    def test_training_monitor_forwards_notice_once(
+        self, tmp_path, monkeypatch
+    ):
+        from dlrover_tpu.agent.monitor import (
+            TrainingMonitor,
+            report_runtime_metrics,
+        )
+
+        path = str(tmp_path / "metrics.json")
+        monkeypatch.setenv("DLROVER_TPU_RUNTIME_METRICS_PATH", path)
+
+        class _Client:
+            def __init__(self):
+                self.notices = []
+
+            def report_eviction_notice(self, grace, drain_ms=0.0,
+                                       reason=""):
+                self.notices.append((grace, drain_ms))
+
+            def report_global_step(self, step):
+                pass
+
+            def report_train_metrics(self, *a, **kw):
+                pass
+
+        client = _Client()
+        mon = TrainingMonitor(client, interval=1000)
+        report_runtime_metrics(
+            5, eviction_pending=1.0, eviction_grace_s=20.0
+        )
+        mon._tick()
+        mon._tick()  # unchanged: no duplicate notice
+        assert client.notices == [(20.0, 0.0)]
+        # the drain's final write adds the measured latency
+        report_runtime_metrics(
+            5,
+            eviction_pending=1.0,
+            eviction_grace_s=20.0,
+            eviction_drain_ms=412.0,
+        )
+        mon._tick()
+        assert client.notices == [(20.0, 0.0), (20.0, 412.0)]
+
+
+# ---------------------------------------------------------------------------
+# Brain: eviction-aware floors + drain-latency-priced dwell
+# ---------------------------------------------------------------------------
+class TestBrainEvictionPricing:
+    def _store_with_job(self, job, sizes=((4, 1.0), (8, 1.6))):
+        from dlrover_tpu.brain.service import BrainServicer
+
+        ds = BrainServicer(db_path=":memory:")
+        for n, sps in sizes:
+            for _ in range(3):
+                ds.persist_metrics(
+                    job,
+                    comm.JobMetricsSample(
+                        timestamp=time.time(),
+                        global_step=100,
+                        steps_per_sec=sps,
+                        alive_nodes=n,
+                        goodput_pct=90.0,
+                    ),
+                )
+        return ds
+
+    def test_parse_drain_ms(self):
+        from dlrover_tpu.brain.scheduler import parse_drain_ms
+
+        assert parse_drain_ms("grace=20.0s drain_ms=412 x") == 412.0
+        assert parse_drain_ms("grace=20.0s") == 0.0
+        assert parse_drain_ms("drain_ms=oops") == 0.0
+        assert parse_drain_ms("") == 0.0
+
+    def test_detail_column_round_trip_and_migration(self, tmp_path):
+        import sqlite3
+
+        from dlrover_tpu.brain.service import BrainServicer
+
+        # a pre-eviction store: node_events WITHOUT the detail column
+        db = str(tmp_path / "old.db")
+        conn = sqlite3.connect(db)
+        conn.execute(
+            "CREATE TABLE node_events (job TEXT NOT NULL, ts REAL NOT "
+            "NULL, node_id INTEGER, hostname TEXT, event TEXT NOT "
+            "NULL, memory_mb INTEGER, cpu_percent REAL)"
+        )
+        conn.execute(
+            "INSERT INTO node_events VALUES "
+            "('legacy', ?, 0, 'h', 'oom', 512, 0.0)",
+            (time.time(),),  # recent: the retention prune keeps it
+        )
+        conn.commit()
+        conn.close()
+        ds = BrainServicer(db_path=db)
+        ds.record_node_event(
+            comm.BrainNodeEventReport(
+                job_name="j1",
+                node_id=0,
+                hostname="spot-1",
+                event="eviction",
+                detail="grace=20.0s drain_ms=300",
+            )
+        )
+        rows = ds.node_events(job="j1", event="eviction")
+        assert rows[0].detail == "grace=20.0s drain_ms=300"
+        legacy = ds.node_events(job="legacy")
+        assert legacy[0].detail == ""
+
+    def test_eviction_raises_floor(self):
+        from dlrover_tpu.brain.scheduler import ClusterScheduler
+
+        job = "spotty"
+        ds = self._store_with_job(job)
+        sched = ClusterScheduler(ds, total_chips=16, node_unit=1)
+        base = sched.job_state(job, time.time()).floor
+        ds.record_node_event(
+            comm.BrainNodeEventReport(
+                job_name=job,
+                node_id=0,
+                hostname="spot-1",
+                event="eviction",
+                detail="grace=20.0s drain_ms=250",
+            )
+        )
+        st = sched.job_state(job, time.time())
+        assert st.floor == base + sched.node_unit
+        assert "eviction_prone" in st.verdicts
+
+    def test_dwell_priced_from_measured_downtime(self):
+        from dlrover_tpu.brain.scheduler import (
+            DWELL_DOWNTIME_FACTOR,
+            ClusterScheduler,
+        )
+
+        job = "heavy-resize"
+        ds = self._store_with_job(job)
+        sched = ClusterScheduler(
+            ds, total_chips=16, node_unit=1, min_dwell_s=10.0
+        )
+        now = time.time()
+        assert sched.dwell_for(job, now) == 10.0  # nothing measured
+        # a measured 4 s decision->resized latency prices the dwell
+        ds.record_cluster_plan(
+            ds.next_plan_version(),
+            [{"job": job, "worker_count": 8, "prev_count": 4,
+              "reason": "t", "exclude_hosts": []}],
+            now,
+        )
+        ds.record_plan_outcome(
+            comm.PlanOutcomeReport(
+                job_name=job,
+                version=ds.latest_plan_version(),
+                worker_count=8,
+                decision_to_resized_ms=4000.0,
+            )
+        )
+        assert sched.dwell_for(job, now) == pytest.approx(
+            DWELL_DOWNTIME_FACTOR * 4.0
+        )
+        # an eviction drain stacks on top (the job pays both per move)
+        ds.record_node_event(
+            comm.BrainNodeEventReport(
+                job_name=job, node_id=0, hostname="h",
+                event="eviction", detail="drain_ms=2000",
+            )
+        )
+        assert sched.dwell_for(job, now) == pytest.approx(
+            DWELL_DOWNTIME_FACTOR * 6.0
+        )
+
+    def test_cheap_resizer_keeps_floor_dwell(self):
+        from dlrover_tpu.brain.scheduler import ClusterScheduler
+
+        job = "warm-dp"
+        ds = self._store_with_job(job)
+        sched = ClusterScheduler(
+            ds, total_chips=16, node_unit=1, min_dwell_s=120.0
+        )
+        now = time.time()
+        ds.record_cluster_plan(
+            ds.next_plan_version(),
+            [{"job": job, "worker_count": 8, "prev_count": 4,
+              "reason": "t", "exclude_hosts": []}],
+            now,
+        )
+        ds.record_plan_outcome(
+            comm.PlanOutcomeReport(
+                job_name=job,
+                version=ds.latest_plan_version(),
+                worker_count=8,
+                decision_to_resized_ms=200.0,  # 0.2 s warm resize
+            )
+        )
+        assert sched.dwell_for(job, now) == 120.0
+
+
+# ---------------------------------------------------------------------------
+# worker drain, end to end on a real tiny trainer
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def drained_trainer(tmp_path_factory):
+    """One trainer evicted mid-run; every drain contract asserts off
+    this single (expensive) run."""
+    import jax
+    import optax
+
+    from dlrover_tpu.accel.strategy import Strategy
+    from dlrover_tpu.models import tiny
+    from dlrover_tpu.parallel.mesh import MeshConfig
+    from dlrover_tpu.trainer.elastic.trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
+
+    tmp = tmp_path_factory.mktemp("evict_run")
+    metrics_path = str(tmp / "runtime_metrics.json")
+    flight_dir = str(tmp / "flight")
+    old_m = os.environ.get("DLROVER_TPU_RUNTIME_METRICS_PATH")
+    old_f = os.environ.get("DLROVER_TPU_FLIGHT_DIR")
+    os.environ["DLROVER_TPU_RUNTIME_METRICS_PATH"] = metrics_path
+    os.environ["DLROVER_TPU_FLIGHT_DIR"] = flight_dir
+
+    class _Tokens:
+        def __init__(self, n=256, seq=32, vocab=256):
+            rng = np.random.default_rng(3)
+            self.data = rng.integers(
+                0, vocab, (n, seq + 1), dtype=np.int32
+            )
+
+        def __len__(self):
+            return len(self.data)
+
+        def __getitem__(self, i):
+            return {"x": self.data[i][:-1], "y": self.data[i][1:]}
+
+    events = []
+    trainer = ElasticTrainer(
+        model_cfg=tiny(num_layers=1),
+        tx=optax.adamw(1e-2),
+        dataset=_Tokens(),
+        trainer_cfg=TrainerConfig(
+            batch_size=8,
+            seq_len=32,
+            ckpt_dir=str(tmp / "ckpt"),
+            save_memory_interval=4,
+            save_storage_interval=10_000,
+            report_metrics=True,
+            log_interval=4,
+            prefetch=2,
+            donation_aware=False,
+            speculative_compile=False,
+            eviction_grace_s=20.0,
+        ),
+        strategy=Strategy(mesh=MeshConfig(dp=1), dtype="float32"),
+        devices=list(jax.devices())[:1],
+        metrics_hook=lambda step, m: (
+            trainer.request_eviction(20.0, reason="test")
+            if step == 6
+            else None
+        ),
+    )
+    trainer.set_event_reporter(
+        lambda ev, detail: events.append((ev, detail))
+    )
+    try:
+        trainer.train(12)
+        yield {
+            "trainer": trainer,
+            "events": events,
+            "metrics_path": metrics_path,
+            "flight_dir": flight_dir,
+            "ckpt_dir": str(tmp / "ckpt"),
+        }
+    finally:
+        # the drain suppressed the PROCESS-DEFAULT recorder's watchdog
+        # for the grace window; later test files share that recorder
+        trainer._flight.clear_suppression()
+        trainer.close()
+        for key, old in (
+            ("DLROVER_TPU_RUNTIME_METRICS_PATH", old_m),
+            ("DLROVER_TPU_FLIGHT_DIR", old_f),
+        ):
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
+
+class TestDrainStateMachine:
+    def test_drain_stops_training_at_notice(self, drained_trainer):
+        t = drained_trainer["trainer"]
+        assert t.evicted is True
+        assert t.global_step == 6  # finished the in-flight step, no more
+
+    def test_emergency_checkpoint_is_verified_and_current(
+        self, drained_trainer
+    ):
+        t = drained_trainer["trainer"]
+        assert t._ckptr.latest_verified_step() == 6
+
+    def test_drain_booked_as_eviction_goodput(self, drained_trainer):
+        t = drained_trainer["trainer"]
+        rep = t._goodput.snapshot()
+        assert rep.seconds["eviction"] > 0
+        assert t.eviction_drain_ms > 0
+
+    def test_event_reporter_saw_notice_and_drain(self, drained_trainer):
+        events = drained_trainer["events"]
+        assert len(events) >= 2
+        assert all(ev == "eviction" for ev, _ in events)
+        assert any("drain_ms=" in d for _, d in events)
+
+    def test_final_metrics_carry_drain_latency(self, drained_trainer):
+        with open(drained_trainer["metrics_path"]) as f:
+            metrics = json.load(f)
+        assert metrics["eviction_pending"] == 1.0
+        assert metrics["eviction_grace_s"] == 20.0
+        assert metrics["eviction_drain_ms"] > 0
+
+    def test_flight_bundle_dumped(self, drained_trainer):
+        d = drained_trainer["flight_dir"]
+        assert os.path.isdir(d)
+        assert any("eviction" in name for name in os.listdir(d))
+
+    def test_watchdog_suppressed_through_drain(self, drained_trainer):
+        t = drained_trainer["trainer"]
+        assert t._flight.watchdog_suppressed()
+
+    def test_evict_worker_command_requests_drain(
+        self, tmp_path, monkeypatch, drained_trainer
+    ):
+        """The PR-7 command channel leg: an `evict` command in the
+        relay file arms the drain with the master's grace window."""
+        from dlrover_tpu.agent.monitor import atomic_write_json
+
+        t = drained_trainer["trainer"]
+        path = str(tmp_path / "commands.json")
+        monkeypatch.setenv("DLROVER_TPU_WORKER_COMMANDS_PATH", path)
+        atomic_write_json(
+            path,
+            {
+                "commands": [
+                    {
+                        "id": t._last_command_id + 1,
+                        "kind": "evict",
+                        "arg": 7,
+                        "reason": "operator",
+                    }
+                ]
+            },
+        )
+        # reset the (already drained) eviction state to observe arming
+        t.evicted = False
+        t._evict_event.clear()
+        t._evict_deadline = None
+        t._poll_worker_commands()
+        assert t.eviction_pending
+        assert t._evict_grace_s == 7.0
+        assert "master_operator" in t._evict_reason
+
+
+# ---------------------------------------------------------------------------
+# rpc.recv fault site (satellite 1): response-leg retry coverage
+# ---------------------------------------------------------------------------
+class TestRpcRecvFaultSite:
+    def teardown_method(self):
+        faults.reset()
+
+    def _client(self):
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        c = MasterClient.__new__(MasterClient)
+        c._master_addr = "test:0"
+        c._node_id = 0
+        c._node_type = "worker"
+        c._timeout = 1.0
+        return c
+
+    def test_recv_leg_failure_rides_jittered_retry(self, monkeypatch):
+        """The server APPLIED the request but the response leg died:
+        the jittered-retry path must resend and succeed — rpc.recv
+        coverage, not just rpc.send."""
+        import dlrover_tpu.agent.master_client as mc
+
+        client = self._client()
+        calls = {"n": 0}
+        ok = comm.BaseResponse(
+            data=comm.serialize_message(comm.SyncResult(done=True))
+        )
+
+        def fake_rpc(payload, timeout=None):
+            calls["n"] += 1
+            return comm.serialize_message(ok)
+
+        sleeps = []
+        monkeypatch.setattr(
+            mc.time, "sleep", lambda s: sleeps.append(s)
+        )
+        faults.configure("rpc.recv:io_error:@1")
+        resp = client._call(fake_rpc, comm.SyncResult())
+        assert resp.done is True
+        # the rpc itself ran twice: the first RESPONSE was eaten after
+        # the server had already processed the request
+        assert calls["n"] == 2
+        assert len(sleeps) == 1
+        assert faults.triggered() == {("rpc.recv", "io_error"): 1}
+
+    def test_recv_leg_single_attempt_for_non_idempotent(
+        self, monkeypatch
+    ):
+        """A non-idempotent report must NOT retry past a lost
+        response — replay would double-apply server-side."""
+        import dlrover_tpu.agent.master_client as mc
+
+        client = self._client()
+        client._report_rpc = lambda payload, timeout=None: (
+            comm.serialize_message(comm.BaseResponse())
+        )
+        calls = {"n": 0}
+
+        def fake_rpc(payload, timeout=None):
+            calls["n"] += 1
+            return comm.serialize_message(comm.BaseResponse())
+
+        client._report_rpc = fake_rpc
+        monkeypatch.setattr(mc.time, "sleep", lambda s: None)
+        faults.configure("rpc.recv:io_error:1.0")
+        with pytest.raises(ConnectionError):
+            client.report(comm.KeyValueAdd(key="k", amount=1),
+                          idempotent=False)
+        assert calls["n"] == 1

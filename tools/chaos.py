@@ -1,0 +1,597 @@
+#!/usr/bin/env python
+"""Deterministic chaos harness: scripted kill/evict/outage scenarios
+with hard recovery gates.
+
+`common/faults.py` gives single fault POINTS deterministic triggering;
+this harness composes them into end-to-end SCENARIOS — the sequences a
+hostile fleet actually produces — and gates each one on the survival
+contract instead of "it didn't crash":
+
+- **loss continuity**: training resumed from the surviving checkpoint
+  reproduces the uninterrupted run's losses bitwise;
+- **bounded loss of progress**: a hard kill loses at most one commit
+  interval of steps;
+- **goodput attribution**: an eviction drain books its wall time to the
+  ``eviction`` category, not ``other``;
+- **no wedged processes**: every scenario ends with the process tree
+  (or thread set) it started with.
+
+Scenarios (each takes a seed; the same seed replays the same run):
+
+| name                     | what it scripts                             |
+|--------------------------|---------------------------------------------|
+| eviction_during_save     | eviction notice lands while a chunked save  |
+|                          | is staged: graceful drain, emergency commit |
+|                          | of the CURRENT step, bitwise resume         |
+| sigkill_mid_step         | `node.preempt:kill:@K` hard-exits a real    |
+|                          | trainer subprocess mid-run; the restarted   |
+|                          | process loses <= one commit interval        |
+| master_restart_mid_plan  | the master dies holding a pending Brain     |
+|                          | cluster-plan slice; the restarted executor  |
+|                          | redelivers and the plan converges to acked  |
+| brain_outage_mid_plan    | the Brain goes dark mid-plan; the executor  |
+|                          | degrades to warnings and the redelivered    |
+|                          | slice executes when the Brain returns       |
+
+Usage:
+
+    python tools/chaos.py --list
+    python tools/chaos.py --scenario eviction_during_save --seed 7
+    python tools/chaos.py --all --seed 7          # the full matrix
+    # any invocation: --json for machine-readable gate output
+
+Exit codes: 0 = every gate passed; 1 = a gate failed; 2 = usage.
+
+``bench.py --smoke`` runs ``eviction_during_save`` + ``sigkill_mid_step``
+through :func:`run_scenario` as a nonzero-exit CI gate; the full matrix
+lives in ``tests/test_chaos_harness.py`` (tier-1 runs the fast
+scenarios, the subprocess legs are ``slow``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+try:  # script execution (`python tools/chaos.py`) without an
+    import dlrover_tpu  # noqa: F401  # installed package: fall back to
+except ImportError:  # the repo root next to this file
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import dlrover_tpu  # noqa: F401
+
+# scenario tuning: small enough for CI, large enough that the kill and
+# the eviction land mid-run with real checkpoints on both sides
+TOTAL_STEPS = 16
+SAVE_MEMORY_INTERVAL = 4
+# the commit interval the SIGKILL gate is bounded by (storage commits
+# in the subprocess leg; the sync engine commits every memory save too)
+COMMIT_INTERVAL = 4
+EVICT_STEP = 8  # a save-interval step: a chunked stage is in flight
+KILL_STEP = 7  # node.preempt evaluations are step boundaries (1-based)
+
+
+# ---------------------------------------------------------------------------
+# shared tiny-trainer scaffolding (the bench's forensics-leg pattern)
+# ---------------------------------------------------------------------------
+class _Tokens:
+    def __init__(self, n=2048, seq=32, vocab=256, seed=11):
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        self.data = rng.integers(0, vocab, (n, seq + 1), dtype=np.int32)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return {"x": self.data[i][:-1], "y": self.data[i][1:]}
+
+
+def _make_trainer(ckpt_dir: str, seed: int, metrics_hook=None):
+    import jax
+    import optax
+
+    from dlrover_tpu.accel.strategy import Strategy
+    from dlrover_tpu.models import tiny
+    from dlrover_tpu.parallel.mesh import MeshConfig
+    from dlrover_tpu.trainer.elastic.trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
+
+    return ElasticTrainer(
+        model_cfg=tiny(num_layers=1),
+        tx=optax.adamw(1e-2),
+        dataset=_Tokens(seed=seed),
+        trainer_cfg=TrainerConfig(
+            batch_size=8,
+            seq_len=32,
+            ckpt_dir=ckpt_dir,
+            save_memory_interval=SAVE_MEMORY_INTERVAL,
+            save_storage_interval=10_000,  # memory-path commits only
+            report_metrics=False,
+            log_interval=4,
+            prefetch=2,
+            donation_aware=False,
+            speculative_compile=False,
+            eviction_grace_s=20.0,
+        ),
+        strategy=Strategy(mesh=MeshConfig(dp=1), dtype="float32"),
+        devices=list(jax.devices())[:1],
+        metrics_hook=metrics_hook,
+    )
+
+
+def _loss_recorder(losses: Dict[int, float], on_step=None):
+    """metrics_hook that materializes every step's loss (the host sync
+    makes the trajectory comparable bitwise) and optionally fires a
+    scripted per-step action."""
+
+    def hook(step, metrics):
+        if "loss" in metrics:
+            losses[step] = float(metrics["loss"])
+        if on_step is not None:
+            on_step(step)
+
+    return hook
+
+
+def _thread_names() -> List[str]:
+    return sorted(
+        t.name for t in threading.enumerate() if t.is_alive()
+    )
+
+
+# ---------------------------------------------------------------------------
+# scenario: eviction during chunked save
+# ---------------------------------------------------------------------------
+def eviction_during_save(seed: int, workdir: str) -> Dict:
+    """An eviction notice lands at a save-interval step — a chunked
+    stage of that step is in flight — and the trainer drains: aborts
+    the stale stage, emergency-commits the CURRENT step inside the
+    grace window, books the drain to the ``eviction`` goodput
+    category, and a fresh trainer resumes bitwise."""
+    from dlrover_tpu.common import faults
+    from dlrover_tpu.obs import flight_recorder as obs_flight
+
+    faults.reset()
+    golden_dir = os.path.join(workdir, "golden_ckpt")
+    ckpt_dir = os.path.join(workdir, "evict_ckpt")
+    out: Dict = {"scenario": "eviction_during_save", "seed": seed}
+
+    # the drain dumps an `eviction` flight bundle: keep the artifact
+    # inside the scenario workdir (and gate on its existence below)
+    prev_flight = os.environ.get(obs_flight.ENV_FLIGHT_DIR)
+    os.environ[obs_flight.ENV_FLIGHT_DIR] = os.path.join(
+        workdir, "flight"
+    )
+    threads_before = _thread_names()
+
+    # golden: the uninterrupted trajectory (same data seed, same save
+    # cadence — checkpoint activity must not be a variable)
+    golden: Dict[int, float] = {}
+    t = _make_trainer(golden_dir, seed, _loss_recorder(golden))
+    try:
+        t.train(TOTAL_STEPS)
+    finally:
+        t.close()
+
+    # run A: evict at EVICT_STEP, mid-save
+    losses_a: Dict[int, float] = {}
+    stager_live = {"at_evict": False}
+
+    def maybe_evict(step):
+        if step == EVICT_STEP:
+            stager_live["at_evict"] = trainer._stager is not None
+            trainer.request_eviction(20.0, reason="chaos")
+
+    trainer = _make_trainer(
+        ckpt_dir, seed, _loss_recorder(losses_a, maybe_evict)
+    )
+    try:
+        trainer.train(TOTAL_STEPS)
+        out["evicted"] = trainer.evicted
+        out["drain_ms"] = round(trainer.eviction_drain_ms, 1)
+        gp = trainer._goodput.snapshot()
+        out["goodput_eviction_s"] = round(
+            gp.seconds.get("eviction", 0.0), 4
+        )
+        out["goodput_other_s"] = round(gp.seconds.get("other", 0.0), 4)
+        verified = trainer._ckptr.latest_verified_step()
+        out["verified_step"] = verified
+    finally:
+        trainer.close()
+
+    # run B: resume from the emergency checkpoint, finish the run
+    losses_b: Dict[int, float] = {}
+    t2 = _make_trainer(ckpt_dir, seed, _loss_recorder(losses_b))
+    try:
+        out["resumed_step"] = t2.global_step
+        t2.train(TOTAL_STEPS)
+    finally:
+        t2.close()
+
+    flight_dir = os.path.join(workdir, "flight")
+    out["flight_bundle"] = bool(
+        os.path.isdir(flight_dir)
+        and any("eviction" in d for d in os.listdir(flight_dir))
+    )
+    if prev_flight is None:
+        os.environ.pop(obs_flight.ENV_FLIGHT_DIR, None)
+    else:
+        os.environ[obs_flight.ENV_FLIGHT_DIR] = prev_flight
+
+    # let trainer daemon threads (heartbeats, watchdogs) finish dying
+    deadline = time.time() + 10
+    while _thread_names() != threads_before and time.time() < deadline:
+        time.sleep(0.1)
+    wedged = [
+        n for n in _thread_names() if n not in threads_before
+    ]
+    out["wedged_threads"] = wedged
+
+    resumed_steps = sorted(losses_b)
+    out["loss_bitwise"] = bool(resumed_steps) and all(
+        losses_b[s] == golden.get(s) for s in resumed_steps
+    )
+    out["lost_steps"] = TOTAL_STEPS  # pessimistic default
+    if "resumed_step" in out:
+        out["lost_steps"] = EVICT_STEP - out["resumed_step"]
+    out["ok"] = bool(
+        out.get("evicted")
+        and out.get("verified_step", -1) == EVICT_STEP
+        and out.get("resumed_step", -1) == EVICT_STEP
+        and out["loss_bitwise"]
+        and out["goodput_eviction_s"] > 0
+        and out["flight_bundle"]
+        and not wedged
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scenario: SIGKILL mid-step (real process death, subprocess leg)
+# ---------------------------------------------------------------------------
+def _worker_train(args) -> int:
+    """Subprocess body: a real trainer that dies (or not) per the
+    DLROVER_TPU_FAULTS env the parent armed. Writes a progress file so
+    the parent can gate on resumed/final steps."""
+    progress = {"start_step": -1, "end_step": -1, "losses": {}}
+
+    def hook(step, metrics):
+        if "loss" in metrics:
+            progress["losses"][str(step)] = float(metrics["loss"])
+        progress["end_step"] = step
+        with open(args.progress + ".tmp", "w") as f:
+            json.dump(progress, f)
+        os.replace(args.progress + ".tmp", args.progress)
+
+    t = _make_trainer(args.ckpt_dir, args.seed, hook)
+    # the kill leg gates on the STORAGE commit interval: shm does not
+    # outlive this single-process scenario, disk does
+    t.tcfg.save_storage_interval = COMMIT_INTERVAL
+    t.tcfg.save_memory_interval = 10_000
+    try:
+        progress["start_step"] = t.global_step
+        hook(t.global_step, {})
+        t.train(TOTAL_STEPS)
+    finally:
+        t.close()
+    return 0
+
+
+def _spawn_worker(
+    ckpt_dir: str, progress: str, seed: int, fault_spec: str = ""
+) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DLROVER_TPU_FAULTS"] = fault_spec
+    return subprocess.Popen(
+        [
+            sys.executable,
+            os.path.abspath(__file__),
+            "--worker",
+            "--ckpt-dir", ckpt_dir,
+            "--progress", progress,
+            "--seed", str(seed),
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT,
+    )
+
+
+def sigkill_mid_step(seed: int, workdir: str) -> Dict:
+    """A real trainer process hard-exits (``node.preempt:kill:@K`` —
+    the in-process stand-in for SIGKILL/OOM-kill/hard preemption) at a
+    scripted step boundary; the restarted process must resume from a
+    verified checkpoint losing at most one commit interval of steps,
+    finish, and stay loss-continuous with its own pre-kill history."""
+    ckpt_dir = os.path.join(workdir, "kill_ckpt")
+    progress = os.path.join(workdir, "kill_progress.json")
+    out: Dict = {"scenario": "sigkill_mid_step", "seed": seed}
+
+    # leg 1: scripted death at the KILL_STEP-th step boundary
+    spec = f"node.preempt:kill:@{KILL_STEP + 1}:{seed}"
+    p = _spawn_worker(ckpt_dir, progress, seed, fault_spec=spec)
+    try:
+        rc = p.wait(timeout=600)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        out["ok"] = False
+        out["error"] = "killed worker wedged (timeout)"
+        return out
+    out["kill_rc"] = rc
+    try:
+        with open(progress) as f:
+            prog1 = json.load(f)
+    except (OSError, ValueError):
+        prog1 = {}
+    kill_step = int(prog1.get("end_step", -1))
+    out["killed_at_step"] = kill_step
+
+    # leg 2: restart, resume, finish
+    p2 = _spawn_worker(ckpt_dir, progress, seed, fault_spec="")
+    try:
+        rc2 = p2.wait(timeout=600)
+    except subprocess.TimeoutExpired:
+        p2.kill()
+        out["ok"] = False
+        out["error"] = "restarted worker wedged (timeout)"
+        return out
+    out["restart_rc"] = rc2
+    try:
+        with open(progress) as f:
+            prog2 = json.load(f)
+    except (OSError, ValueError):
+        prog2 = {}
+    resumed = int(prog2.get("start_step", -1))
+    out["resumed_step"] = resumed
+    out["final_step"] = int(prog2.get("end_step", -1))
+    out["lost_steps"] = kill_step - resumed if resumed >= 0 else -1
+    # continuity across the kill: where the histories overlap, the
+    # replayed steps must reproduce the pre-kill losses bitwise
+    l1 = prog1.get("losses", {})
+    l2 = prog2.get("losses", {})
+    overlap = sorted(set(l1) & set(l2), key=int)
+    out["overlap_steps"] = len(overlap)
+    out["loss_bitwise"] = all(l1[s] == l2[s] for s in overlap)
+    out["ok"] = bool(
+        rc == 137  # the injected hard exit, not an incidental crash
+        and rc2 == 0
+        and kill_step >= KILL_STEP - 1
+        and 0 <= out["lost_steps"] <= COMMIT_INTERVAL
+        and out["final_step"] >= TOTAL_STEPS
+        and out["loss_bitwise"]
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scenario: master restart with a pending cluster-plan slice
+# ---------------------------------------------------------------------------
+class _FakeScaler:
+    """Minimal platform scaler: records plans (the PR-9 test pattern)."""
+
+    def __init__(self):
+        self.plans: List = []
+        self.exclude: tuple = ()
+
+    def scale(self, plan):
+        self.plans.append(plan)
+
+    def relaunch_node(self, old, new):
+        pass
+
+    def set_exclude_hosts(self, hosts):
+        self.exclude = tuple(hosts)
+
+
+def _brain_with_plan(workdir: str, job: str, count: int):
+    """A serving Brain holding one pending plan slice for ``job``."""
+    from dlrover_tpu.brain.service import start_brain_service
+
+    db = os.path.join(workdir, "brain.db")
+    server, ds, addr = start_brain_service(db_path=db)
+    version = ds.next_plan_version()
+    ds.record_cluster_plan(
+        version,
+        [
+            {
+                "job": job,
+                "worker_count": count,
+                "prev_count": 2,
+                "reason": "chaos",
+                "exclude_hosts": [],
+            }
+        ],
+        time.time(),
+    )
+    return server, ds, addr, version
+
+
+def _executor(addr: str, job: str, target: int = 2):
+    from dlrover_tpu.brain.plan_exec import PlanExecutor
+    from dlrover_tpu.brain.service import BrainClient
+    from dlrover_tpu.master.job_auto_scaler import JobAutoScaler
+    from dlrover_tpu.master.job_manager import JobManager
+
+    jm = JobManager(scaler=_FakeScaler())
+    jm.create_initial_nodes(target)
+    scaler = JobAutoScaler(
+        jm, scaler=_FakeScaler(), target_nodes=target
+    )
+    client = BrainClient(addr, job, retry_budget_s=3.0, retries=1)
+    return PlanExecutor(client, scaler), scaler, client
+
+
+def master_restart_mid_plan(seed: int, workdir: str) -> Dict:
+    """The master dies between the Brain emitting a plan slice and the
+    executor acting on it (the PR-9 robustness gap): the restarted
+    master's fresh ``PlanExecutor`` (ack watermark 0) must be
+    redelivered the pending slice, execute it, and converge the plan
+    to acked — no slice is ever silently dropped."""
+    out: Dict = {"scenario": "master_restart_mid_plan", "seed": seed}
+    job = f"chaos-mrp-{seed}"
+    server, ds, addr, version = _brain_with_plan(workdir, job, 4)
+    try:
+        # incarnation 1: built, never got to poll (died mid-window)
+        ex1, _, c1 = _executor(addr, job)
+        c1.close()
+        del ex1
+
+        # incarnation 2: fresh watermark -> redelivery -> ack
+        ex2, scaler2, c2 = _executor(addr, job)
+        try:
+            executed = ex2.poll_once()
+            out["executed_version"] = executed
+            out["target_after"] = scaler2.target
+            counts = ds.plan_status_counts()
+            out["plan_status"] = dict(counts)
+            out["ok"] = bool(
+                executed == version
+                and scaler2.target == 4
+                and counts.get("acked", 0) >= 1
+                and counts.get("pending", 0) == 0
+            )
+        finally:
+            c2.close()
+    finally:
+        server.stop(grace=0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scenario: Brain outage mid-plan
+# ---------------------------------------------------------------------------
+def brain_outage_mid_plan(seed: int, workdir: str) -> Dict:
+    """The Brain goes dark while a plan slice is pending: the executor
+    must degrade to warnings (training untouched), and the redelivered
+    slice must execute once the Brain returns on the same store."""
+    from dlrover_tpu.brain.service import start_brain_service
+
+    out: Dict = {"scenario": "brain_outage_mid_plan", "seed": seed}
+    job = f"chaos-bom-{seed}"
+    server, ds, addr, version = _brain_with_plan(workdir, job, 4)
+    port = int(addr.rsplit(":", 1)[1])
+    ex, scaler, client = _executor(addr, job)
+    try:
+        # outage BEFORE the first poll: the slice is pending server-side
+        server.stop(grace=0).wait(timeout=5)
+        got = ex.poll_once()  # must swallow the outage, not raise
+        out["poll_during_outage"] = got
+        out["target_during_outage"] = scaler.target
+
+        # Brain returns on the same port + store
+        server2, ds2, _ = start_brain_service(
+            port=port, db_path=os.path.join(workdir, "brain.db")
+        )
+        try:
+            deadline = time.time() + 30
+            executed = None
+            while executed is None and time.time() < deadline:
+                executed = ex.poll_once()
+                if executed is None:
+                    time.sleep(0.2)
+            out["executed_version"] = executed
+            counts = ds2.plan_status_counts()
+            out["plan_status"] = dict(counts)
+            out["ok"] = bool(
+                got is None
+                and out["target_during_outage"] == 2
+                and executed == version
+                and scaler.target == 4
+                and counts.get("acked", 0) >= 1
+            )
+        finally:
+            server2.stop(grace=0)
+    finally:
+        client.close()
+        server.stop(grace=0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# registry / CLI
+# ---------------------------------------------------------------------------
+SCENARIOS = {
+    "eviction_during_save": eviction_during_save,
+    "sigkill_mid_step": sigkill_mid_step,
+    "master_restart_mid_plan": master_restart_mid_plan,
+    "brain_outage_mid_plan": brain_outage_mid_plan,
+}
+
+
+def run_scenario(
+    name: str, seed: int = 7, workdir: Optional[str] = None
+) -> Dict:
+    """Run one scenario; returns its gate dict (``ok`` is the verdict).
+    A replay with the same name+seed reproduces the same run."""
+    if name not in SCENARIOS:
+        raise ValueError(
+            f"unknown scenario {name!r} (known: {sorted(SCENARIOS)})"
+        )
+    own_tmp = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix=f"dlrover_chaos_{name}_")
+    os.makedirs(workdir, exist_ok=True)
+    try:
+        return SCENARIOS[name](seed, workdir)
+    finally:
+        if own_tmp:
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("dlrover-tpu chaos harness")
+    ap.add_argument("--scenario", default="")
+    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--json", action="store_true")
+    # internal: the subprocess leg of sigkill_mid_step
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--progress", default="")
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        return _worker_train(args)
+    if args.list:
+        for name in sorted(SCENARIOS):
+            print(name)
+        return 0
+    names = (
+        sorted(SCENARIOS)
+        if args.all
+        else ([args.scenario] if args.scenario else [])
+    )
+    if not names:
+        ap.print_usage()
+        return 2
+    results = []
+    for name in names:
+        res = run_scenario(name, seed=args.seed)
+        results.append(res)
+        if args.json:
+            print(json.dumps(res))
+        else:
+            print(
+                f"{name}: {'PASS' if res.get('ok') else 'FAIL'} "
+                f"({json.dumps({k: v for k, v in res.items() if k not in ('scenario',)})})"
+            )
+    return 0 if all(r.get("ok") for r in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,9 @@ FAULT_SITES = frozenset(
         "reshard.gather",  # on-device resize state remap
         "prefetch.pull",  # prefetch producer's source pull
         "node.preempt",  # trainer step boundary (preemption arrival)
+        "embedding.export",  # embedding ckpt bytes → storage (data
+        # kinds corrupt the serialized npz/delta payload)
+        "embedding.import",  # embedding ckpt read leg (restore)
     }
 )
 

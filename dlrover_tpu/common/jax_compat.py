@@ -119,6 +119,26 @@ def cost_analysis_dict(compiled) -> dict:
     return ca or {}
 
 
+def pallas_interpret_mode() -> bool:
+    """True when Pallas kernels must run under the interpreter on this
+    backend (anything without a Mosaic TPU compiler). The embedding
+    hot-tier gather/scatter kernels pass this to ``pallas_call`` so
+    tier-1 runs everywhere: compiled on TPU, interpreted on the CPU
+    backend — same kernel, same numerics. ``DLROVER_TPU_PALLAS``
+    overrides (``compile``/``interpret``) for debugging."""
+    forced = os.getenv("DLROVER_TPU_PALLAS", "")
+    if forced == "interpret":
+        return True
+    if forced == "compile":
+        return False
+    import jax
+
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except Exception:
+        return True
+
+
 def pallas_tpu_compiler_params(**kwargs):
     """``pltpu.CompilerParams(**kwargs)`` under either name."""
     from jax.experimental.pallas import tpu as pltpu

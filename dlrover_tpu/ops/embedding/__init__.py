@@ -7,10 +7,19 @@ kv_store.cc for the component mapping.
 from dlrover_tpu.ops.embedding.store import (  # noqa: F401
     KvEmbeddingStore,
     ShardedKvEmbedding,
+    WarmReshardReport,
 )
 from dlrover_tpu.ops.embedding.ckpt import (  # noqa: F401
     IncrementalCheckpointManager,
 )
 from dlrover_tpu.ops.embedding.tiered import (  # noqa: F401
+    NativeTieredKvEmbedding,
     TieredKvEmbedding,
+    three_tier_embedding,
+)
+from dlrover_tpu.ops.embedding.device_tier import (  # noqa: F401
+    DeviceHotTier,
+    DeviceSparseEmbedding,
+    EmbeddingTierStats,
+    PreparedBatch,
 )

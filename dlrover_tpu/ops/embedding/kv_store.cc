@@ -551,6 +551,69 @@ void kv_import(void* h, const int64_t* keys, int64_t n,
   }
 }
 
+// List every live key (no values, no freq/ts bump): the cheap first
+// pass of a warm reshard — 8 bytes per row instead of the full
+// row_floats export, so ownership can be recomputed over millions of
+// rows before any row data moves. Returns the count, or -1 when the
+// caller's buffer raced a concurrent insert and is too small (retry
+// with a fresh kv_size).
+int64_t kv_export_keys(void* h, int64_t* keys_out, int64_t capacity) {
+  Store* s = static_cast<Store*>(h);
+  int64_t n = 0;
+  for (auto& b : s->buckets) {
+    std::lock_guard<std::mutex> g(b.mu);
+    for (auto& kv : b.map) {
+      if (n >= capacity) return -1;
+      keys_out[n++] = kv.first;
+    }
+  }
+  return n;
+}
+
+// Export full rows (values + slots + freq/ts) for exactly the given
+// keys — the move leg of a warm reshard and the device hot tier's
+// fault-in read. Absent keys zero their row and mark freq_out = -1;
+// freq/ts are NOT bumped (this is a state read, not an access).
+// Returns the number of keys found.
+int64_t kv_export_rows(void* h, const int64_t* keys, int64_t n,
+                       float* rows_out, int64_t* freq_out,
+                       int64_t* ts_out) {
+  Store* s = static_cast<Store*>(h);
+  int64_t rf = s->row_floats();
+  int64_t found = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    Bucket& b = s->bucket(keys[i]);
+    std::lock_guard<std::mutex> g(b.mu);
+    auto it = b.map.find(keys[i]);
+    if (it == b.map.end()) {
+      std::memset(rows_out + i * rf, 0, sizeof(float) * rf);
+      freq_out[i] = -1;
+      ts_out[i] = -1;
+    } else {
+      std::memcpy(rows_out + i * rf, it->second.data.data(),
+                  sizeof(float) * rf);
+      freq_out[i] = it->second.freq;
+      ts_out[i] = it->second.ts;
+      ++found;
+    }
+  }
+  return found;
+}
+
+// Delete exactly the given keys (the hand-off leg of a warm reshard:
+// rows exported to their new owner leave the old shard). Returns the
+// number actually removed.
+int64_t kv_delete_keys(void* h, const int64_t* keys, int64_t n) {
+  Store* s = static_cast<Store*>(h);
+  int64_t removed = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    Bucket& b = s->bucket(keys[i]);
+    std::lock_guard<std::mutex> g(b.mu);
+    removed += static_cast<int64_t>(b.map.erase(keys[i]));
+  }
+  return removed;
+}
+
 // Evict rows last touched before ts_limit (parity:
 // KvVariableDeleteWithTimestamp). Returns evicted count.
 int64_t kv_delete_before_timestamp(void* h, int64_t ts_limit) {

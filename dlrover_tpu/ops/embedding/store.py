@@ -17,6 +17,7 @@ import os
 import subprocess
 import threading
 import time
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -29,14 +30,61 @@ _LIB_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 
 
+# stable per-process fallback build dir when the cache dir is not
+# writable (read-only site-packages / locked-down shared FS): one extra
+# compile per process, not one per _build_library call
+_FALLBACK_BUILD_DIR: Optional[str] = None
+
+
 def _build_library() -> str:
+    global _FALLBACK_BUILD_DIR
     with open(_SRC, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
     cache_dir = os.environ.get(
         "DLROVER_TPU_KV_CACHE", os.path.join(_HERE, "_build")
     )
-    os.makedirs(cache_dir, exist_ok=True)
-    lib_path = os.path.join(cache_dir, f"libdlrover_kv_{digest}.so")
+    lib_name = f"libdlrover_kv_{digest}.so"
+    candidates = [cache_dir]
+    if _FALLBACK_BUILD_DIR is not None:
+        candidates.append(_FALLBACK_BUILD_DIR)
+    for d in candidates:
+        cached = os.path.join(d, lib_name)
+        if os.path.exists(cached):
+            return cached
+    # the try covers ONLY the writability probe: a failing COMPILE
+    # (missing g++, source error) must propagate untouched instead of
+    # being misreported as "cache dir not writable" and pointlessly
+    # retried in a tmpdir
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        # probe writability up front: a read-only dir would otherwise
+        # surface as an opaque g++ "cannot open output file" error
+        probe = os.path.join(cache_dir, f".probe.{os.getpid()}")
+        with open(probe, "w"):
+            pass
+        os.remove(probe)
+    except OSError as e:
+        # read-only cache dir (read-only install, locked-down shared
+        # FS): fall back to a process-stable tmpdir instead of
+        # crashing at import time — the PR-6 topology-cache
+        # read-only-fs tolerance, applied to the build cache
+        if _FALLBACK_BUILD_DIR is None:
+            import tempfile
+
+            _FALLBACK_BUILD_DIR = tempfile.mkdtemp(
+                prefix="dlrover_kv_build_"
+            )
+        logger.warning(
+            f"kv build cache {cache_dir} is not writable ({e}); "
+            f"building into {_FALLBACK_BUILD_DIR} instead (set "
+            f"DLROVER_TPU_KV_CACHE to a writable dir to cache builds)"
+        )
+        return _compile_into(_FALLBACK_BUILD_DIR, lib_name)
+    return _compile_into(cache_dir, lib_name)
+
+
+def _compile_into(cache_dir: str, lib_name: str) -> str:
+    lib_path = os.path.join(cache_dir, lib_name)
     if os.path.exists(lib_path):
         return lib_path
     tmp = f"{lib_path}.tmp.{os.getpid()}"
@@ -97,6 +145,13 @@ def _load_library() -> ctypes.CDLL:
         lib.kv_import.argtypes = [p, I64P, i64, F32P, I64P, I64P]
         lib.kv_delete_before_timestamp.restype = i64
         lib.kv_delete_before_timestamp.argtypes = [p, i64]
+        # warm-reshard / device-tier primitives
+        lib.kv_export_keys.restype = i64
+        lib.kv_export_keys.argtypes = [p, I64P, i64]
+        lib.kv_export_rows.restype = i64
+        lib.kv_export_rows.argtypes = [p, I64P, i64, F32P, I64P, I64P]
+        lib.kv_delete_keys.restype = i64
+        lib.kv_delete_keys.argtypes = [p, I64P, i64]
         lib.kv_meta.argtypes = [p, I64P, i64, I64P, I64P]
         # native cold tier (hybrid embedding spill store)
         lib.cold_open.restype = p
@@ -122,6 +177,33 @@ _SCATTER_OPS = {
     "update": 0, "add": 1, "sub": 2, "mul": 3, "div": 4,
     "min": 5, "max": 6,
 }
+
+
+@dataclass
+class WarmReshardReport:
+    """What a warm reshard moved (mirrors ckpt.reshard.ReshardReport:
+    the per-axis story for embedding shards is old→new shard count and
+    the mover fraction)."""
+
+    old_shards: int
+    new_shards: int
+    total_rows: int
+    moved_rows: int
+    bytes_moved: int
+    elapsed_s: float
+
+    @property
+    def moved_fraction(self) -> float:
+        return self.moved_rows / self.total_rows if self.total_rows else 0.0
+
+    def describe(self) -> str:
+        return (
+            f"shards {self.old_shards}->{self.new_shards}: "
+            f"{self.moved_rows}/{self.total_rows} rows moved "
+            f"({100.0 * self.moved_fraction:.1f}%, "
+            f"{self.bytes_moved / 1e6:.2f} MB) in "
+            f"{self.elapsed_s * 1e3:.1f} ms"
+        )
 
 
 def _now() -> int:
@@ -352,6 +434,36 @@ class KvEmbeddingStore:
         self._lib.kv_meta(self._h, k, len(k), freq, ts)
         return freq, ts
 
+    def export_keys(self) -> np.ndarray:
+        """Every live key — 8 bytes per row, no values, no freq/ts
+        bump: the cheap ownership pass of a warm reshard."""
+        while True:
+            cap = len(self) + 64  # headroom vs concurrent inserts
+            keys = np.empty(cap, np.int64)
+            n = self._lib.kv_export_keys(self._h, keys, cap)
+            if n >= 0:  # -1 = an insert raced the sizing; retry
+                return keys[:n]
+
+    def export_rows(
+        self, keys
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Full rows (values + slots), freq, ts and a presence mask for
+        exactly ``keys``. Unlike gather this is a STATE read: absent
+        keys are NOT created, freq/ts are NOT bumped, and optimizer
+        slots travel — the move leg of a warm reshard and the device
+        hot tier's fault-in."""
+        k = self._keys(keys)
+        rows = np.empty((len(k), self.row_floats), np.float32)
+        freq = np.empty(len(k), np.int64)
+        ts = np.empty(len(k), np.int64)
+        self._lib.kv_export_rows(self._h, k, len(k), rows, freq, ts)
+        return rows, freq, ts, freq >= 0
+
+    def delete_keys(self, keys) -> int:
+        """Remove exactly ``keys``; returns the number removed."""
+        k = self._keys(keys)
+        return self._lib.kv_delete_keys(self._h, k, len(k))
+
     def evict_older_than(self, ts_limit: int) -> int:
         return self._lib.kv_delete_before_timestamp(self._h, ts_limit)
 
@@ -427,9 +539,13 @@ class ShardedKvEmbedding:
         return sum(len(s) for s in self.shards)
 
     def _route(self, keys: np.ndarray) -> np.ndarray:
+        return self._route_n(keys, self.num_shards)
+
+    @staticmethod
+    def _route_n(keys: np.ndarray, num_shards: int) -> np.ndarray:
         # same mix as the native bucket router, mod num_shards
         h = keys.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
-        return ((h >> np.uint64(17)) % np.uint64(self.num_shards)).astype(
+        return ((h >> np.uint64(17)) % np.uint64(num_shards)).astype(
             np.int64
         )
 
@@ -569,7 +685,136 @@ class ShardedKvEmbedding:
                 tss[mask] = t
         return freqs, tss
 
+    def export_keys(self) -> np.ndarray:
+        """Every live key across all shards (no values, no bumps)."""
+        parts = [s.export_keys() for s in self.shards]
+        return (
+            np.concatenate(parts) if parts else np.empty(0, np.int64)
+        )
+
+    def import_rows(self, keys, rows, freq=None, ts=None):
+        """Route-and-import full rows (values + slots) — the write leg
+        of device-tier spills and warm-reshard moves."""
+        k = KvEmbeddingStore._keys(keys)
+        if len(k) == 0:
+            return
+        r = np.ascontiguousarray(rows, dtype=np.float32).reshape(
+            len(k), self.dim * (1 + self.num_slots)
+        )
+        f = (
+            np.ascontiguousarray(freq, dtype=np.int64)
+            if freq is not None
+            else np.zeros(len(k), np.int64)
+        )
+        t = (
+            np.ascontiguousarray(ts, dtype=np.int64)
+            if ts is not None
+            else np.zeros(len(k), np.int64)
+        )
+        route = self._route(k)
+        for sid in range(self.num_shards):
+            mask = route == sid
+            if mask.any():
+                self.shards[sid].import_rows(
+                    k[mask], r[mask], f[mask], t[mask]
+                )
+
+    def export_rows(
+        self, keys
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Full rows/freq/ts/presence for exactly ``keys`` (state
+        read: nothing created, freq/ts untouched, slots travel)."""
+        k = KvEmbeddingStore._keys(keys)
+        rows = np.zeros((len(k), self.dim * (1 + self.num_slots)), np.float32)
+        freq = np.full(len(k), -1, np.int64)
+        ts = np.full(len(k), -1, np.int64)
+        present = np.zeros(len(k), bool)
+        route = self._route(k)
+        for sid in range(self.num_shards):
+            mask = route == sid
+            if mask.any():
+                r, f, t, p = self.shards[sid].export_rows(k[mask])
+                rows[mask], freq[mask], ts[mask] = r, f, t
+                present[mask] = p
+        return rows, freq, ts, present
+
+    def delete_keys(self, keys) -> int:
+        k = KvEmbeddingStore._keys(keys)
+        route = self._route(k)
+        removed = 0
+        for sid in range(self.num_shards):
+            mask = route == sid
+            if mask.any():
+                removed += self.shards[sid].delete_keys(k[mask])
+        return removed
+
     # -- elastic resharding --------------------------------------------
+    def warm_reshard(self, new_num_shards: int) -> "WarmReshardReport":
+        """N → M shards moving ONLY rows whose route changes.
+
+        The cold :meth:`reshard` exports every row once and re-imports
+        the whole table into fresh stores; under a resize that is the
+        embedding analogue of a full checkpoint restore. The warm path
+        is the ElasWave-style per-dimension reconfiguration: existing
+        shard objects with index < M are kept in place, each old shard
+        lists its keys (8 bytes/row), recomputes ownership under M, and
+        exports/deletes only the movers — rows whose home is unchanged
+        never leave their store. Bumps the PS cluster version exactly
+        like :meth:`reshard` so consumers detect the topology change.
+        """
+        old_n = self.num_shards
+        t0 = time.perf_counter()
+        total = len(self)
+        moved = 0
+        bytes_moved = 0
+        rf = self.dim * (1 + self.num_slots)
+        if new_num_shards == old_n:
+            return WarmReshardReport(
+                old_shards=old_n, new_shards=new_num_shards,
+                total_rows=total, moved_rows=0, bytes_moved=0,
+                elapsed_s=time.perf_counter() - t0,
+            )
+        for _ in range(old_n, new_num_shards):
+            self.shards.append(
+                KvEmbeddingStore(
+                    self.dim, self.num_slots, self.seed, self.init_scale
+                )
+            )
+        # movers are computed against the OLD shard list: shards past M
+        # dissolve entirely, kept shards surrender only re-routed keys
+        for sid in range(old_n):
+            shard = self.shards[sid]
+            keys = shard.export_keys()
+            if len(keys) == 0:
+                continue
+            dest = self._route_n(keys, new_num_shards)
+            mover_mask = dest != sid
+            movers = keys[mover_mask]
+            if len(movers) == 0:
+                continue
+            rows, freq, ts, _present = shard.export_rows(movers)
+            mover_dest = dest[mover_mask]
+            for did in np.unique(mover_dest):
+                m = mover_dest == did
+                self.shards[int(did)].import_rows(
+                    movers[m], rows[m], freq[m], ts[m]
+                )
+            shard.delete_keys(movers)
+            moved += len(movers)
+            bytes_moved += len(movers) * (rf * 4 + 3 * 8)
+        if new_num_shards < old_n:
+            self.shards = self.shards[:new_num_shards]
+        if self._version_service is not None:
+            self._version_service.inc_global_version()
+        report = WarmReshardReport(
+            old_shards=old_n, new_shards=new_num_shards,
+            total_rows=total, moved_rows=moved,
+            bytes_moved=bytes_moved,
+            elapsed_s=time.perf_counter() - t0,
+        )
+        logger.info(f"warm embedding reshard: {report.describe()}")
+        return report
+
     def reshard(self, new_num_shards: int) -> None:
         """N → M shards: export every row once, re-route, import. Bumps
         the PS cluster version so consumers refresh their topology."""

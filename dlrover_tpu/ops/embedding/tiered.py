@@ -24,6 +24,7 @@ Semantics:
 
 from __future__ import annotations
 
+import os
 import sqlite3
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -259,6 +260,16 @@ class TieredKvEmbedding:
             }
         return state
 
+    def warm_reshard(self, new_num_shards: int):
+        """Move-only reshard of the hot store under the tier write
+        lock. The sqlite cold tier is keyed by row key (not by shard),
+        so cold rows stay valid across any hot shard-count change."""
+        self._tier_lock.acquire_write()
+        try:
+            return self.hot.warm_reshard(new_num_shards)
+        finally:
+            self._tier_lock.release_write()
+
     # -- eviction -------------------------------------------------------
     def evict_cold(self, ts_limit: int) -> int:
         """Move rows last touched before ``ts_limit`` to disk.
@@ -362,8 +373,6 @@ class NativeTieredKvEmbedding:
     """
 
     def __init__(self, hot: ShardedKvEmbedding, cold_path: str):
-        import os
-
         from dlrover_tpu.ops.embedding.store import _load_library
 
         self.hot = hot
@@ -411,42 +420,61 @@ class NativeTieredKvEmbedding:
                 )
             self._cold.append(h)
 
+    def _drain_cold_to_hot(self):
+        """Fault every cold row back hot and retire the spill logs —
+        the shared prelude of both reshard flavors (per-shard logs
+        cannot survive a shard-count change). Caller holds the tier
+        write lock."""
+        for shard, cold in zip(self.hot.shards, self._cold):
+            n = self._lib.cold_count(cold)
+            if n:
+                keys = np.empty(n, np.int64)
+                rows = np.empty((n, self.row_floats), np.float32)
+                freq = np.empty(n, np.int64)
+                ts = np.empty(n, np.int64)
+                got = self._lib.cold_export(
+                    cold, 0, keys, rows, freq, ts, n
+                )
+                if got < 0:
+                    raise OSError("cold-tier read failed in reshard")
+                moved = self._lib.kv_fault_from_cold(
+                    shard._h, cold, keys[:got], got
+                )
+                if moved < 0:
+                    raise OSError(
+                        "cold-tier fault-in failed in reshard"
+                    )
+        old_n = len(self._cold)
+        for h in self._cold:
+            self._lib.cold_close(h)
+        self._cold = []
+        for i in range(old_n):
+            os.unlink(f"{self._cold_path}.shard{i}")
+
     def reshard(self, new_num_shards: int):
         """Elastic reshard of a tiered store: every cold row faults back
         hot first (key→shard routing changes with the shard count, so
         per-shard spill logs cannot survive a reshard), the hot store
         reshards, and fresh empty logs are opened for the new layout."""
-        import os
-
         self._tier_lock.acquire_write()
         try:
-            for shard, cold in zip(self.hot.shards, self._cold):
-                n = self._lib.cold_count(cold)
-                if n:
-                    keys = np.empty(n, np.int64)
-                    rows = np.empty((n, self.row_floats), np.float32)
-                    freq = np.empty(n, np.int64)
-                    ts = np.empty(n, np.int64)
-                    got = self._lib.cold_export(
-                        cold, 0, keys, rows, freq, ts, n
-                    )
-                    if got < 0:
-                        raise OSError("cold-tier read failed in reshard")
-                    moved = self._lib.kv_fault_from_cold(
-                        shard._h, cold, keys[:got], got
-                    )
-                    if moved < 0:
-                        raise OSError(
-                            "cold-tier fault-in failed in reshard"
-                        )
-            old_n = len(self._cold)
-            for h in self._cold:
-                self._lib.cold_close(h)
-            self._cold = []
-            for i in range(old_n):
-                os.unlink(f"{self._cold_path}.shard{i}")
+            self._drain_cold_to_hot()
             self.hot.reshard(new_num_shards)
             self._open_cold_logs()
+        finally:
+            self._tier_lock.release_write()
+
+    def warm_reshard(self, new_num_shards: int):
+        """Move-only reshard. Spill logs are keyed BY SHARD here, so
+        cold rows fault back hot first (same rule as :meth:`reshard`),
+        then the hot store moves only re-routed rows and fresh logs
+        open for the new layout."""
+        self._tier_lock.acquire_write()
+        try:
+            self._drain_cold_to_hot()
+            report = self.hot.warm_reshard(new_num_shards)
+            self._open_cold_logs()
+            return report
         finally:
             self._tier_lock.release_write()
 
@@ -585,3 +613,48 @@ class NativeTieredKvEmbedding:
         for h in self._cold:
             self._lib.cold_close(h)
         self._cold = []
+
+
+def three_tier_embedding(
+    num_shards: int,
+    dim: int,
+    cold_path: str,
+    num_slots: int = 1,
+    seed: int = 0,
+    init_scale: float = 0.05,
+    hbm_budget_bytes: Optional[int] = None,
+    native_cold: bool = True,
+    version_service=None,
+    **device_kwargs,
+):
+    """The full hierarchy in one call: HBM hot tier (device-resident,
+    Pallas gather/scatter, bounded by ``hbm_budget_bytes``) over a host
+    C++ store over a disk cold tier. The HBM→host boundary mirrors the
+    host→disk one: bounded by a byte budget, spilled at checkpoint
+    cadence (``DeviceSparseEmbedding.evict_to_host`` ≙ ``evict_cold``),
+    rows fault back in on access with optimizer slots travelling.
+    Returns a :class:`~dlrover_tpu.ops.embedding.device_tier.
+    DeviceSparseEmbedding` whose ``host`` is the two-host-tier store.
+    """
+    from dlrover_tpu.ops.embedding.device_tier import (
+        _DEF_HBM_BUDGET,
+        DeviceSparseEmbedding,
+    )
+
+    hot = ShardedKvEmbedding(
+        num_shards, dim, num_slots=num_slots, seed=seed,
+        init_scale=init_scale, version_service=version_service,
+    )
+    tier_cls = (
+        NativeTieredKvEmbedding if native_cold else TieredKvEmbedding
+    )
+    host = tier_cls(hot, cold_path)
+    return DeviceSparseEmbedding(
+        host,
+        hbm_budget_bytes=(
+            hbm_budget_bytes
+            if hbm_budget_bytes is not None
+            else _DEF_HBM_BUDGET
+        ),
+        **device_kwargs,
+    )

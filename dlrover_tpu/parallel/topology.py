@@ -474,6 +474,22 @@ def note_fallback_use(model: LinkModel) -> None:
     )
 
 
+def price_host_transfer(
+    nbytes: int, h2d: bool = False, model: Optional[LinkModel] = None
+) -> float:
+    """Seconds a host↔device transfer of ``nbytes`` costs on the PR-6
+    host leg (bandwidth + per-transfer latency). The embedding row
+    pipeline prices its fault-in (H2D) and spill/scatter-back (D2H)
+    traffic through here so the dry-runner's est_step_s and the Brain's
+    job telemetry see the same host-link physics the collectives and
+    checkpoint staging are priced with — not an invented constant."""
+    if nbytes <= 0:
+        return 0.0
+    m = model if model is not None else get_link_model()
+    note_fallback_use(m)
+    return m.host_lat_s + nbytes * m.sec_per_host_byte(h2d=h2d)
+
+
 def export_link_metrics(model: LinkModel, registry=None) -> None:
     """Per-link gauges into the metrics registry
     (docs/observability.md): ``dlrover_link_{ici,dcn,host_d2h,

@@ -8,23 +8,60 @@ The TPU shape replaces the parameter-server fleet with the host-side
 trains on the chip under jit, the SPARSE embedding rows live in host
 memory with fused native optimizers, and elasticity means
 
-- checkpoint = dense pytree (flash ckpt) + embedding export (npz);
+- checkpoint = dense pytree + embedding export (npz, crc-verified with
+  rollback to the previous good file — a torn export must never
+  restore silently);
 - failover = watch the master's PS cluster version; on a bump (a
-  reshard happened elsewhere, or we are a restarted worker) re-import
-  the embedding state before continuing — the analog of the reference's
-  relaunch-aware session refresh (tensorflow_failover.py:91).
+  reshard happened elsewhere, or we are a restarted worker) refresh
+  the embedding state before continuing — the analog of the
+  reference's relaunch-aware session refresh
+  (tensorflow_failover.py:91). With a reshard target the refresh is a
+  WARM id-range redistribution (move only re-routed rows) instead of
+  a full npz re-import; either way the window is booked to the goodput
+  ledger (``restart_replay``) instead of vanishing from the wall-time
+  closure.
+
+Two train cycles:
+
+- **host cycle** (``train_step``): host gather → device dense step →
+  host fused sparse update — every row crosses the host link every
+  step (the full fused-optimizer family is available);
+- **device cycle** (``train_step_device`` / ``run(overlapped=True)``):
+  the embedding is a :class:`DeviceSparseEmbedding` — gathers are HBM
+  Pallas kernels, the sparse update runs on device, and with the
+  :class:`SparseRowPipeline` the host link only carries fault-ins for
+  step N+1 (overlapping step N's compute) and async spill-backs.
 """
 
 from __future__ import annotations
 
+import io
 import os
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.ops.embedding import ShardedKvEmbedding
+from dlrover_tpu.ops.embedding.device_tier import DeviceSparseEmbedding
+
+
+def _book_replay(t0_ns: int):
+    """Attribute a state-refresh window (re-import or warm reshard) to
+    the goodput ledger so it cannot vanish from the wall-time closure."""
+    try:
+        from dlrover_tpu.obs.goodput import default_ledger
+
+        ledger = default_ledger()
+        if ledger is not None:
+            ledger.mark_interval(
+                "restart_replay", t0_ns, time.monotonic_ns()
+            )
+    except Exception:  # accounting must never break the refresh itself
+        pass
 
 
 class SparseTrainer:
@@ -32,19 +69,26 @@ class SparseTrainer:
 
     ``dense_step(dense_params, rows, batch) ->
     (dense_params, row_grads, metrics)`` is the user's jitted dense
-    computation; the trainer owns the gather → step → fused-sparse-update
+    computation; the trainer owns the gather → step → sparse-update
     cycle, checkpoints, and cluster-version failover.
+
+    ``embedding`` may be a host store (``ShardedKvEmbedding`` /
+    tiered) for the classic host cycle, or a
+    :class:`DeviceSparseEmbedding` to enable the device cycle.
+    ``target_shards_fn`` (e.g. a master query) makes a cluster-version
+    bump warm-reshard to that shard count instead of re-importing.
     """
 
     def __init__(
         self,
-        embedding: ShardedKvEmbedding,
+        embedding,
         dense_params: Any,
         dense_step: Callable,
         ckpt_dir: str = "",
         sparse_optimizer: str = "adagrad",
         sparse_lr: float = 0.05,
         master_client=None,
+        target_shards_fn: Optional[Callable[[], int]] = None,
     ):
         self.embedding = embedding
         self.dense_params = dense_params
@@ -53,12 +97,19 @@ class SparseTrainer:
         self._opt = sparse_optimizer
         self._lr = sparse_lr
         self._client = master_client
+        self._target_shards_fn = target_shards_fn
         self._cluster_version = (
-            master_client.get_cluster_version() if master_client else 0
+            self._poll_cluster_version(initial=True)
+            if master_client
+            else 0
         )
         self.step = 0
 
-    # -- sparse update dispatch ----------------------------------------
+    @property
+    def device_mode(self) -> bool:
+        return isinstance(self.embedding, DeviceSparseEmbedding)
+
+    # -- sparse update dispatch (host cycle) ---------------------------
     def _apply_sparse(self, keys, grads):
         if self._opt == "adagrad":
             self.embedding.sparse_adagrad(keys, grads, lr=self._lr)
@@ -90,26 +141,62 @@ class SparseTrainer:
             raise ValueError(f"unknown sparse optimizer {self._opt!r}")
 
     # -- failover -------------------------------------------------------
+    def _poll_cluster_version(self, initial: bool = False) -> int:
+        """One cluster-version read over the client. A real
+        ``MasterClient`` already retries with full jitter inside
+        ``_call``; when the budget is exhausted anyway (master restart
+        in flight) the poll degrades to "no change" instead of killing
+        the train loop — the next poll sees the bump."""
+        try:
+            return self._client.get_cluster_version()
+        except (ConnectionError, OSError) as e:
+            if initial:
+                raise
+            logger.warning(
+                f"cluster-version poll failed ({e!r}); keeping version "
+                f"{self._cluster_version} until the master answers"
+            )
+            return self._cluster_version
+
     def check_failover(self) -> bool:
-        """True if the PS cluster version moved and state was reloaded
-        (parity: ps_addresses_changed → session refresh)."""
+        """True if the PS cluster version moved and state was refreshed
+        (parity: ps_addresses_changed → session refresh). The refresh
+        is a WARM move-only reshard when a target shard count is known
+        (``target_shards_fn``), else the npz re-import; both windows
+        are booked to the goodput ledger as ``restart_replay``."""
         if self._client is None:
             return False
-        version = self._client.get_cluster_version()
+        version = self._poll_cluster_version()
         if version == self._cluster_version:
             return False
         logger.warning(
             f"embedding cluster version {self._cluster_version} -> "
-            f"{version}: reloading sparse state"
+            f"{version}: refreshing sparse state"
         )
         self._cluster_version = version
-        self.restore_embedding()
+        t0 = time.monotonic_ns()
+        try:
+            target = (
+                self._target_shards_fn()
+                if self._target_shards_fn is not None
+                else None
+            )
+            if target and hasattr(self.embedding, "warm_reshard"):
+                report = self.embedding.warm_reshard(int(target))
+                logger.info(
+                    f"warm embedding reshard on version bump: "
+                    f"{report.describe()}"
+                )
+            else:
+                self.restore_embedding()
+        finally:
+            _book_replay(t0)
         return True
 
     # -- train loop -----------------------------------------------------
     def train_step(self, ids: np.ndarray, batch: Any) -> Dict:
-        """One cycle: gather rows → dense step on device → fused sparse
-        update on host."""
+        """One HOST cycle: gather rows → dense step on device → fused
+        sparse update on host."""
         rows = self.embedding.gather(ids)
         self.dense_params, row_grads, metrics = self._dense_step(
             self.dense_params, rows, batch
@@ -118,34 +205,270 @@ class SparseTrainer:
         self.step += 1
         return metrics
 
+    def train_step_device(
+        self, ids: np.ndarray, batch: Any, prep=None
+    ) -> Dict:
+        """One DEVICE cycle: HBM gather → dense step → on-device sparse
+        update. ``prep`` usually comes from the row pipeline one step
+        ahead; a stale prep (the tier was flushed/resharded in between)
+        is transparently re-prepared."""
+        emb = self.embedding
+        if prep is None:
+            prep = emb.prepare(ids)
+        try:
+            try:
+                rows = emb.gather_for(prep)
+            except RuntimeError:  # stale generation → re-prepare
+                prep = emb.prepare(ids)
+                rows = emb.gather_for(prep)
+            self.dense_params, row_grads, metrics = self._dense_step(
+                self.dense_params, rows, batch
+            )
+            emb.apply_grads(prep, row_grads, step=self.step + 1)
+        finally:
+            emb.release(prep)  # no-op when apply_grads got there
+        self.step += 1
+        return metrics
+
+    def run(
+        self,
+        data_iter,
+        num_steps: Optional[int] = None,
+        overlapped: bool = True,
+        pipeline_depth: int = 2,
+    ) -> List[Dict]:
+        """Drive ``data_iter`` of ``(ids, batch)`` pairs. In device
+        mode with ``overlapped=True`` the row pipeline faults step
+        N+1's rows in while step N computes; otherwise the synchronous
+        cycle runs (host cycle for host stores, inline-prepare device
+        cycle for a device embedding)."""
+        metrics: List[Dict] = []
+        if self.device_mode and overlapped:
+            from dlrover_tpu.data.sparse_prefetch import SparseRowPipeline
+
+            pipe = SparseRowPipeline(
+                data_iter, self.embedding, depth=pipeline_depth
+            )
+            try:
+                for ids, batch, prep in pipe:
+                    metrics.append(
+                        self.train_step_device(ids, batch, prep)
+                    )
+                    if num_steps and len(metrics) >= num_steps:
+                        break
+            finally:
+                pipe.close()
+            return metrics
+        for ids, batch in data_iter:
+            if self.device_mode:
+                metrics.append(self.train_step_device(ids, batch))
+            else:
+                metrics.append(self.train_step(ids, batch))
+            if num_steps and len(metrics) >= num_steps:
+                break
+        return metrics
+
+    # -- telemetry ------------------------------------------------------
+    def telemetry(self) -> Dict[str, float]:
+        """Per-table hot-tier scalars (+ trainer step), published to
+        the obs registry; with a master client they also ride
+        ``report_train_metrics`` to the master's collector → Brain
+        ``job_metrics`` alongside loss/lr."""
+        scalars: Dict[str, float] = {"sparse_step": float(self.step)}
+        if self.device_mode:
+            scalars.update(self.embedding.export_metrics())
+        return scalars
+
+    def report_telemetry(self, extra: Optional[Dict] = None):
+        scalars = self.telemetry()
+        if extra:
+            scalars.update(extra)
+        if self._client is not None and hasattr(
+            self._client, "report_train_metrics"
+        ):
+            try:
+                self._client.report_train_metrics(self.step, scalars)
+            except (ConnectionError, OSError) as e:
+                logger.warning(f"telemetry report failed: {e!r}")
+        return scalars
+
     # -- checkpoint -----------------------------------------------------
     def _emb_path(self) -> str:
         return os.path.join(self._ckpt_dir, "embedding_state.npz")
 
+    @staticmethod
+    def _prev_path(path: str) -> str:
+        return path.replace(".npz", ".prev.npz")
+
+    @staticmethod
+    def _meta_path(path: str) -> str:
+        return path + ".meta"
+
+    def _dense_leaves(self) -> Dict[str, np.ndarray]:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(self.dense_params)
+        return {
+            f"__dense_{i}": np.asarray(leaf)
+            for i, leaf in enumerate(leaves)
+        }
+
+    def _restore_dense(self, data: Dict[str, np.ndarray]):
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(self.dense_params)
+        saved = [
+            data.pop(k)
+            for k in sorted(
+                (k for k in data if k.startswith("__dense_")),
+                key=lambda k: int(k.rsplit("_", 1)[1]),
+            )
+        ]
+        if not saved:
+            return
+        if len(saved) != len(leaves):
+            logger.warning(
+                f"checkpoint dense leaf count {len(saved)} != current "
+                f"{len(leaves)}; keeping in-memory dense params"
+            )
+            return
+        import jax.numpy as jnp
+
+        self.dense_params = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(s) for s in saved]
+        )
+
     def save_embedding(self):
+        """crc-verified atomic save: the npz blob's whole-file crc32
+        plus per-record crcs are written to a ``.meta`` sidecar BEFORE
+        any byte can be corrupted in flight (the PR-5 writer-side-crc
+        rule), and the previous good file is kept for rollback. A
+        device-tier embedding is flushed first so device-resident
+        training is in the export."""
         if not self._ckpt_dir:
             return
         os.makedirs(self._ckpt_dir, exist_ok=True)
-        state = self.embedding.export_state()
-        # np.savez appends .npz to names without it — keep the suffix on
-        # the temp file so the atomic rename targets what was written
-        tmp = self._emb_path().replace(".npz", f".tmp{os.getpid()}.npz")
-        np.savez(tmp, step=self.step, **state)
-        os.replace(tmp, self._emb_path())
+        state = dict(self.embedding.export_state())
+        records = {**state, **self._dense_leaves()}
+        buf = io.BytesIO()
+        np.savez(buf, step=np.int64(self.step), **records)
+        blob = buf.getvalue()
+        import json
+
+        meta = {
+            "crc32": zlib.crc32(blob),
+            "nbytes": len(blob),
+            "records": {
+                name: zlib.crc32(np.ascontiguousarray(arr).tobytes())
+                for name, arr in records.items()
+            },
+            "step": int(self.step),
+        }
+        # fault site embedding.export: data kinds corrupt the payload
+        # AFTER the crcs were computed — exactly a torn/bit-rotted
+        # write, which restore must detect and roll back from
+        blob = faults.corrupt("embedding.export", blob)
+        path = self._emb_path()
+        if os.path.exists(path):
+            os.replace(path, self._prev_path(path))
+            if os.path.exists(self._meta_path(path)):
+                os.replace(
+                    self._meta_path(path),
+                    self._meta_path(self._prev_path(path)),
+                )
+        tmp = path.replace(".npz", f".tmp{os.getpid()}.npz")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())  # a "saved" checkpoint is durable
+        with open(self._meta_path(path) + ".tmp", "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(self._meta_path(path) + ".tmp", self._meta_path(path))
+        os.replace(tmp, path)
         logger.info(
-            f"saved embedding state ({len(state['keys'])} rows) at "
-            f"step {self.step}"
+            f"saved embedding state ({len(state['keys'])} rows, "
+            f"crc {meta['crc32']:08x}) at step {self.step}"
+        )
+
+    def _load_verified(self, path: str) -> Optional[Dict]:
+        """Load + verify one checkpoint file; None when absent, raises
+        ``ValueError`` on corruption (caller quarantines)."""
+        import json
+
+        if not os.path.exists(path):
+            return None
+        faults.fire("embedding.import")
+        with open(path, "rb") as f:
+            blob = f.read()
+        meta = None
+        if os.path.exists(self._meta_path(path)):
+            try:
+                with open(self._meta_path(path)) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                meta = None
+        if meta is not None:
+            if len(blob) != meta["nbytes"] or (
+                zlib.crc32(blob) != meta["crc32"]
+            ):
+                raise ValueError(
+                    f"embedding checkpoint {path} fails crc/length "
+                    f"verification (torn or corrupted write)"
+                )
+        try:
+            data = dict(np.load(io.BytesIO(blob)))
+        except Exception as e:  # torn zip on legacy (meta-less) files
+            raise ValueError(f"embedding checkpoint {path} unreadable: {e!r}")
+        if meta is not None:
+            for name, crc in meta["records"].items():
+                if name not in data or (
+                    zlib.crc32(
+                        np.ascontiguousarray(data[name]).tobytes()
+                    )
+                    != crc
+                ):
+                    raise ValueError(
+                        f"embedding checkpoint {path}: record "
+                        f"{name!r} fails crc verification"
+                    )
+        return data
+
+    def _quarantine(self, path: str):
+        for p in (path, self._meta_path(path)):
+            if os.path.exists(p):
+                os.replace(p, p + ".corrupt")
+        logger.error(
+            f"embedding checkpoint {path} quarantined to "
+            f"{path}.corrupt"
         )
 
     def restore_embedding(self) -> bool:
+        """Restore the newest VERIFIED embedding checkpoint: the
+        current file, else (after quarantining it) the kept previous
+        one — a torn export rolls back instead of restoring silently."""
         path = self._emb_path()
-        if not os.path.exists(path):
-            return False
-        data = dict(np.load(path))
-        self.step = int(data.pop("step", 0))
-        self.embedding.import_state(data)
-        logger.info(
-            f"restored embedding state ({len(data['keys'])} rows) at "
-            f"step {self.step}"
-        )
-        return True
+        for candidate in (path, self._prev_path(path)):
+            try:
+                data = self._load_verified(candidate)
+            except ValueError as e:
+                logger.error(str(e))
+                self._quarantine(candidate)
+                continue
+            if data is None:
+                continue
+            self.step = int(data.pop("step", 0))
+            self._restore_dense(data)
+            self.embedding.import_state(data)
+            logger.info(
+                f"restored embedding state ({len(data['keys'])} rows) "
+                f"at step {self.step}"
+                + (
+                    " [rolled back to previous good file]"
+                    if candidate != path
+                    else ""
+                )
+            )
+            return True
+        return False

@@ -2,14 +2,20 @@
 KvEmbedding store: host-side embeddings + fused sparse optimizers,
 dense head on the chip, incremental checkpoints, PS-version failover.
 
-    python examples/train_sparse.py
+    python examples/train_sparse.py            # host cycle
+    python examples/train_sparse.py --device   # HBM hot tier +
+                                               # overlapped row pipeline
+                                               # (docs/sparse-embeddings.md)
 """
+
+import sys
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from dlrover_tpu.ops.embedding import (
+    DeviceSparseEmbedding,
     IncrementalCheckpointManager,
     ShardedKvEmbedding,
 )
@@ -39,8 +45,18 @@ def dense_step(w, rows, labels):
     return w - 0.3 * gw, grows, {"loss": float(loss)}
 
 
-def main():
-    embedding = ShardedKvEmbedding(num_shards=4, dim=DIM, seed=0)
+def main(device_tier: bool = False):
+    host = ShardedKvEmbedding(num_shards=4, dim=DIM, seed=0)
+    embedding = (
+        DeviceSparseEmbedding(
+            host,
+            hbm_budget_bytes=8 << 20,
+            sparse_optimizer="adagrad",
+            lr=0.5,
+        )
+        if device_tier
+        else host
+    )
     trainer = SparseTrainer(
         embedding,
         dense_params=jnp.zeros((DIM,)),
@@ -49,18 +65,28 @@ def main():
         sparse_optimizer="adagrad",
         sparse_lr=0.5,
     )
-    ckpt = IncrementalCheckpointManager(embedding, "/tmp/sparse_ckpt/emb")
+    ckpt = IncrementalCheckpointManager(host, "/tmp/sparse_ckpt/emb")
 
     rng = np.random.default_rng(0)
-    for step in range(200):
-        ids = rng.integers(0, 10_000, 256)
-        labels = (ids % 2).astype(np.float32)  # toy target: id parity
-        metrics = trainer.train_step(ids, labels)
-        if step % 50 == 0:
-            print(f"step {step}: loss={metrics['loss']:.4f}")
-            ckpt.save(step=step)  # full or delta automatically
+
+    def stream(n):
+        for _ in range(n):
+            ids = rng.integers(0, 10_000, 256)
+            yield ids, (ids % 2).astype(np.float32)  # target: id parity
+
+    for chunk in range(4):
+        metrics = trainer.run(stream(50), overlapped=device_tier)
+        print(
+            f"step {trainer.step}: loss={metrics[-1]['loss']:.4f}"
+        )
+        if device_tier:
+            embedding.flush()  # checkpoint precondition
+            print("  hot tier:", trainer.telemetry())
+        ckpt.save(step=trainer.step)  # full or delta automatically
     print(f"embedding rows: {len(embedding)}")
+    if device_tier:
+        embedding.close()
 
 
 if __name__ == "__main__":
-    main()
+    main(device_tier="--device" in sys.argv[1:])

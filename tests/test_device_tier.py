@@ -1,0 +1,550 @@
+"""Device-resident embedding hot tier: Pallas/jnp kernels, LRU + pins,
+on-device optimizer math, spill coherency, the overlapped row pipeline
+(ISSUE 12)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.data.sparse_prefetch import SparseRowPipeline
+from dlrover_tpu.ops.embedding import ShardedKvEmbedding
+from dlrover_tpu.ops.embedding.device_tier import (
+    DeviceHotTier,
+    DeviceSparseEmbedding,
+    _bucket,
+    _Kernels,
+)
+
+DIM = 8
+RF = DIM * 2  # dim * (1 + num_slots)
+
+
+def _host(num_shards=2, seed=0, num_slots=1, dim=DIM):
+    return ShardedKvEmbedding(num_shards, dim, num_slots=num_slots, seed=seed)
+
+
+def _emb(capacity=64, opt="adagrad", lr=0.5, host=None, **kw):
+    return DeviceSparseEmbedding(
+        host if host is not None else _host(),
+        capacity=capacity,
+        sparse_optimizer=opt,
+        lr=lr,
+        **kw,
+    )
+
+
+class TestKernels:
+    """The pallas kernels (CPU interpreter) and the jnp fallback are the
+    same function: both are checked against a raw numpy reference."""
+
+    @pytest.mark.parametrize("mode", ["jnp", "pallas"])
+    def test_gather_matches_numpy(self, mode):
+        import jax.numpy as jnp
+
+        k = _Kernels(mode)
+        table = jnp.asarray(
+            np.random.default_rng(0).normal(size=(32, RF)).astype(np.float32)
+        )
+        slots = np.array([3, 0, 31, 7], np.int32)
+        out = np.asarray(k.gather(table, slots))
+        np.testing.assert_array_equal(out, np.asarray(table)[slots])
+
+    @pytest.mark.parametrize("mode", ["jnp", "pallas"])
+    def test_scatter_matches_numpy(self, mode):
+        import jax.numpy as jnp
+
+        k = _Kernels(mode)
+        base = np.random.default_rng(1).normal(size=(32, RF)).astype(np.float32)
+        table = jnp.asarray(base)
+        slots = np.array([5, 1, 30], np.int32)
+        rows = jnp.asarray(
+            np.random.default_rng(2).normal(size=(3, RF)).astype(np.float32)
+        )
+        new = np.asarray(k.scatter(table, slots, rows))
+        ref = base.copy()
+        ref[slots] = np.asarray(rows)
+        np.testing.assert_array_equal(new, ref)
+
+    def test_modes_agree(self):
+        import jax.numpy as jnp
+
+        table = jnp.asarray(
+            np.random.default_rng(3).normal(size=(16, RF)).astype(np.float32)
+        )
+        slots = np.array([2, 9, 15, 0], np.int32)
+        a = np.asarray(_Kernels("pallas").gather(table, slots))
+        b = np.asarray(_Kernels("jnp").gather(table, slots))
+        np.testing.assert_array_equal(a, b)
+
+    def test_bucket(self):
+        assert _bucket(1) == 64
+        assert _bucket(64) == 64
+        assert _bucket(65) == 128
+        assert _bucket(4097) == 8192
+
+
+class TestDeviceHotTier:
+    def test_capacity_from_budget(self):
+        tier = DeviceHotTier(DIM, 1, hbm_budget_bytes=RF * 4 * 100)
+        assert tier.capacity == 100
+        assert tier.hbm_bytes == RF * 4 * 100
+        # one extra scratch row beyond capacity
+        assert tier.table.shape == (101, RF)
+
+    def test_lru_evicts_coldest_unpinned(self):
+        tier = DeviceHotTier(DIM, 1, capacity=4)
+        for i in range(4):
+            s, _v, _vi = tier._allocate(1)
+            tier.bind(np.array([i], np.int64), s)
+        tier.touch(np.array([tier._slot_of[0]]))  # 0 is now hottest
+        tier.pin(np.array([tier._slot_of[1]]))  # 1 may not be evicted
+        _slots, _victims, victim_ids = tier._allocate(2)
+        assert {int(k) for k in victim_ids} == {2, 3}
+        assert 0 in tier._slot_of and 1 in tier._slot_of
+        assert 2 not in tier._slot_of and 3 not in tier._slot_of
+
+    def test_allocate_over_pinned_capacity_raises(self):
+        tier = DeviceHotTier(DIM, 1, capacity=2)
+        s, _v, _vi = tier._allocate(2)
+        tier.bind(np.array([7, 8], np.int64), s)
+        tier.pin(s)
+        with pytest.raises(ValueError, match="pinned"):
+            tier._allocate(1)
+
+
+class TestDeviceSparseEmbedding:
+    def test_gather_matches_host_values(self):
+        host = _host()
+        emb = _emb(host=host)
+        ids = np.array([5, 3, 5, 9], np.int64)
+        rows = np.asarray(emb.gather(ids))
+        ref = host.gather(np.array([5, 3, 5, 9]), insert_missing=False)
+        np.testing.assert_array_equal(rows, ref)
+        emb.close()
+
+    def test_adagrad_matches_numpy_reference(self):
+        host = _host()
+        emb = _emb(host=host, lr=0.5)
+        ids = np.array([1, 2, 1, 4, 2, 2], np.int64)
+        prep = emb.prepare(ids)
+        grads = (
+            np.random.default_rng(0).normal(size=(6, DIM)).astype(np.float32)
+        )
+        uniq, inv = np.unique(ids, return_inverse=True)
+        gsum = np.zeros((len(uniq), DIM), np.float32)
+        np.add.at(gsum, inv, grads)
+        w0 = host.gather(uniq, insert_missing=False).copy()
+        ref = w0 - 0.5 * gsum / (np.sqrt(gsum * gsum) + 1e-8)
+        emb.apply_grads(prep, grads, step=1)
+        got = np.asarray(emb.gather(uniq))
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+        # flush writes the same values (AND the accumulator slot) back
+        emb.flush()
+        np.testing.assert_allclose(
+            host.gather(uniq, insert_missing=False), ref,
+            rtol=1e-6, atol=1e-6,
+        )
+        acc_rows, _, _, present = host.export_rows(uniq)
+        assert present.all()
+        np.testing.assert_allclose(
+            acc_rows[:, DIM:], gsum * gsum, rtol=1e-6, atol=1e-6
+        )
+        emb.close()
+
+    @pytest.mark.parametrize("opt,slots", [("momentum", 1), ("adam", 2)])
+    def test_other_optimizers_run_and_learn(self, opt, slots):
+        host = _host(num_slots=slots)
+        emb = _emb(host=host, opt=opt, lr=0.1)
+        ids = np.arange(8, dtype=np.int64)
+        w0 = np.asarray(emb.gather(ids)).copy()
+        for s in range(3):
+            prep = emb.prepare(ids)
+            emb.apply_grads(
+                prep, np.ones((8, DIM), np.float32), step=s + 1
+            )
+        w1 = np.asarray(emb.gather(ids))
+        assert not np.allclose(w0, w1)
+        assert np.isfinite(w1).all()
+        emb.close()
+
+    def test_lru_spill_preserves_trained_values(self):
+        host = _host()
+        emb = _emb(host=host, capacity=16, lr=1.0)
+        # train 48 distinct ids through a 16-slot tier: spills must
+        # carry the trained values (and slots) back to the host store
+        for start in range(0, 48, 8):
+            ids = np.arange(start, start + 8, dtype=np.int64)
+            prep = emb.prepare(ids)
+            emb.apply_grads(
+                prep, np.full((8, DIM), 0.1, np.float32), step=1
+            )
+        emb.flush()
+        assert emb.stats.spill_rows > 0
+        assert len(host) == 48
+        # every id's value reflects exactly one adagrad step
+        for probe in (0, 20, 40):
+            ids = np.arange(probe, probe + 4, dtype=np.int64)
+            rows, _, _, present = host.export_rows(ids)
+            assert present.all()
+            acc = rows[:, DIM:]
+            np.testing.assert_allclose(acc, 0.01, rtol=1e-5)
+        emb.close()
+
+    def test_sync_spill_mode(self):
+        emb = _emb(capacity=8, async_spill=False)
+        for start in range(0, 32, 8):
+            prep = emb.prepare(np.arange(start, start + 8, dtype=np.int64))
+            emb.apply_grads(prep, np.ones((8, DIM), np.float32), step=1)
+        emb.flush()
+        assert emb.stats.spill_rows > 0
+        emb.close()
+
+    def test_capacity_too_small_for_batch_raises(self):
+        emb = _emb(capacity=4)
+        with pytest.raises(ValueError, match="cannot hold"):
+            emb.prepare(np.arange(10, dtype=np.int64))
+        emb.close()
+
+    def test_stale_prep_rejected_after_evict(self):
+        emb = _emb(capacity=64)
+        prep = emb.prepare(np.arange(8, dtype=np.int64))
+        emb.release(prep)
+        emb.evict_to_host(keep_rows=0)  # bumps the generation
+        with pytest.raises(RuntimeError, match="stale"):
+            emb.gather_for(prep)
+        emb.close()
+
+    def test_import_state_invalidates_device_rows(self):
+        host = _host()
+        emb = _emb(host=host, lr=1.0)
+        ids = np.arange(6, dtype=np.int64)
+        prep = emb.prepare(ids)
+        emb.apply_grads(prep, np.ones((6, DIM), np.float32), step=1)
+        state = emb.export_state()  # flushes
+        # more device training after the snapshot
+        prep = emb.prepare(ids)
+        emb.apply_grads(prep, np.ones((6, DIM), np.float32), step=2)
+        moved = np.asarray(emb.gather(ids)).copy()
+        emb.import_state(state)  # restore the snapshot
+        back = np.asarray(emb.gather(ids))
+        assert not np.allclose(moved, back)
+        np.testing.assert_allclose(
+            back, host.gather(ids, insert_missing=False), rtol=1e-6
+        )
+        emb.close()
+
+    def test_warm_reshard_keeps_residency_and_values(self):
+        host = _host(num_shards=2)
+        emb = _emb(host=host, lr=1.0)
+        ids = np.arange(20, dtype=np.int64)
+        prep = emb.prepare(ids)
+        emb.apply_grads(prep, np.ones((20, DIM), np.float32), step=1)
+        before = np.asarray(emb.gather(ids)).copy()
+        report = emb.warm_reshard(3)
+        assert host.num_shards == 3
+        assert report.moved_rows < report.total_rows
+        np.testing.assert_array_equal(np.asarray(emb.gather(ids)), before)
+        emb.close()
+
+    def test_metrics_exported_per_table(self):
+        from dlrover_tpu.obs.metrics import MetricsRegistry
+
+        emb = _emb(table_name="clicks")
+        emb.gather(np.arange(8, dtype=np.int64))
+        reg = MetricsRegistry()
+        scalars = emb.export_metrics(reg)
+        assert scalars["emb_faults"] == 8.0
+        text = reg.prometheus_text()
+        assert "dlrover_embedding_gather_hit_pct" in text
+        assert 'table="clicks"' in text
+        emb.close()
+
+    def test_host_leg_priced_through_link_model(self):
+        from dlrover_tpu.parallel.topology import (
+            LinkModel,
+            reset_link_model,
+            set_link_model,
+        )
+
+        reset_link_model()
+        try:
+            set_link_model(
+                LinkModel(
+                    host_d2h_gbps=1.0,
+                    host_h2d_gbps=1.0,
+                    host_lat_s=0.0,
+                    fingerprint="t",
+                    source="measured",
+                )
+            )
+            emb = _emb()
+            emb.gather(np.arange(16, dtype=np.int64))
+            expected = 16 * RF * 4 / 1e9  # bytes at 1 GB/s
+            assert emb.stats.host_leg_s == pytest.approx(
+                expected, rel=1e-6
+            )
+            emb.close()
+        finally:
+            reset_link_model()
+
+    def test_rejects_unsupported_optimizer(self):
+        with pytest.raises(ValueError, match="device tier supports"):
+            _emb(opt="group_ftrl")
+
+    def test_rejects_insufficient_slots(self):
+        with pytest.raises(ValueError, match="num_slots"):
+            DeviceSparseEmbedding(
+                _host(num_slots=1), sparse_optimizer="adam"
+            )
+
+
+class TestSparseRowPipeline:
+    def _stream(self, n, bs=16, vocab=200, seed=5):
+        r = np.random.default_rng(seed)
+        for _ in range(n):
+            ids = r.integers(0, vocab, bs).astype(np.int64)
+            yield ids, (ids % 2).astype(np.float32)
+
+    def test_delivers_prepared_steps_in_order(self):
+        emb = _emb(capacity=256)
+        pipe = SparseRowPipeline(self._stream(6), emb)
+        seen = 0
+        for ids, batch, prep in pipe:
+            assert prep.n_unique == len(np.unique(ids))
+            # every unique id is already device-resident
+            assert (emb.hot.lookup(prep.unique_ids) >= 0).all()
+            emb.release(prep)
+            seen += 1
+        assert seen == 6
+        pipe.close()
+        emb.close()
+
+    def test_source_error_propagates_after_good_steps(self):
+        def bad_stream():
+            yield np.arange(4, dtype=np.int64), np.zeros(4, np.float32)
+            raise OSError("source died")
+
+        emb = _emb()
+        pipe = SparseRowPipeline(bad_stream(), emb)
+        ids, batch, prep = next(pipe)
+        emb.release(prep)
+        with pytest.raises(OSError, match="source died"):
+            next(pipe)
+        # terminal: the same error on every retry
+        with pytest.raises(OSError, match="source died"):
+            next(pipe)
+        pipe.close()
+        emb.close()
+
+    def test_close_is_idempotent_and_unblocks(self):
+        emb = _emb()
+        pipe = SparseRowPipeline(self._stream(2), emb)
+        pipe.close()
+        pipe.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            next(pipe)
+        emb.close()
+
+    def test_overlap_prepares_ahead(self):
+        """While the consumer sits on step N, the producer prepares
+        step N+1: its unique ids become resident before the consumer
+        asks."""
+        emb = _emb(capacity=256)
+        pipe = SparseRowPipeline(self._stream(3, seed=9), emb, depth=2)
+        first = next(pipe)
+        deadline = time.monotonic() + 5.0
+        while pipe.buffered_steps() < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pipe.buffered_steps() >= 1
+        emb.release(first[2])
+        for _, _, prep in pipe:
+            emb.release(prep)
+        pipe.close()
+        emb.close()
+
+    def test_trainer_run_overlapped_learns(self):
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.trainer.sparse import SparseTrainer
+
+        @jax.jit
+        def loss_fn(w, rows, y):
+            p = jax.nn.sigmoid(rows @ w)
+            return -jnp.mean(
+                y * jnp.log(p + 1e-7) + (1 - y) * jnp.log(1 - p + 1e-7)
+            )
+
+        grad_fn = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1)))
+
+        def dense_step(w, rows, batch):
+            y = jnp.asarray(batch)
+            loss, (gw, grows) = grad_fn(w, jnp.asarray(rows), y)
+            return w - 0.3 * gw, grows, {"loss": float(loss)}
+
+        def stream(n):
+            r = np.random.default_rng(7)
+            for _ in range(n):
+                ids = r.integers(0, 50, 128).astype(np.int64)
+                yield ids, (ids % 2).astype(np.float32)
+
+        emb = _emb(capacity=128, lr=0.5)
+        t = SparseTrainer(emb, jnp.zeros((DIM,)), dense_step)
+        losses = [m["loss"] for m in t.run(stream(25), overlapped=True)]
+        assert losses[-1] < losses[0] * 0.6, losses[::8]
+        assert t.step == 25
+        assert emb.stats.hit_pct > 50.0
+        emb.close()
+
+
+class _SlowImportHost:
+    """Host-store wrapper whose import_rows sleeps — widens the
+    spill-in-flight window deterministically."""
+
+    def __init__(self, host, delay=0.15):
+        self._host = host
+        self._delay = delay
+
+    def import_rows(self, *a, **kw):
+        time.sleep(self._delay)
+        return self._host.import_rows(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._host, name)
+
+
+class TestSpillLifetime:
+    """Review findings: fault-ins must not read pre-spill host state,
+    and join_spills must wait for the IMPORT, not just the queue."""
+
+    def test_fault_in_waits_for_inflight_spill_of_same_id(self):
+        base = _host()
+        host = _SlowImportHost(base)
+        emb = DeviceSparseEmbedding(
+            base, capacity=64, sparse_optimizer="adagrad", lr=1.0
+        )
+        emb.host = host  # slow the drain's import leg only
+        ids = np.arange(8, dtype=np.int64)
+        prep = emb.prepare(ids)
+        emb.apply_grads(prep, np.ones((8, DIM), np.float32), step=1)
+        trained = np.asarray(emb.gather(ids)).copy()
+        emb.evict_to_host(keep_rows=0)  # spill queued, import is slow
+        # immediate re-request of the victims: must see the TRAINED
+        # values, not the pre-spill host rows
+        got = np.asarray(emb.gather(ids))
+        np.testing.assert_array_equal(got, trained)
+        emb.close()
+
+    def test_join_spills_waits_for_import_not_queue(self):
+        base = _host()
+        emb = DeviceSparseEmbedding(
+            base, capacity=64, sparse_optimizer="adagrad", lr=1.0
+        )
+        emb.host = _SlowImportHost(base)
+        ids = np.arange(8, dtype=np.int64)
+        prep = emb.prepare(ids)
+        emb.apply_grads(prep, np.ones((8, DIM), np.float32), step=1)
+        trained = np.asarray(emb.gather(ids)).copy()
+        emb.evict_to_host(keep_rows=0)
+        state = emb.export_state()  # flush → join_spills barrier
+        keys = list(state["keys"])
+        rows = {int(k): state["rows"][i] for i, k in enumerate(keys)}
+        for i, k in enumerate(ids):
+            np.testing.assert_array_equal(
+                rows[int(k)][:DIM], trained[i]
+            )
+        emb.close()
+
+
+class TestPinLifetime:
+    """Review findings: generation bumps and pipeline close() must not
+    leak pins (ghost-pinned slots are un-evictable forever)."""
+
+    def test_evict_to_host_resets_pins_of_stale_preps(self):
+        emb = _emb(capacity=64)
+        # unpinned residents (a delivered+released earlier step) ...
+        done = emb.prepare(np.arange(100, 108, dtype=np.int64))
+        emb.release(done)
+        # ... plus an in-flight prep holding pins
+        prep = emb.prepare(np.arange(8, dtype=np.int64))
+        assert emb.hot._pins.sum() == 8
+        emb.evict_to_host(keep_rows=0)  # evicts the unpinned, bumps gen
+        assert emb.hot._pins.sum() == 0  # stale prep's pins reset too
+        with pytest.raises(RuntimeError, match="stale"):
+            emb.gather_for(prep)
+        emb.release(prep)  # stale: no-op, must not go negative
+        assert (emb.hot._pins >= 0).all()
+        # the tier is fully reusable: a full-capacity batch fits
+        p2 = emb.prepare(np.arange(200, 264, dtype=np.int64))
+        emb.release(p2)
+        emb.close()
+
+    def test_evict_with_everything_pinned_keeps_prep_valid(self):
+        emb = _emb(capacity=64)
+        prep = emb.prepare(np.arange(8, dtype=np.int64))
+        assert emb.evict_to_host(keep_rows=0) == 0  # all pinned: no-op
+        rows = emb.gather_for(prep)  # prep still valid (no gen bump)
+        assert rows.shape == (8, DIM)
+        emb.release(prep)
+        assert emb.hot._pins.sum() == 0
+        emb.close()
+
+    def test_pipeline_close_releases_undelivered_pins(self):
+        emb = _emb(capacity=256)
+
+        def stream():
+            r = np.random.default_rng(3)
+            while True:  # infinite: close() always drops buffered steps
+                ids = r.integers(0, 120, 16).astype(np.int64)
+                yield ids, (ids % 2).astype(np.float32)
+
+        pipe = SparseRowPipeline(stream(), emb, depth=2)
+        ids, batch, prep = next(pipe)
+        emb.release(prep)
+        deadline = time.monotonic() + 5.0
+        while pipe.buffered_steps() < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        pipe.close()  # must release the buffered (undelivered) preps
+        deadline = time.monotonic() + 2.0
+        while emb.hot._pins.sum() != 0 and time.monotonic() < deadline:
+            time.sleep(0.01)  # a racing producer releases via close path
+        assert emb.hot._pins.sum() == 0
+        emb.close()
+
+
+class TestReadOnlyGather:
+    def test_insert_missing_false_creates_nothing(self):
+        host = _host()
+        emb = _emb(host=host, lr=1.0)
+        ids = np.arange(6, dtype=np.int64)
+        prep = emb.prepare(ids)
+        emb.apply_grads(prep, np.ones((6, DIM), np.float32), step=1)
+        trained = np.asarray(emb.gather(ids)).copy()
+        n0 = len(emb)
+        probe = np.array([0, 3, 999, 1000], np.int64)
+        got = np.asarray(emb.gather(probe, insert_missing=False))
+        assert len(emb) == n0  # nothing created, host or device
+        assert 999 not in emb.hot._slot_of
+        np.testing.assert_array_equal(got[0], trained[0])
+        np.testing.assert_array_equal(got[1], trained[3])
+        np.testing.assert_array_equal(got[2:], np.zeros((2, DIM)))
+        emb.close()
+
+    def test_insert_missing_false_reads_host_resident_rows(self):
+        host = _host()
+        emb = _emb(host=host, lr=1.0)
+        # rows that exist ONLY host-side (never promoted)
+        host.gather(np.arange(10, 15, dtype=np.int64))
+        got = np.asarray(
+            emb.gather(np.arange(10, 15, dtype=np.int64),
+                       insert_missing=False)
+        )
+        np.testing.assert_array_equal(
+            got,
+            host.gather(np.arange(10, 15, dtype=np.int64),
+                        insert_missing=False),
+        )
+        assert 10 not in emb.hot._slot_of  # no device promotion
+        emb.close()

@@ -126,3 +126,193 @@ class TestIncrementalCkpt:
             emb2.gather(np.arange(10), insert_missing=False),
             emb.gather(np.arange(10), insert_missing=False),
         )
+
+
+class TestCkptIntegrity:
+    """crc-verified chains with rollback + the chunked delta stager
+    (ISSUE 12: a torn embedding export must never restore silently)."""
+
+    def _chain(self, tmp_path, steps=3):
+        emb = ShardedKvEmbedding(2, DIM, seed=0)
+        mgr = IncrementalCheckpointManager(
+            emb, str(tmp_path), full_every=10
+        )
+        emb.gather(np.arange(100))
+        mgr.save(step=1)  # full
+        for s in range(2, steps + 1):
+            _touch(emb, list(range(10 * s, 10 * s + 5)))
+            mgr.save(step=s)  # deltas
+        return emb, mgr
+
+    def test_manifest_carries_crc_and_nbytes(self, tmp_path):
+        _, mgr = self._chain(tmp_path)
+        for e in mgr._read_manifest():
+            assert e["crc32"] and e["nbytes"] > 0
+            p = tmp_path / e["file"]
+            import zlib
+
+            blob = p.read_bytes()
+            assert len(blob) == e["nbytes"]
+            assert zlib.crc32(blob) == e["crc32"]
+
+    def test_corrupt_delta_truncates_chain_to_good_prefix(self, tmp_path):
+        emb, mgr = self._chain(tmp_path, steps=3)
+        entries = mgr._read_manifest()
+        bad = tmp_path / entries[-1]["file"]  # the step-3 delta
+        bad.write_bytes(bad.read_bytes()[:-30])
+        emb2 = ShardedKvEmbedding(2, DIM, seed=5)
+        mgr2 = IncrementalCheckpointManager(emb2, str(tmp_path))
+        assert mgr2.restore() == 2  # rolled back one delta
+        assert (tmp_path / (entries[-1]["file"] + ".corrupt")).exists()
+        # the quarantined file is out of the manifest
+        names = [e["file"] for e in mgr2._read_manifest()]
+        assert entries[-1]["file"] not in names
+
+    def test_corrupt_full_falls_back_to_previous_chain(self, tmp_path):
+        emb = ShardedKvEmbedding(2, DIM, seed=0)
+        mgr = IncrementalCheckpointManager(
+            emb, str(tmp_path), full_every=1, keep_history=2
+        )
+        emb.gather(np.arange(50))
+        mgr.save(step=1)  # full chain 1
+        _touch(emb, [1, 2])
+        mgr.save(step=2)  # full chain 2
+        entries = mgr._read_manifest()
+        newest_full = tmp_path / entries[-1]["file"]
+        newest_full.write_bytes(b"x" * 100)
+        emb2 = ShardedKvEmbedding(2, DIM, seed=9)
+        mgr2 = IncrementalCheckpointManager(emb2, str(tmp_path))
+        assert mgr2.restore() == 1
+
+    def test_all_chains_corrupt_restores_none(self, tmp_path):
+        _, mgr = self._chain(tmp_path, steps=1)
+        for e in mgr._read_manifest():
+            (tmp_path / e["file"]).write_bytes(b"junk")
+        emb2 = ShardedKvEmbedding(2, DIM, seed=1)
+        assert IncrementalCheckpointManager(
+            emb2, str(tmp_path)
+        ).restore() is None
+
+    def test_fault_site_chaos_matrix(self, tmp_path):
+        """Every data fault kind at embedding.export ends in detection
+        + rollback, never a silent restore of corrupt rows."""
+        from dlrover_tpu.common import faults
+
+        for kind in ("torn_write", "bit_flip"):
+            d = tmp_path / kind
+            emb = ShardedKvEmbedding(2, DIM, seed=0)
+            mgr = IncrementalCheckpointManager(emb, str(d))
+            emb.gather(np.arange(60))
+            mgr.save(step=1)  # clean full
+            good = emb.gather(
+                np.arange(60), insert_missing=False
+            ).copy()
+            faults.reset()
+            try:
+                faults.configure(f"embedding.export:{kind}:1.0:7")
+                _touch(emb, [5])
+                mgr.save(step=2)  # corrupted delta
+                assert faults.triggered_total() > 0
+            finally:
+                faults.reset()
+            emb2 = ShardedKvEmbedding(2, DIM, seed=4)
+            mgr2 = IncrementalCheckpointManager(emb2, str(d))
+            assert mgr2.restore() == 1
+            np.testing.assert_array_equal(
+                emb2.gather(np.arange(60), insert_missing=False), good
+            )
+
+
+class TestChunkedDeltaStager:
+    def test_advance_is_budgeted_and_crc_matches(self, tmp_path):
+        import zlib
+
+        emb = ShardedKvEmbedding(2, DIM, seed=0)
+        mgr = IncrementalCheckpointManager(emb, str(tmp_path))
+        emb.gather(np.arange(2000))
+        st = mgr.begin_chunked_save(step=1, chunk_bytes=8 << 10)
+        assert st.total_bytes > 8 << 10
+        first = st.advance(budget_s=0.0)  # one chunk, bounded overshoot
+        assert 0 < first <= (8 << 10)
+        assert st.backlog_bytes == st.total_bytes - first
+        path = st.commit()
+        entry = mgr._read_manifest()[-1]
+        blob = open(path, "rb").read()
+        # incremental fold == whole-blob crc, and the file matches it
+        assert zlib.crc32(blob) == entry["crc32"]
+        assert st.chunks_written >= 2
+
+    def test_snapshot_is_point_in_time(self, tmp_path):
+        """Mutations after begin_chunked_save must not leak into the
+        staged checkpoint (the consistency a mid-drain step relies on)."""
+        emb = ShardedKvEmbedding(2, DIM, seed=0)
+        mgr = IncrementalCheckpointManager(emb, str(tmp_path))
+        emb.gather(np.arange(100))
+        snap = emb.gather(np.arange(100), insert_missing=False).copy()
+        st = mgr.begin_chunked_save(step=1)
+        _touch(emb, list(range(100)))  # mutate mid-drain
+        st.commit()
+        emb2 = ShardedKvEmbedding(2, DIM, seed=3)
+        mgr2 = IncrementalCheckpointManager(emb2, str(tmp_path))
+        assert mgr2.restore() == 1
+        np.testing.assert_array_equal(
+            emb2.gather(np.arange(100), insert_missing=False), snap
+        )
+
+    def test_abort_leaves_previous_chain_and_next_delta_complete(
+        self, tmp_path
+    ):
+        emb = ShardedKvEmbedding(2, DIM, seed=0)
+        mgr = IncrementalCheckpointManager(emb, str(tmp_path))
+        emb.gather(np.arange(50))
+        mgr.save(step=1)
+        _touch(emb, [7])
+        st = mgr.begin_chunked_save(step=2)
+        st.advance(budget_s=0.0)
+        st.abort()
+        assert not any(
+            ".staging" in f for f in os.listdir(tmp_path)
+        )
+        # the aborted rows were NOT swallowed: the next delta carries
+        # the step-2 mutation
+        path = mgr.save(step=3)
+        data = dict(np.load(path))
+        assert 7 in set(int(k) for k in data["keys"])
+        emb2 = ShardedKvEmbedding(2, DIM, seed=8)
+        mgr2 = IncrementalCheckpointManager(emb2, str(tmp_path))
+        assert mgr2.restore() == 3
+        np.testing.assert_array_equal(
+            emb2.gather(np.arange(50), insert_missing=False),
+            emb.gather(np.arange(50), insert_missing=False),
+        )
+
+    def test_crash_mid_drain_previous_chain_restorable(self, tmp_path):
+        emb = ShardedKvEmbedding(2, DIM, seed=0)
+        mgr = IncrementalCheckpointManager(emb, str(tmp_path))
+        emb.gather(np.arange(50))
+        mgr.save(step=1)
+        _touch(emb, [3])
+        st = mgr.begin_chunked_save(step=2)
+        st.advance(budget_s=0.0)
+        # no commit: simulate the process dying mid-drain. The staging
+        # temp is invisible to restore.
+        emb2 = ShardedKvEmbedding(2, DIM, seed=6)
+        mgr2 = IncrementalCheckpointManager(emb2, str(tmp_path))
+        assert mgr2.restore() == 1
+
+    def test_second_inflight_stager_rejected(self, tmp_path):
+        """Two live stagers would target the SAME file index (it only
+        advances at publish) — the second begin must refuse instead of
+        letting both publish entries for one clobbered file."""
+        import pytest
+
+        emb = ShardedKvEmbedding(2, DIM, seed=0)
+        mgr = IncrementalCheckpointManager(emb, str(tmp_path))
+        emb.gather(np.arange(20))
+        st = mgr.begin_chunked_save(step=1)
+        with pytest.raises(RuntimeError, match="already in flight"):
+            mgr.begin_chunked_save(step=2)
+        st.commit()
+        st2 = mgr.begin_chunked_save(step=2)  # fine after publish
+        st2.abort()
+        mgr.begin_chunked_save(step=3).commit()  # and after abort

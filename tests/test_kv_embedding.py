@@ -6,6 +6,7 @@ import-export against the C++ kernels; here the same contracts are
 driven through the ctypes binding.
 """
 
+import os
 import threading
 
 import numpy as np
@@ -450,3 +451,114 @@ class TestSparseTraining:
             w = w - 0.5 * gw
             emb.sparse_adagrad(batch_ids, np.asarray(grows), lr=0.5)
         assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+DIM = 8
+
+
+class TestWarmReshard:
+    """Move-only elastic resharding (ISSUE 12): only rows whose route
+    changes leave their shard, values/slots/metadata survive exactly."""
+
+    def _trained(self, shards=4, rows=800, dim=16):
+        emb = ShardedKvEmbedding(shards, dim, num_slots=1, seed=3)
+        ids = np.arange(rows, dtype=np.int64)
+        emb.gather(ids)
+        emb.sparse_adagrad(
+            ids, np.full((rows, dim), 0.2, np.float32), lr=0.3
+        )
+        return emb, ids
+
+    def test_values_and_slots_survive_grow_and_shrink(self):
+        emb, ids = self._trained()
+        rows0, _, _, _ = emb.export_rows(ids)
+        rep = emb.warm_reshard(6)
+        assert emb.num_shards == 6 and len(emb) == len(ids)
+        rows1, _, _, present = emb.export_rows(ids)
+        assert present.all()
+        np.testing.assert_array_equal(rows0, rows1)
+        rep2 = emb.warm_reshard(3)
+        assert emb.num_shards == 3 and len(emb) == len(ids)
+        rows2, _, _, _ = emb.export_rows(ids)
+        np.testing.assert_array_equal(rows0, rows2)
+        assert rep.moved_rows > 0 and rep2.moved_rows > 0
+
+    def test_moves_strictly_fewer_rows_than_full(self):
+        emb, ids = self._trained()
+        rep = emb.warm_reshard(6)
+        # the cold path moves EVERY row; warm must move a strict subset
+        assert 0 < rep.moved_rows < rep.total_rows
+        assert 0.0 < rep.moved_fraction < 1.0
+
+    def test_routing_invariant_after_warm(self):
+        """Every row sits in the shard the router says it belongs to —
+        a misplaced row would be invisible to routed gathers."""
+        emb, ids = self._trained()
+        emb.warm_reshard(5)
+        route = emb._route(ids)
+        for sid, shard in enumerate(emb.shards):
+            keys = np.sort(shard.export_keys())
+            expect = np.sort(ids[route == sid])
+            np.testing.assert_array_equal(keys, expect)
+
+    def test_noop_and_version_bump(self):
+        class _V:
+            def __init__(self):
+                self.v = 0
+
+            def inc_global_version(self):
+                self.v += 1
+
+        vs = _V()
+        emb = ShardedKvEmbedding(2, DIM, seed=0, version_service=vs)
+        emb.gather(np.arange(10))
+        rep = emb.warm_reshard(2)
+        assert rep.moved_rows == 0 and vs.v == 0  # same count: no-op
+        emb.warm_reshard(3)
+        assert vs.v == 1
+
+    def test_export_rows_is_a_pure_state_read(self):
+        emb = ShardedKvEmbedding(2, DIM, seed=0)
+        ids = np.arange(5, dtype=np.int64)
+        emb.gather(ids)
+        f0, _ = emb.meta(ids)
+        emb.export_rows(ids)
+        f1, _ = emb.meta(ids)
+        np.testing.assert_array_equal(f0, f1)  # no freq bump
+        # absent keys are not created
+        _, _, _, present = emb.export_rows(np.array([999], np.int64))
+        assert not present.any()
+        assert len(emb) == 5
+
+    def test_delete_keys(self):
+        emb = ShardedKvEmbedding(3, DIM, seed=0)
+        ids = np.arange(30, dtype=np.int64)
+        emb.gather(ids)
+        assert emb.delete_keys(ids[:10]) == 10
+        assert emb.delete_keys(ids[:10]) == 0  # already gone
+        assert len(emb) == 20
+
+
+class TestBuildCacheFallback:
+    def test_unwritable_cache_dir_falls_back_to_tmpdir(
+        self, tmp_path, monkeypatch
+    ):
+        """An unwritable DLROVER_TPU_KV_CACHE must not crash the import
+        path — the build lands in a process-stable tmpdir instead
+        (satellite: the PR-6 topology-cache read-only-fs tolerance).
+        chmod is useless under root, so the unwritable dir is modeled
+        as a cache path occupied by a plain file (same OSError class a
+        read-only filesystem raises)."""
+        import dlrover_tpu.ops.embedding.store as store_mod
+
+        ro = tmp_path / "not_a_dir"
+        ro.write_text("occupied")
+        monkeypatch.setenv("DLROVER_TPU_KV_CACHE", str(ro))
+        monkeypatch.setattr(store_mod, "_FALLBACK_BUILD_DIR", None)
+        path = store_mod._build_library()
+        assert os.path.exists(path)
+        assert not path.startswith(str(ro))
+        # second call reuses the SAME fallback dir (and the cached .so
+        # in it — one compile per process, not per call)
+        path2 = store_mod._build_library()
+        assert path2 == path

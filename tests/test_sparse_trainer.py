@@ -120,3 +120,264 @@ class TestSparseTrainer:
         np.testing.assert_array_equal(
             emb.gather(ids[:10], insert_missing=False), saved
         )
+
+
+def _device_trainer(ckpt_dir="", capacity=128, lr=0.5, client=None, **kw):
+    from dlrover_tpu.ops.embedding.device_tier import DeviceSparseEmbedding
+
+    host = ShardedKvEmbedding(2, DIM, num_slots=1, seed=0)
+    emb = DeviceSparseEmbedding(
+        host, capacity=capacity, sparse_optimizer="adagrad", lr=lr
+    )
+    t = SparseTrainer(
+        emb, jnp.zeros((DIM,)), _dense_step_factory(),
+        ckpt_dir=str(ckpt_dir), master_client=client, **kw,
+    )
+    return t, host, emb
+
+
+def _stream(n, bs=64, vocab=40, seed=7):
+    for s in range(n):
+        r = np.random.default_rng(seed * 1000 + s)
+        ids = r.integers(0, vocab, bs).astype(np.int64)
+        yield ids, (ids % 2).astype(np.float32)
+
+
+class TestDeviceModeTrainer:
+    def test_device_cycle_learns_parity(self):
+        t, _, emb = _device_trainer()
+        losses = [
+            m["loss"] for m in t.run(_stream(25), overlapped=True)
+        ]
+        assert losses[-1] < losses[0] * 0.6, losses[::8]
+        emb.close()
+
+    def test_sync_and_overlapped_device_runs_are_bitwise(self):
+        """The pipeline only changes WHEN rows are faulted in, never
+        the math: the overlapped run must reproduce the inline run's
+        losses bitwise."""
+        ta, _, ea = _device_trainer()
+        la = [m["loss"] for m in ta.run(_stream(12), overlapped=False)]
+        ea.close()
+        tb, _, eb = _device_trainer()
+        lb = [m["loss"] for m in tb.run(_stream(12), overlapped=True)]
+        eb.close()
+        assert la == lb
+
+    def test_chunked_delta_resume_is_bitwise(self, tmp_path):
+        from dlrover_tpu.ops.embedding import IncrementalCheckpointManager
+
+        ta, ha, ea = _device_trainer()
+        mgr = IncrementalCheckpointManager(ha, str(tmp_path), full_every=4)
+        ta.run(_stream(3), overlapped=False)
+        ea.flush()
+        mgr.save(step=3)  # full
+        ta.run((x for i, x in enumerate(_stream(5)) if i >= 3),
+               overlapped=False)
+        ea.flush()
+        stager = mgr.begin_chunked_save(step=5, chunk_bytes=4 << 10)
+        dense_at_5 = np.asarray(ta.dense_params)
+        tail_a = []
+        for i, (ids, y) in enumerate(_stream(9)):
+            if i < 5:
+                continue
+            stager.advance(budget_s=0.001)
+            tail_a.append(ta.train_step_device(ids, y)["loss"])
+        stager.commit()
+        ea.close()
+
+        tb, hb, eb = _device_trainer()
+        mgr_b = IncrementalCheckpointManager(hb, str(tmp_path))
+        assert mgr_b.restore() == 5
+        tb.step = 5
+        tb.dense_params = jnp.asarray(dense_at_5)
+        tail_b = []
+        for i, (ids, y) in enumerate(_stream(9)):
+            if i < 5:
+                continue
+            tail_b.append(tb.train_step_device(ids, y)["loss"])
+        eb.close()
+        assert tail_a == tail_b  # bitwise loss continuity
+
+    def test_telemetry_rides_train_metrics_report(self):
+        class _Client:
+            def __init__(self):
+                self.reports = []
+
+            def get_cluster_version(self, version_type="global"):
+                return 0
+
+            def report_train_metrics(self, step, metrics):
+                self.reports.append((step, metrics))
+
+        c = _Client()
+        t, _, emb = _device_trainer(client=c)
+        t.run(_stream(3), overlapped=False)
+        scalars = t.report_telemetry()
+        assert scalars["sparse_step"] == 3.0
+        assert "emb_gather_hit_pct" in scalars
+        assert c.reports and c.reports[-1][0] == 3
+        assert "emb_host_leg_ms" in c.reports[-1][1]
+        emb.close()
+
+
+class TestFailoverHardening:
+    class _Client:
+        def __init__(self):
+            self.version = 0
+            self.fail = False
+
+        def get_cluster_version(self, version_type="global"):
+            if self.fail:
+                raise ConnectionError("master unreachable")
+            return self.version
+
+    def test_poll_failure_degrades_to_no_change(self, tmp_path):
+        c = self._Client()
+        t, _, emb = _device_trainer(ckpt_dir=tmp_path, client=c)
+        c.fail = True
+        assert t.check_failover() is False  # no crash, no refresh
+        c.fail = False
+        assert t.check_failover() is False  # version unchanged
+        emb.close()
+
+    def test_poll_failure_at_init_raises(self):
+        c = self._Client()
+        c.fail = True
+        with pytest.raises(ConnectionError):
+            _device_trainer(client=c)
+
+    def test_version_bump_warm_reshards_and_books_ledger(self, tmp_path):
+        from dlrover_tpu.obs.goodput import (
+            GoodputLedger,
+            install_default_ledger,
+        )
+
+        ledger = install_default_ledger(GoodputLedger())
+        try:
+            c = self._Client()
+            t, host, emb = _device_trainer(
+                ckpt_dir=tmp_path, client=c,
+                target_shards_fn=lambda: 3,
+            )
+            t.run(_stream(3), overlapped=False)
+            c.version = 1
+            assert t.check_failover() is True
+            assert host.num_shards == 3
+            rep = ledger.snapshot()
+            assert rep.seconds["restart_replay"] > 0
+            emb.close()
+        finally:
+            install_default_ledger(GoodputLedger())
+
+    def test_version_bump_reimports_and_books_ledger(self, tmp_path):
+        from dlrover_tpu.obs.goodput import (
+            GoodputLedger,
+            install_default_ledger,
+        )
+
+        ledger = install_default_ledger(GoodputLedger())
+        try:
+            c = self._Client()
+            t, host, emb = _device_trainer(ckpt_dir=tmp_path, client=c)
+            t.run(_stream(4), overlapped=False)
+            t.save_embedding()
+            saved = np.asarray(emb.gather(np.arange(10))).copy()
+            t.run((x for i, x in enumerate(_stream(6)) if i >= 4),
+                  overlapped=False)
+            c.version = 1
+            assert t.check_failover() is True  # no target: re-import
+            np.testing.assert_array_equal(
+                np.asarray(emb.gather(np.arange(10))), saved
+            )
+            assert ledger.snapshot().seconds["restart_replay"] > 0
+            emb.close()
+        finally:
+            install_default_ledger(GoodputLedger())
+
+
+class TestCheckpointIntegrity:
+    def test_corrupt_newest_rolls_back_to_previous(self, tmp_path):
+        import os
+
+        t, _, emb = _device_trainer(ckpt_dir=tmp_path)
+        t.run(_stream(5), overlapped=False)
+        t.save_embedding()
+        vals = np.asarray(emb.gather(np.arange(10))).copy()
+        dense5 = np.asarray(t.dense_params).copy()
+        t.run((x for i, x in enumerate(_stream(8)) if i >= 5),
+              overlapped=False)
+        t.save_embedding()  # rotates the first save to .prev
+        p = str(tmp_path / "embedding_state.npz")
+        blob = open(p, "rb").read()
+        open(p, "wb").write(blob[: len(blob) // 2])  # torn write
+
+        t2, _, emb2 = _device_trainer(ckpt_dir=tmp_path)
+        assert t2.restore_embedding()
+        assert t2.step == 5  # the previous good save
+        np.testing.assert_array_equal(
+            np.asarray(emb2.gather(np.arange(10))), vals
+        )
+        np.testing.assert_array_equal(np.asarray(t2.dense_params), dense5)
+        assert os.path.exists(p + ".corrupt")
+        emb.close()
+        emb2.close()
+
+    def test_both_corrupt_restores_nothing(self, tmp_path):
+        t, _, emb = _device_trainer(ckpt_dir=tmp_path)
+        t.run(_stream(2), overlapped=False)
+        t.save_embedding()
+        t.save_embedding()
+        for name in ("embedding_state.npz", "embedding_state.prev.npz"):
+            p = str(tmp_path / name)
+            open(p, "wb").write(b"garbage")
+        t2, _, emb2 = _device_trainer(ckpt_dir=tmp_path)
+        assert t2.restore_embedding() is False
+        emb.close()
+        emb2.close()
+
+    @pytest.mark.parametrize("kind", ["torn_write", "bit_flip"])
+    def test_export_fault_detected_and_rolled_back(self, tmp_path, kind):
+        """Chaos matrix for the embedding.export site: a corrupted
+        export must be detected at restore and roll back to the
+        previous good file — never restore silently."""
+        from dlrover_tpu.common import faults
+
+        t, _, emb = _device_trainer(ckpt_dir=tmp_path)
+        t.run(_stream(4), overlapped=False)
+        t.save_embedding()  # good
+        vals = np.asarray(emb.gather(np.arange(10))).copy()
+        faults.reset()
+        try:
+            faults.configure(f"embedding.export:{kind}:1.0:3")
+            t.run((x for i, x in enumerate(_stream(6)) if i >= 4),
+                  overlapped=False)
+            t.save_embedding()  # corrupted in flight
+            assert faults.triggered_total() > 0
+        finally:
+            faults.reset()
+        t2, _, emb2 = _device_trainer(ckpt_dir=tmp_path)
+        assert t2.restore_embedding()
+        assert t2.step == 4  # rolled back
+        np.testing.assert_array_equal(
+            np.asarray(emb2.gather(np.arange(10))), vals
+        )
+        emb.close()
+        emb2.close()
+
+    def test_import_fault_site_fires(self, tmp_path):
+        from dlrover_tpu.common import faults
+
+        t, _, emb = _device_trainer(ckpt_dir=tmp_path)
+        t.run(_stream(2), overlapped=False)
+        t.save_embedding()
+        faults.reset()
+        try:
+            faults.configure("embedding.import:io_error:1.0")
+            t2, _, emb2 = _device_trainer(ckpt_dir=tmp_path)
+            with pytest.raises(OSError):
+                t2.restore_embedding()
+            emb2.close()
+        finally:
+            faults.reset()
+        emb.close()

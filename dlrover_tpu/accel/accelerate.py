@@ -217,6 +217,7 @@ def auto_accelerate(
             grad_compress=strategy.resolved_grad_compress(),
             grad_bucket_mb=strategy.grad_bucket_mb,
             grad_slices=strategy.mesh.dp_slices(),
+            batch_pad=strategy.batch_pad,
         )
     return AccelerateResult(
         strategy=strategy,

@@ -130,6 +130,11 @@ def _build(
             # the resolved value: one source of truth with the state
             # layout below ([pp, v, lc] iff virtual > 1)
             virtual_stages=virtual,
+            # the explicit per-stage sync (pp x dp meshes) — same
+            # resolved accessors the non-pipeline branch uses
+            comm_overlap=strategy.resolved_comm_overlap(),
+            grad_bucket_mb=strategy.grad_bucket_mb,
+            grad_slices=strategy.mesh.dp_slices(),
         )
         shardings = pipeline_state_shardings(cfg, mesh, tx, virtual=virtual)
 
@@ -169,6 +174,7 @@ def _build(
             grad_compress=strategy.grad_compress,
             grad_bucket_mb=strategy.grad_bucket_mb,
             grad_slices=strategy.mesh.dp_slices(),
+            batch_pad=strategy.batch_pad,
         )
 
         def init_fn(key):
@@ -183,6 +189,10 @@ def _build(
             x = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(
                 np.int32
             )
+            if strategy.batch_pad:
+                from dlrover_tpu.models.train import pad_batch_rows
+
+                x = pad_batch_rows(x, batch + strategy.batch_pad)
             b = shard_batch({"x": x, "y": x}, mesh)
             return b["x"], b["y"]
 
@@ -275,16 +285,50 @@ def _comm_estimate(
 
     s = report.strategy
     m = s.mesh
-    if m.dp * m.fsdp <= 1:
-        return
     p_bytes = 2 if cfg.param_dtype in ("bfloat16", "float16") else 4
     prof = profile_model(cfg, batch, seq)
     param_bytes = prof.total_params * p_bytes
+
+    # MoE all-to-all term (mesh-matrix leg, ISSUE 13): both schedules
+    # run the dispatch/combine all-to-alls on the critical path — 2
+    # forward + 2 backward per MoE layer per step — so the term is
+    # common, but pricing it through the LinkModel keeps ep candidates
+    # link-sensitive (the PR-6 model-sensitivity property)
+    if cfg.num_experts and m.ep > 1:
+        from dlrover_tpu.parallel import topology
+
+        act_bytes = 2 if cfg.dtype in ("bfloat16", "float16") else 4
+        tokens_loc = batch * seq / max(m.dp * m.fsdp, 1)
+        # each device ships its routed buckets: ~capacity_factor x
+        # top_k x its tokens x model_dim, (ep-1)/ep of it crossing
+        a2a_payload = (
+            cfg.capacity_factor
+            * max(cfg.moe_top_k, 1)
+            * tokens_loc
+            * cfg.model_dim
+            * act_bytes
+        )
+        from dlrover_tpu.models.config import num_moe_layers
+
+        n_moe = num_moe_layers(cfg)
+        a2a_s = topology.alltoall_time_s(
+            int(a2a_payload), m.ep, dcn="ep" in m.dcn_axes
+        )
+        report.comm_exposed_s += 4.0 * n_moe * a2a_s * max(
+            s.grad_accum, 1
+        )
+
+    if m.dp * m.fsdp <= 1:
+        return
     # the shared mesh gate — this cost model must engage the explicit
-    # path for exactly the meshes the step builder does
+    # path for exactly the meshes the step builder does (including the
+    # ep+grad_accum exclusion: that step runs GSPMD, K syncs)
+    mode = resolve_sync_mode(m.axis_sizes())
     explicit = (
-        resolve_sync_mode(m.axis_sizes()) is not None
-    ) and s.resolved_comm_overlap()
+        mode is not None
+        and s.resolved_comm_overlap()
+        and not (mode.kind == "ep" and s.grad_accum > 1)
+    )
     if explicit:
         one_sync = comm_bytes_per_device(
             param_bytes, s, grad_itemsize=p_bytes
@@ -293,6 +337,23 @@ def _comm_estimate(
             param_bytes, s, grad_itemsize=p_bytes
         )
         syncs = 1
+        if mode.kind == "pp":
+            # per-stage sync scheduled INTO the pipeline bubble: the
+            # drain's idle slots absorb the wire time, so only the
+            # spill past the bubble is exposed (not added to step
+            # time) — the fallback's post-drain monolithic all-reduce
+            # is fully exposed by contrast
+            M = max(s.num_microbatches, 1)
+            v = s.resolved_virtual()
+            bubble_frac = (m.pp - 1) / float(M * v + m.pp - 1)
+            compute_s = max(
+                report.flops_per_device * _SEC_PER_FLOP,
+                report.bytes_per_device * _SEC_PER_BYTE,
+            )
+            bubble_s = compute_s * bubble_frac
+            report.comm_bytes_per_device += one_sync
+            report.comm_exposed_s += max(0.0, one_sync_s - bubble_s)
+            return
         exposed_frac = 1.0 - OVERLAP_HIDDEN_FRACTION
     else:
         # the GSPMD default schedule: full-precision, per-microbatch.
@@ -307,8 +368,8 @@ def _comm_estimate(
         )
         syncs = max(s.grad_accum, 1)
         exposed_frac = 1.0
-    report.comm_bytes_per_device = one_sync * syncs
-    report.comm_exposed_s = one_sync_s * syncs * exposed_frac
+    report.comm_bytes_per_device += one_sync * syncs
+    report.comm_exposed_s += one_sync_s * syncs * exposed_frac
 
 
 def _finalize_estimate(
@@ -350,6 +411,67 @@ def _finalize_estimate(
         )
         + report.comm_exposed_s
     )
+
+
+def price_rebalance_options(
+    cfg: TransformerConfig,
+    batch: int,
+    seq: int,
+    idle_strategy: Strategy,
+    rebalanced_strategy: Strategy,
+    measured_step_s: Optional[float] = None,
+    current_strategy: Optional[Strategy] = None,
+) -> Tuple[float, float]:
+    """(idle_est_s, rebalanced_est_s): the dry-runner's analytic
+    roofline of one step under (a) the degraded mesh that idles
+    surplus ranks and (b) the padded micro-batch rebalance that uses
+    every rank (``Strategy.batch_pad``). Per-device compute scales
+    with rows-per-rank — the rebalance wins exactly when its ceil-pad
+    waste is smaller than the idle path's lost ranks — and the
+    gradient sync is priced per link (``comm_time_per_device_s``).
+    Pure-Python (no compiles): cheap enough for ``_strategy_for`` to
+    consult inside a resize window.
+
+    ``measured_step_s`` (+ ``current_strategy``): self-calibration,
+    the same trick ``dry_run`` plays with its timed finalists — the
+    static weights assume TPU-class peaks, so on any other backend
+    (CPU smoke meshes) the per-row compute term can price BELOW the
+    ring-latency constant and invert the ranking; rescaling the row
+    term so the current world's estimate reproduces the trainer's
+    MEASURED step time keeps the comparison in real seconds."""
+    from dlrover_tpu.accel.profiler import profile_model
+    from dlrover_tpu.parallel.grad_sync import comm_time_per_device_s
+
+    p_bytes = 2 if cfg.param_dtype in ("bfloat16", "float16") else 4
+
+    def row_est(s: Strategy) -> float:
+        shards = max(s.mesh.dp * s.mesh.fsdp, 1)
+        rows = (batch + s.batch_pad) // shards
+        prof = profile_model(cfg, max(rows, 1), seq)
+        # only the WORLD-DEPENDENT compute: per-rank row flops +
+        # activation traffic (both scale with rows). The per-device
+        # param/optimizer HBM pass is identical under both options —
+        # folding it in would mask a 3-vs-4-rows difference behind a
+        # term that cannot change.
+        return (
+            prof.step_flops * _SEC_PER_FLOP
+            + 2.0 * prof.activation_bytes * _SEC_PER_BYTE
+        )
+
+    calib = 1.0
+    if measured_step_s and current_strategy is not None:
+        cur = row_est(current_strategy)
+        if cur > 0:
+            calib = max(1.0, measured_step_s / cur)
+
+    def est(s: Strategy) -> float:
+        prof = profile_model(cfg, 1, seq)
+        p_total = prof.total_params * p_bytes
+        return row_est(s) * calib + comm_time_per_device_s(
+            p_total, s, grad_itemsize=p_bytes
+        )
+
+    return est(idle_strategy), est(rebalanced_strategy)
 
 
 def compiled_cost(

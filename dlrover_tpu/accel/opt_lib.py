@@ -113,10 +113,14 @@ register_optimization(
 # bucketed dp sync under the GSPMD tp submesh on dp x tp/sp — XLA
 # gets independent collectives it can overlap with backward compute,
 # and grad_accum syncs once per optimizer step instead of per
-# microbatch. Tunable: auto_accelerate's candidate stamping may apply
-# it across the whole candidate list; non-qualifying meshes (pp/ep/3D)
-# fall back to the GSPMD default schedule inside build_train_step with
-# a once-per-mesh log.
+# microbatch. ISSUE 13 finished the mesh matrix: pp x dp (per-stage
+# sync into the pipeline bubble), dp x ep (fully-manual region with
+# the MoE all-to-alls) and 3D dp x fsdp x tp all take the explicit
+# path too. Tunable: auto_accelerate's candidate stamping may apply
+# it across the whole candidate list; the remaining exotica (pp/ep
+# composed with other model axes) fall back to the GSPMD default
+# schedule inside the step builders with a once-per-mesh log naming
+# the axes.
 register_optimization(
     "comm_overlap",
     lambda cfg, s: (cfg, dc_replace(s, comm_overlap=True)),

@@ -21,7 +21,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from dlrover_tpu.models.config import TransformerConfig
+from dlrover_tpu.models.config import TransformerConfig, is_moe_layer
 
 # bf16 peak TFLOP/s per chip (public specs); used for MFU
 PEAK_TFLOPS = {
@@ -76,6 +76,16 @@ class PipelineStats:
     # non-divisible device count picks the largest valid mesh <= n
     # instead of failing; also dlrover_resize_idle_ranks gauge)
     resize_idle_ranks: int = 0
+    # padded rows per step of the micro-batch rebalance alternative
+    # (ISSUE 13): instead of idling surplus ranks, the batch is padded
+    # to divide over ALL ranks and the pads carry loss weight 0 — 0
+    # when the current strategy is unpadded. resize_idle_ranks stays 0
+    # on the rebalanced path (also dlrover_resize_mb_pad gauge).
+    resize_mb_pad: int = 0
+    # capacity re-splits applied by the MoE rebalancer (trainer
+    # moe_rebalance_interval; each one is a step rebuild through the
+    # AOT cache)
+    moe_capacity_resplits: int = 0
     # -- overlap-scheduled gradient sync (parallel/grad_sync.py) -------
     # which gradient-sync schedule the current mesh runs: "explicit"
     # (the bucketed scheduler engaged) or "gspmd" (fallback — was
@@ -153,6 +163,8 @@ class PipelineStats:
             "resize_count": self.resize_count,
             "resize_downtime_ms": round(self.resize_downtime_ms, 2),
             "resize_idle_ranks": self.resize_idle_ranks,
+            "resize_mb_pad": self.resize_mb_pad,
+            "moe_capacity_resplits": self.moe_capacity_resplits,
             "grad_sync_path": self.grad_sync_path,
             # numeric twin for the metrics registry (fold_pipeline_
             # stats skips strings): 1 = explicit, 0 = gspmd fallback,
@@ -299,7 +311,7 @@ def profile_model(
                 f"block{i}.attn", qkv_params, attn_flops, attn_act
             )
         )
-        if cfg.num_experts and i % cfg.moe_every == cfg.moe_every - 1:
+        if is_moe_layer(cfg, i):
             mlp_params = cfg.num_experts * 2 * d * f + d * cfg.num_experts
             mlp_flops = 2.0 * tok * 2 * d * f  # top-1: same flops as dense
         elif cfg.swiglu:

@@ -39,9 +39,10 @@ class Strategy:
     # explicit overlap-scheduled gradient sync (parallel/grad_sync.py):
     # bucketed collectives under shard_map, one sync per optimizer
     # step under grad_accum. Engages where the mesh qualifies
-    # (resolve_sync_mode: pure-dp, dp x fsdp ZeRO, dp x tp/sp) —
-    # pp/ep/3D meshes fall back to the GSPMD default schedule with a
-    # once-per-mesh log.
+    # (resolve_sync_mode: pure-dp, dp x fsdp ZeRO, dp x tp/sp,
+    # dp x ep, dp x fsdp x tp, pp x dp) — the remaining compositions
+    # fall back to the GSPMD default schedule with a once-per-mesh
+    # log naming the axes.
     comm_overlap: bool = False
     # "none" | "int8": int8-quantized collective payloads with
     # per-bucket shared scales, int32 accumulation and error feedback
@@ -51,6 +52,16 @@ class Strategy:
     # per link from the measured topology.LinkModel (the DCN leg on
     # multi-slice meshes, the ICI ring otherwise)
     grad_bucket_mb: int = 4
+    # micro-batch rebalance (ISSUE 13): rows of zero-weight padding
+    # appended to every global batch so it divides over dp*fsdp on an
+    # otherwise-indivisible worker count — heavier ranks take one
+    # extra micro-batch row instead of surplus ranks idling. The
+    # padded rows carry loss weight 0 (models/train.py
+    # pad_row_weights), so gradients are bitwise those of the real
+    # batch; the dry-runner prices the padded compute against the
+    # idle-ranks alternative and the trainer picks the cheaper
+    # (accel/dry_runner.price_rebalance_options).
+    batch_pad: int = 0
     # named optimization-library entries applied to this strategy
     # (accel/opt_lib.py re-derives the config from these on every host)
     opts: Tuple[str, ...] = ()
@@ -116,6 +127,8 @@ class Strategy:
                 if sched == "interleaved"
                 else sched
             )
+        if self.batch_pad:
+            bits.append(f"mbpad{self.batch_pad}")
         if self.remat or "remat" in self.opts:
             bits.append("remat")
         if self.offload_opt and "offload_opt" not in self.opts:

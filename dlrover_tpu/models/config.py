@@ -32,6 +32,13 @@ class TransformerConfig:
     num_experts: int = 0
     moe_every: int = 2
     capacity_factor: float = 1.25
+    # per-expert capacity re-split ([num_experts] ints, static): ()
+    # keeps the uniform capacity_factor sizing; a non-empty tuple
+    # (parallel/moe.py CapacityRebalancer.splits from measured load)
+    # gives each expert its own cutoff — the bucket dim becomes
+    # max(splits), so hot experts stop overflowing while cold ones
+    # ship padding. Changing it is a recompile (static shapes).
+    capacity_splits: tuple = ()
     # experts per token (1 = Switch, 2 = GShard-style top-2; parity:
     # switch_gating.py:154 covers both) and the router z-loss weight
     # (keeps gate logits small; 0 disables)
@@ -111,6 +118,22 @@ def llama2_7b() -> TransformerConfig:
         rmsnorm=True,
         swiglu=True,
         tie_embeddings=False,
+    )
+
+
+def is_moe_layer(cfg: TransformerConfig, i: int) -> bool:
+    """THE layer-placement rule: block ``i`` carries an expert FFN.
+    Every consumer (init/forward layout, metric normalization, the
+    dry-runner's all-to-all pricing, the analytic profiler) routes
+    through here so the rule cannot drift between them."""
+    return bool(
+        cfg.num_experts and i % cfg.moe_every == cfg.moe_every - 1
+    )
+
+
+def num_moe_layers(cfg: TransformerConfig) -> int:
+    return sum(
+        1 for i in range(cfg.num_layers) if is_moe_layer(cfg, i)
     )
 
 

@@ -163,6 +163,37 @@ def state_spec(
     )
 
 
+def pad_row_weights(n_real: int, n_padded: int):
+    """Loss row-weights for a zero-padded batch (micro-batch
+    rebalance): real rows weigh ``n_padded / n_real`` and pad rows 0,
+    so the plain mean over the padded batch equals the mean over the
+    real rows — and the per-shard mean-of-means the explicit dp sync
+    computes does too (the scale is uniform, so shard means compose
+    exactly)."""
+    import numpy as np
+
+    if not 0 < n_real <= n_padded:
+        raise ValueError(
+            f"need 0 < n_real <= n_padded, got {n_real}/{n_padded}"
+        )
+    w = np.zeros((n_padded,), np.float32)
+    w[:n_real] = n_padded / float(n_real)
+    return w
+
+
+def pad_batch_rows(x, n_padded: int):
+    """Zero-pad a [B, ...] host batch to ``n_padded`` rows (the
+    trainer's collate step for a rebalanced strategy; the matching
+    ``pad_row_weights`` zero the pads out of the loss)."""
+    import numpy as np
+
+    x = np.asarray(x)
+    if x.shape[0] >= n_padded:
+        return x
+    pad = np.zeros((n_padded - x.shape[0],) + x.shape[1:], x.dtype)
+    return np.concatenate([x, pad], axis=0)
+
+
 def _zeros_like_tree(shape_tree):
     return jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), shape_tree
@@ -201,8 +232,11 @@ def _grad_sync_plan(
     """BucketPlan for the explicit sync path, or None when this mesh
     keeps GSPMD's native schedule — the gate lives in ONE place
     (``grad_sync.plan_for_mesh``, shared with the Strategy-level
-    ``resolve_plan`` the trainer/cost model consult). pp/ep and 3D
-    dp x fsdp x tp meshes fall back with a once-per-mesh log
+    ``resolve_plan`` the trainer/cost model consult). dp x ep meshes
+    get an ``EPSyncPlan`` (the fully-manual all-to-all region), 3D
+    dp x fsdp x tp a tp-local ``BucketPlan``; pp meshes plan through
+    the pipeline builder instead. The remaining compositions fall
+    back with a once-per-mesh log naming the axes
     (``note_gspmd_fallback``): the strategy search stamps the opt
     names onto every candidate and such a candidate must still
     build."""
@@ -237,6 +271,7 @@ def build_train_step(
     grad_compress: str = "none",
     grad_bucket_mb: int = 4,
     grad_slices: int = 1,
+    batch_pad: int = 0,
 ) -> Callable:
     """jitted (state, tokens, targets) → (state, metrics).
 
@@ -271,8 +306,13 @@ def build_train_step(
     the final microbatch syncs (wire traffic cut K×), and optionally
     int8-quantized wire payloads with error feedback when the state
     carries a residual (``grad_sync.ensure_residual``; dp/fsdp plans
-    only). pp/ep and 3D meshes fall back to the GSPMD default
-    schedule with a once-per-mesh log."""
+    only). dp x ep meshes sync inside one fully-manual (dp, ep)
+    region with the MoE all-to-alls; 3D dp x fsdp x tp composes the
+    ZeRO and tp legs; only the remaining exotica (pp/ep composed with
+    other model axes) fall back to the GSPMD default schedule, with a
+    once-per-mesh log naming the axes. ``batch_pad`` is the
+    micro-batch rebalance (zero-weight pad rows; see
+    ``pad_row_weights``)."""
     opt_sh = None
     if offload_opt_state:
         # the MIXED tree from offload_shardings: host-kind tensors,
@@ -299,6 +339,22 @@ def build_train_step(
         if (comm_overlap or grad_compress == "int8")
         else None
     )
+    if (
+        plan is not None
+        and getattr(plan, "kind", "") == "ep"
+        and grad_accum > 1
+    ):
+        # the ep path syncs inside its one fully-manual region; a
+        # grad-accum scan around it would sync every microbatch —
+        # keep GSPMD's schedule instead of silently paying K syncs
+        from dlrover_tpu.parallel.grad_sync import note_gspmd_fallback
+
+        note_gspmd_fallback(
+            dict(zip(mesh.axis_names, mesh.devices.shape)),
+            reason=f"ep explicit sync with grad_accum={grad_accum}: "
+            f"the manual region syncs per call",
+        )
+        plan = None
     # synced grads are pinned to the params' canonical shardings:
     # sync_grads hands back bucket slices whose GSPMD layout is the
     # flat bucket's (fsdp chunks / whatever auto-tp propagation
@@ -306,10 +362,33 @@ def build_train_step(
     # drift off the layout the AOT executable was compiled with
     grad_sh = param_shardings(cfg, mesh, rules) if plan is not None else None
 
+    if batch_pad and grad_accum > 1:
+        raise ValueError(
+            "batch_pad (micro-batch rebalance) requires grad_accum=1"
+        )
+    if batch_pad and cfg.num_experts:
+        # the router's balance/z aux losses are computed over ALL
+        # tokens — pad rows would shift them (and the capacity sizing)
+        # even at loss weight 0, breaking the "gradients are those of
+        # the real batch" contract; MoE models keep the idle-ranks
+        # degradation instead (_rebalanced_strategy_for returns None)
+        raise ValueError(
+            "batch_pad is not supported for MoE models: the gating "
+            "aux losses would see the pad tokens"
+        )
+
+    def _row_w(B: int):
+        """Static loss row-weights for a padded batch of B rows (the
+        trailing ``batch_pad`` rows weigh 0), or None unpadded."""
+        if not batch_pad:
+            return None
+        return jnp.asarray(pad_row_weights(B - batch_pad, B))
+
     def grads_and_loss(params, tokens, targets):
         def lf(p):
             return loss_fn(
-                p, tokens, targets, cfg, mesh, return_aux=True
+                p, tokens, targets, cfg, mesh, return_aux=True,
+                row_weights=_row_w(tokens.shape[0]),
             )
 
         return jax.value_and_grad(lf, has_aux=True)(params)
@@ -333,15 +412,27 @@ def build_train_step(
         from dlrover_tpu.common.jax_compat import shard_map
 
         kw = {}
-        if plan.auto_axes:
+        if plan.three_d:
+            # manual over the data axes only; tp/sp stay GSPMD auto
+            # for the matmuls (the sync itself later goes FULLY
+            # manual in _sync_grads_3d — psum_scatter cannot run in
+            # a partial-manual region)
+            kw["axis_names"] = ("dp", "fsdp")
+            batch_spec = P(("dp", "fsdp"))
+        elif plan.auto_axes:
             kw["axis_names"] = ("dp",)
-            batch_spec = P(("dp",))  # tp/sp sharding rides as auto
+            batch_spec = P(("dp",))  # tp/sp/ep sharding rides as auto
         else:
             batch_spec = P(("dp", "fsdp"), "sp")
 
-        def body(p, x, y):
+        def body(p, x, y, w):
             def lf(pp):
-                return loss_fn(pp, x, y, cfg, None, return_aux=True)
+                return loss_fn(
+                    pp, x, y, cfg, None, return_aux=True,
+                    # replicated dummy when unpadded (batch_pad is a
+                    # build-time constant)
+                    row_weights=w if batch_pad else None,
+                )
 
             (loss, aux), g = jax.value_and_grad(lf, has_aux=True)(p)
             lead = lambda a: a[None]  # noqa: E731
@@ -351,15 +442,25 @@ def build_train_step(
                 jax.tree_util.tree_map(lead, g),
             )
 
+        # row weights shard with the batch rows (uniform scale, so the
+        # per-shard mean-of-means still composes exactly — see
+        # pad_row_weights)
+        w = _row_w(tokens.shape[0])
+        w_spec = P(batch_spec[0]) if w is not None else P()
         stacked = P(plan.stack_axes)
         return shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), batch_spec, batch_spec),
+            in_specs=(P(), batch_spec, batch_spec, w_spec),
             out_specs=(stacked, stacked, stacked),
             check_vma=False,
             **kw,
-        )(params, tokens, targets)
+        )(
+            params,
+            tokens,
+            targets,
+            w if w is not None else jnp.zeros((1,), jnp.float32),
+        )
 
     def _microbatches(tokens, targets):
         B = tokens.shape[0]
@@ -372,6 +473,124 @@ def build_train_step(
             tokens.reshape(grad_accum, mb, *tokens.shape[1:]),
             targets.reshape(grad_accum, mb, *targets.shape[1:]),
         )
+
+    def ep_synced_grads(state, tokens, targets):
+        """The dp x ep explicit path: ONE fully-manual (dp, ep)
+        region computes per-dp-rank local grads WITH the MoE
+        dispatch/combine all-to-alls inside it (expert weights enter
+        as their LOCAL 1/ep slices; ``moe_axis="ep"`` threads the
+        manual axis into the gating body) and bucket-syncs them over
+        dp in place (``grad_sync.sync_local_tree``). The loss is
+        seeded on ep rank 0 only — every ep rank computes the same
+        loss through the rank-crossing all-to-alls, so seeding all of
+        them would hand the expert weights an ep-scaled cotangent;
+        rank 0's backward still reaches every rank's experts through
+        the all-to-all transpose, and the ep-replicated dense grads
+        are shared back with one selection psum."""
+        from jax.sharding import PartitionSpec as P
+
+        from dlrover_tpu.common.jax_compat import shard_map
+        from dlrover_tpu.parallel.grad_sync import sync_local_tree
+
+        p_leaves, p_def = jax.tree_util.tree_flatten(state.params)
+        expert_ids = set(plan.expert_leaf_ids)
+        dim_by_id = dict(
+            zip(plan.expert_leaf_ids, plan.expert_leaf_dims)
+        )
+        dense_ids = [
+            i for i in range(len(p_leaves)) if i not in expert_ids
+        ]
+
+        def _leaf_spec(i):
+            if i not in expert_ids:
+                return P()
+            entries = [None] * p_leaves[i].ndim
+            entries[dim_by_id[i]] = "ep"
+            return P(*entries)
+
+        param_specs = tuple(_leaf_spec(i) for i in range(len(p_leaves)))
+        batch_spec = P(("dp",))
+
+        def body(leaves_in, x, y, w):
+            params = jax.tree_util.tree_unflatten(
+                p_def, list(leaves_in)
+            )
+            ep_idx = jax.lax.axis_index("ep")
+
+            def lf(p):
+                loss, aux = loss_fn(
+                    p, x, y, cfg, None, return_aux=True,
+                    moe_axis="ep",
+                    # the w operand is a replicated dummy when the
+                    # strategy is unpadded (batch_pad is a build-time
+                    # constant)
+                    row_weights=w if batch_pad else None,
+                )
+                seed = (ep_idx == 0).astype(loss.dtype)
+                return loss * seed, (loss, aux)
+
+            (_, (loss, aux)), g = jax.value_and_grad(
+                lf, has_aux=True
+            )(params)
+            g_leaves = list(jax.tree_util.tree_flatten(g)[0])
+            for i in dense_ids:
+                # dense grads are nonzero only on ep rank 0 (the loss
+                # seed) — psum over ep is selection, not averaging
+                g_leaves[i] = jax.lax.psum(g_leaves[i], "ep")
+            e_synced, ss_e = sync_local_tree(
+                [g_leaves[i] for i in plan.expert_leaf_ids],
+                plan.expert_plan,
+            )
+            d_synced, ss_d = sync_local_tree(
+                [g_leaves[i] for i in dense_ids], plan.dense_plan
+            )
+            out = [None] * len(g_leaves)
+            for i, gl in zip(plan.expert_leaf_ids, e_synced):
+                out[i] = gl
+            for i, gl in zip(dense_ids, d_synced):
+                out[i] = gl
+            gnorm = jnp.sqrt(jax.lax.psum(ss_e, "ep") + ss_d)
+            loss = jax.lax.pmean(loss, "dp")
+            aux = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, "dp"), aux
+            )
+            return tuple(out), loss, aux, gnorm
+
+        from dlrover_tpu.models.transformer import _zero_aux
+
+        aux_specs = jax.tree_util.tree_map(
+            lambda _: P(), _zero_aux(cfg)
+        )
+        # micro-batch rebalance row weights shard with the batch rows
+        # (None -> a replicated dummy the body ignores), same contract
+        # as local_grads_and_loss
+        w = _row_w(tokens.shape[0])
+        grads_leaves, loss, aux, gnorm = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                param_specs,
+                batch_spec,
+                batch_spec,
+                P(("dp",)) if w is not None else P(),
+            ),
+            out_specs=(param_specs, P(), aux_specs, P()),
+            check_vma=False,
+        )(
+            tuple(p_leaves),
+            tokens,
+            targets,
+            w if w is not None else jnp.zeros((1,), jnp.float32),
+        )
+        grads = jax.tree_util.tree_unflatten(
+            p_def, list(grads_leaves)
+        )
+        grads = jax.tree_util.tree_map(
+            lambda g, sh: jax.lax.with_sharding_constraint(g, sh),
+            grads,
+            grad_sh,
+        )
+        return loss, aux, grads, gnorm, state.grad_residual
 
     def synced_grads(state, tokens, targets):
         """The explicit scheduler: local grads (accumulated in fp32
@@ -409,7 +628,7 @@ def build_train_step(
                 state.params,
             )
             (g_sum, loss_sum, aux_sum), _ = jax.lax.scan(
-                body, (zeros_g, jnp.float32(0.0), _zero_aux()), (xs, ys)
+                body, (zeros_g, jnp.float32(0.0), _zero_aux(cfg)), (xs, ys)
             )
             k = jnp.float32(grad_accum)
             g_stacked = jax.tree_util.tree_map(
@@ -440,6 +659,10 @@ def build_train_step(
             grads,
             grad_sh,
         )
+        if gnorm is None:
+            # 3d plans hand the norm back (a per-chunk sum inside the
+            # manual region would double-count tp-replicated leaves)
+            gnorm = optax.global_norm(grads)
         if residual is None:
             new_residual = state.grad_residual
         return loss, aux, grads, gnorm, new_residual
@@ -468,7 +691,7 @@ def build_train_step(
                 state.params,
             )
             (g_sum, loss_sum, aux_sum), _ = jax.lax.scan(
-                body, (zeros_g, jnp.float32(0.0), _zero_aux()), (xs, ys)
+                body, (zeros_g, jnp.float32(0.0), _zero_aux(cfg)), (xs, ys)
             )
             k = jnp.float32(grad_accum)
             grads = jax.tree_util.tree_map(
@@ -485,7 +708,11 @@ def build_train_step(
         return loss, aux, grads, optax.global_norm(grads), None
 
     def train_step(state: TrainState, tokens, targets):
-        if plan is not None:
+        if plan is not None and getattr(plan, "kind", "") == "ep":
+            loss, aux, grads, gnorm, new_residual = ep_synced_grads(
+                state, tokens, targets
+            )
+        elif plan is not None:
             loss, aux, grads, gnorm, new_residual = synced_grads(
                 state, tokens, targets
             )
@@ -509,6 +736,18 @@ def build_train_step(
         if cfg.num_experts:
             metrics["moe_balance_loss"] = aux["balance"]
             metrics["moe_z_loss"] = aux["z"]
+            # routing telemetry (ISSUE 13): per-expert primary load
+            # (a [num_experts] vector — consumers that report scalars
+            # must pop it) and the capacity drop rate; the trainer's
+            # CapacityRebalancer periodically turns these into
+            # cfg.capacity_splits. forward() SUMS aux across layers,
+            # so normalize by the MoE layer count to report true
+            # per-layer rates/fractions
+            from dlrover_tpu.models.config import num_moe_layers
+
+            n_moe = max(num_moe_layers(cfg), 1)
+            metrics["moe_expert_load"] = aux["load"] / n_moe
+            metrics["moe_drop_rate"] = aux["drop"] / n_moe
         return (
             TrainState(
                 step=state.step + 1,

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from dlrover_tpu.models.config import TransformerConfig
+from dlrover_tpu.models.config import TransformerConfig, is_moe_layer
 from dlrover_tpu.parallel.moe import (
     MoEParams,
     init_moe_params,
@@ -87,7 +87,7 @@ def init_params(key, cfg: TransformerConfig) -> Params:
         if not cfg.rmsnorm:
             layer["attn_norm"]["bias"] = jnp.zeros((d,), pd)
             layer["mlp_norm"]["bias"] = jnp.zeros((d,), pd)
-        if cfg.num_experts and i % cfg.moe_every == cfg.moe_every - 1:
+        if is_moe_layer(cfg, i):
             layer["moe"] = init_moe_params(
                 next(keys), cfg.num_experts, d, f, dtype=pd
             )
@@ -152,7 +152,7 @@ def logical_axes(cfg: TransformerConfig) -> Params:
         if not cfg.rmsnorm:
             layer["attn_norm"]["bias"] = ("norm",)
             layer["mlp_norm"]["bias"] = ("norm",)
-        if cfg.num_experts and i % cfg.moe_every == cfg.moe_every - 1:
+        if is_moe_layer(cfg, i):
             layer["moe"] = MoEParams(
                 gate=(None, None),
                 w_up=("experts", None, "expert_mlp"),
@@ -267,27 +267,43 @@ def _attention_block(x, layer, cfg: TransformerConfig, mesh, positions):
     return x + jnp.einsum(out, o, layer["attn"]["wo"].astype(o.dtype))
 
 
-def _zero_aux():
-    return {"balance": jnp.float32(0.0), "z": jnp.float32(0.0)}
+def _zero_aux(cfg: Optional[TransformerConfig] = None):
+    """Aux-loss tree congruent with what MoE layers emit. With a MoE
+    config the tree also carries the per-expert routing load vector
+    and the capacity drop-rate scalar (ISSUE 13 telemetry — the
+    CapacityRebalancer feeds on them); dense layers contribute
+    zeros."""
+    aux = {"balance": jnp.float32(0.0), "z": jnp.float32(0.0)}
+    if cfg is not None and cfg.num_experts:
+        aux["load"] = jnp.zeros((cfg.num_experts,), jnp.float32)
+        aux["drop"] = jnp.float32(0.0)
+    return aux
 
 
-def _mlp_block(x, layer, cfg: TransformerConfig, mesh):
+def _mlp_block(x, layer, cfg: TransformerConfig, mesh, moe_axis=None):
     h = _norm(x, layer["mlp_norm"], cfg)
     if "moe" in layer:
+        caps = cfg.capacity_splits or None
         if mesh is not None:
             out, aux = moe_layer(
                 layer["moe"], h, mesh,
                 capacity_factor=cfg.capacity_factor,
                 top_k=cfg.moe_top_k,
+                expert_caps=caps,
             )
         else:
+            # mesh=None runs inside a manual region; ``moe_axis``
+            # names the manual ep axis when expert weights enter as
+            # LOCAL [E/ep, ...] slices (the explicit-sync path), so
+            # the dispatch/combine all-to-alls still run
             B, T, d = h.shape
             out, aux = moe_layer_local(
                 layer["moe"],
                 h.reshape(B * T, d),
-                axis_name=None,
+                axis_name=moe_axis,
                 capacity_factor=cfg.capacity_factor,
                 top_k=cfg.moe_top_k,
+                expert_caps=caps,
             )
             out = out.reshape(B, T, d)
         return x + out, aux
@@ -308,7 +324,7 @@ def _mlp_block(x, layer, cfg: TransformerConfig, mesh):
     out = mm(z, mlp["w_down"])
     if not cfg.swiglu:
         out = out + mlp["b_down"].astype(h.dtype)
-    return x + out, _zero_aux()
+    return x + out, _zero_aux(cfg)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -378,7 +394,9 @@ def lm_head(params: Params, x: jnp.ndarray, cfg: TransformerConfig):
     return logits
 
 
-def token_nll(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+def token_nll(
+    logits: jnp.ndarray, targets: jnp.ndarray, row_weights=None
+) -> jnp.ndarray:
     """Mean next-token negative log-likelihood.
 
     Written as ``logsumexp(logits) - logits[target]`` (identical math
@@ -388,7 +406,14 @@ def token_nll(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     on the 124M bench (3.3 GB of avoidable HBM traffic at bs32)."""
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - tgt)
+    nll = lse - tgt
+    if row_weights is not None:
+        # weighted mean over rows (micro-batch rebalance: padded rows
+        # carry weight 0, real rows batch_padded/batch_real — see
+        # models/train.py pad_row_weights; the plain mean over the
+        # padded batch then equals the mean over the real rows)
+        return jnp.mean(row_weights[:, None].astype(nll.dtype) * nll)
+    return jnp.mean(nll)
 
 
 def forward(
@@ -397,6 +422,7 @@ def forward(
     cfg: TransformerConfig,
     mesh=None,
     return_hidden: bool = False,
+    moe_axis=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """tokens [B,T] int32 → (logits [B,T,vocab] fp32, moe aux dict
     {"balance": load-balance loss, "z": router z-loss} — zeros for dense
@@ -411,11 +437,11 @@ def forward(
     x = embed_tokens(params, tokens, cfg, mesh)
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
 
-    aux_total = _zero_aux()
+    aux_total = _zero_aux(cfg)
 
     def block(x, layer):
         x = _attention_block(x, layer, cfg, mesh, positions)
-        x, aux = _mlp_block(x, layer, cfg, mesh)
+        x, aux = _mlp_block(x, layer, cfg, mesh, moe_axis=moe_axis)
         return x, aux
 
     if cfg.remat:
@@ -449,13 +475,15 @@ def loss_fn(
     mesh=None,
     moe_aux_weight: float = 0.01,
     return_aux: bool = False,
+    moe_axis=None,
+    row_weights=None,
 ):
     """Mean NLL + weighted MoE aux losses (load balance at
     ``moe_aux_weight``, router z at ``cfg.router_z_weight``).
     ``return_aux=True`` → (loss, aux dict) for metric surfacing."""
-    logits, aux = forward(params, tokens, cfg, mesh)
+    logits, aux = forward(params, tokens, cfg, mesh, moe_axis=moe_axis)
     loss = (
-        token_nll(logits, targets)
+        token_nll(logits, targets, row_weights=row_weights)
         + moe_aux_weight * aux["balance"]
         + cfg.router_z_weight * aux["z"]
     )

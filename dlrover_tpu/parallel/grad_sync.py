@@ -75,10 +75,47 @@ module replaces it with an explicit, schedulable sync layer:
     inherit unstable auto-axis shardings across steps and invalidate
     AOT executables.
 
-  Meshes with ``pp`` or ``ep`` degrees, and 3D ``dp x fsdp x tp``
-  factorizations, keep GSPMD's native schedule; the fallback is
-  logged once per mesh (``note_gspmd_fallback``) and surfaced as
-  ``PipelineStats.grad_sync_path`` instead of only in HLO.
+- **The rest of the mesh matrix** (ISSUE 13): the explicit path now
+  covers every axis combination the strategy search emits.
+
+  - ``pp (x dp)``: per-stage bucketed reduce-scatter/all-gather
+    scheduled into the pipeline bubble. The pipeline step
+    (``parallel/pipeline.py``) runs fully manual over (pp, dp),
+    computes per-dp-rank LOCAL grads inside the region, and each
+    stage's dp sync is issued as independent per-bucket collectives
+    whose replica groups stay within the stage's dp sub-axis —
+    XLA's scheduler can start stage S's sync while stage S' is still
+    draining, instead of one post-drain monolithic all-reduce. The
+    per-stage bucket plans are keyed by stage id (``PPSyncPlan``:
+    one stage-subtree plan every stage shares structurally — SPMD —
+    plus a shared head/embed plan), and the dp legs compose with the
+    existing flat/two-level schedules on the stage's dp sub-axis.
+    Both gpipe and 1f1b/interleaved schedules are covered
+    (``Strategy.resolved_pp_schedule()``).
+  - ``dp x ep``: expert grads are already 1/ep per device (the ep
+    axis shards only the expert FFN weights) and dense grads are
+    ep-replicated, so the dp sync runs exactly like the tp path —
+    bucketed psum over dp under a partial-manual shard_map with ep
+    left to GSPMD. The MoE dispatch/combine all-to-alls themselves
+    are priced per link through the ``LinkModel``
+    (``alltoall_time_s``) and capacity-rebalanced from per-expert
+    load telemetry (``parallel/moe.py CapacityRebalancer``).
+  - ``dp x fsdp x tp`` (3D): the ZeRO reduce-scatter-into-shard-
+    layout leg and the tp leg compose on orthogonal axes. The sync
+    shard_map goes FULLY manual (dp, fsdp, tp all manual — XLA's
+    partitioner cannot mix manual-subgroup reduce-scatter with auto
+    axes, the same 0.4.x limit that shaped the tp path), each device
+    buckets its own tp-local grad shard, reduce-scatters it over
+    fsdp and runs the dp legs on the 1/fsdp chunk; leaves re-enter
+    GSPMD land as (tp, fsdp)-sharded flat buckets and are sliced
+    back per the param's own tp layout. fp32 parity is gated at
+    1e-5 on tp-containing meshes (the PR-8 modes stay bitwise).
+
+  Remaining fallbacks (e.g. pp x ep exotica) name the exact axes
+  that disqualified them (``fallback_reason``), logged once per mesh
+  (``note_gspmd_fallback``, deduped on the full axis dict) and
+  surfaced as ``PipelineStats.grad_sync_path`` instead of only in
+  HLO.
 
 ``resolve_plan`` is the single gating decision both the step builder
 and the trainer consult.
@@ -124,35 +161,126 @@ class SyncMode:
     model). ``kind``: "dp" (classic pure-DP), "zero" (dp x fsdp —
     reduce-scatter into the fsdp shard layout), "tp" (dp x tp/sp —
     bucketed dp sync under a partial-manual shard_map with the model
-    axes left to GSPMD)."""
+    axes left to GSPMD), "ep" (dp x ep — same partial-manual psum
+    schedule; expert grads are already 1/ep per device), "3d"
+    (dp x fsdp x tp — the ZeRO leg and the tp leg composed under a
+    fully-manual sync region), "pp" (pp x dp — per-stage bucketed
+    sync scheduled into the pipeline bubble; the plan itself is built
+    by ``plan_for_pipeline``)."""
 
     kind: str
     dp: int
     fsdp: int = 1
-    # model axes (>1) left to GSPMD on the "tp" path
+    # model axes (>1) left to GSPMD on the "tp"/"ep" paths, and the
+    # tp/sp axes of the "3d" path (manual in the sync region, auto in
+    # the local-grads region)
     auto_axes: Tuple[str, ...] = ()
     # product of the auto axes' degrees: grads of model-sharded params
     # are already 1/model_shard per device, so per-device wire bytes
     # scale down by it
     model_shard: int = 1
+    # pipeline stages ("pp" mode only)
+    pp: int = 1
+    # expert-parallel degree ("ep" mode only)
+    ep: int = 1
+
+
+def fallback_reason(axis_sizes: dict) -> str:
+    """Why ``resolve_sync_mode`` rejected a mesh, naming the EXACT
+    axes that disqualified it (a 3D mesh used to be lumped under
+    "unsupported mesh"; with pp/ep/3D landing, the remaining
+    fallbacks are specific compositions). Empty string when the mesh
+    actually qualifies."""
+    dp = int(axis_sizes.get("dp", 1))
+    fsdp = int(axis_sizes.get("fsdp", 1))
+    tp = int(axis_sizes.get("tp", 1))
+    sp = int(axis_sizes.get("sp", 1))
+    ep = int(axis_sizes.get("ep", 1))
+    pp = int(axis_sizes.get("pp", 1))
+    if resolve_sync_mode(axis_sizes) is not None:
+        return ""
+    if pp > 1:
+        others = [
+            a
+            for a, s in (("fsdp", fsdp), ("tp", tp), ("sp", sp), ("ep", ep))
+            if s > 1
+        ]
+        if others:
+            return (
+                f"pp x {' x '.join(others)} composition: the pipeline "
+                f"sync region supports only a dp sub-axis"
+            )
+        return "pp mesh with dp=1: no data axis to sync"
+    if ep > 1:
+        others = [
+            a
+            for a, s in (("fsdp", fsdp), ("tp", tp), ("sp", sp))
+            if s > 1
+        ]
+        if others:
+            return (
+                f"ep x {' x '.join(others)} composition: the manual "
+                f"(dp, ep) sync region admits no other model axis"
+            )
+        return "ep mesh with dp=1: no data axis to sync"
+    if fsdp > 1 and sp > 1 and tp <= 1:
+        return (
+            "fsdp x sp composition without tp: sp shards no params, "
+            "so the 3d region has nothing to localize"
+        )
+    return "no data axis with degree > 1"
 
 
 def resolve_sync_mode(axis_sizes: dict) -> Optional[SyncMode]:
     """THE mesh gate (every caller routes through here so the step
     builder, trainer and cost model cannot drift): a SyncMode when the
     explicit sync path supports this mesh, else None (GSPMD default
-    schedule). pp/ep meshes and 3D dp x fsdp x tp factorizations stay
+    schedule). Covered: pure-dp, dp x fsdp (ZeRO), dp x tp/sp,
+    dp x ep, dp x fsdp x tp[,sp] (3D) and pp x dp. The remaining
+    fallbacks (pp or ep composed with any other model axis) stay
     GSPMD; callers that *requested* the explicit path should surface
-    the fallback via ``note_gspmd_fallback``."""
+    the fallback via ``note_gspmd_fallback`` with
+    ``fallback_reason``."""
     dp = int(axis_sizes.get("dp", 1))
     fsdp = int(axis_sizes.get("fsdp", 1))
     tp = int(axis_sizes.get("tp", 1))
     sp = int(axis_sizes.get("sp", 1))
-    if int(axis_sizes.get("pp", 1)) > 1 or int(axis_sizes.get("ep", 1)) > 1:
-        return None
+    ep = int(axis_sizes.get("ep", 1))
+    pp = int(axis_sizes.get("pp", 1))
+    if pp > 1:
+        # per-stage sync into the bubble: only a dp sub-axis composes
+        # (the stage-stacked state layout owns the other axes)
+        if fsdp > 1 or tp > 1 or sp > 1 or ep > 1 or dp <= 1:
+            return None
+        return SyncMode("pp", dp=dp, pp=pp)
+    if ep > 1:
+        # expert weights are ep-sharded (1/ep per device), dense
+        # params ep-replicated with ep-replicated activations — the
+        # sync owes only the dp reduction, run FULLY manual over
+        # (dp, ep) with the MoE all-to-alls inside the region (a
+        # partial-manual region with ep auto hard-crashes the 0.4.x
+        # partitioner on the expert einsums). No other model axis
+        # composes with that region.
+        if fsdp > 1 or tp > 1 or sp > 1 or dp <= 1:
+            return None
+        return SyncMode("ep", dp=dp, auto_axes=("ep",), ep=ep)
     if fsdp > 1:
-        if tp > 1 or sp > 1:
-            return None  # 3D mesh: grads entangled across model axes
+        if tp > 1:
+            # 3D: the ZeRO reduce-scatter leg and the tp leg compose
+            # under a fully-manual sync region (sync_grads buckets
+            # each device's tp-local shard); sp may ride along (it
+            # shards no params, so there is nothing to localize)
+            auto = tuple(
+                a for a in ("tp", "sp") if int(axis_sizes.get(a, 1)) > 1
+            )
+            return SyncMode(
+                "3d", dp=dp, fsdp=fsdp, auto_axes=auto, model_shard=tp
+            )
+        if sp > 1:
+            # fsdp x sp WITHOUT tp: no param dim for the 3d region to
+            # localize — keep GSPMD (the pre-ISSUE-13 behavior; named
+            # in fallback_reason)
+            return None
         return SyncMode("zero", dp=dp, fsdp=fsdp)
     if dp > 1 and (tp > 1 or sp > 1):
         auto = tuple(
@@ -182,15 +310,40 @@ class BucketPlan:
     # fsdp degree (> 1 = the ZeRO path: buckets are reduce-scattered
     # into the fsdp shard layout first, the dp legs ride the chunk)
     fsdp: int = 1
-    # model axes left to GSPMD (the "tp" path: sync_grads runs manual
-    # over dp only and each bucket all-reduces with one psum)
+    # model axes left to GSPMD (the "tp"/"ep" paths: sync_grads runs
+    # manual over dp only and each bucket all-reduces with one psum)
     auto_axes: Tuple[str, ...] = ()
     # product of the auto axes' degrees (per-device wire accounting)
     model_shard: int = 1
+    # which SyncMode kind planned this ("" on legacy plans — derived
+    # from the axis fields). "3d" switches sync_grads to the fully-
+    # manual composed schedule below.
+    kind: str = ""
+    # -- 3D (dp x fsdp x tp) fields ------------------------------------
+    # tp degree of the fully-manual sync region; leaf shapes/buckets
+    # are planned over each device's tp-LOCAL shard (so ``padded`` is
+    # already 1/tp and ``model_shard`` stays 1 on 3d plans)
+    tp: int = 1
+    # per-leaf index of the tp-sharded dimension (None = replicated
+    # over tp) — the reconstruction outside the manual region slices
+    # each leaf's tp pieces back along this dim
+    leaf_tp_dims: Tuple[Optional[int], ...] = ()
 
     @property
     def num_buckets(self) -> int:
         return len(self.buckets)
+
+    @property
+    def three_d(self) -> bool:
+        return self.kind == "3d"
+
+    @property
+    def auto_psum(self) -> bool:
+        """dp leg is one bucketed psum (the "tp"/"ep" partial-manual
+        paths) rather than RS+AG — true when model axes ride as GSPMD
+        auto INSIDE the sync region (the 3d path holds auto_axes too,
+        but its sync region is fully manual, so RS+AG apply)."""
+        return bool(self.auto_axes) and not self.zero
 
     @property
     def two_level(self) -> bool:
@@ -290,7 +443,7 @@ class BucketPlan:
                 if self.compress == "int8" and not self.auto_axes
                 else 1.0
             )
-            if self.auto_axes:
+            if self.auto_psum:
                 # bucketed per-bucket all-reduce (psum) over dp
                 total += 2.0 * (self.dp - 1) / self.dp * payload
             elif self.two_level:
@@ -351,8 +504,14 @@ class BucketPlan:
             else ""
         )
         if self.zero:
-            axes = f"{self.dp}-way dp x {self.fsdp}-way fsdp (ZeRO " \
-                f"reduce-scatter, {self.explicit_wire_bytes() >> 10} " \
+            tp3 = (
+                f" x {self.tp}-way tp (manual, tp-local buckets)"
+                if self.three_d
+                else ""
+            )
+            axes = f"{self.dp}-way dp x {self.fsdp}-way fsdp{tp3} " \
+                f"(ZeRO reduce-scatter, " \
+                f"{self.explicit_wire_bytes() >> 10} " \
                 f"KiB/dev vs {self.gspmd_allreduce_bytes() >> 10} KiB " \
                 f"all-reduce)"
         elif self.auto_axes:
@@ -378,6 +537,9 @@ def plan_buckets(
     fsdp: int = 1,
     auto_axes: Tuple[str, ...] = (),
     model_shard: int = 1,
+    kind: str = "",
+    tp: int = 1,
+    leaf_tp_dims: Tuple[Optional[int], ...] = (),
 ) -> BucketPlan:
     """Greedy size-targeted partition of the grad tree (leaf order =
     tree flatten order, which matches the order backward produces
@@ -405,10 +567,21 @@ def plan_buckets(
         raise ValueError(
             f"slices={slices} must divide dp={dp} (and be >= 1)"
         )
-    if auto_axes and (compress != "none" or fsdp > 1):
+    if auto_axes and compress != "none":
         raise ValueError(
-            "a dp x tp/sp plan supports neither int8 compression nor "
-            "an fsdp leg (the residual/scatter would cross GSPMD axes)"
+            "model-sharded plans (dp x tp/sp/ep, 3d) do not support "
+            "int8 compression (the residual would cross GSPMD axes)"
+        )
+    if auto_axes and fsdp > 1 and kind != "3d":
+        raise ValueError(
+            "a dp x tp/sp plan supports no fsdp leg (only the fully-"
+            "manual 3d kind composes them; see resolve_sync_mode)"
+        )
+    if kind == "3d" and (tp < 2 or not leaf_tp_dims):
+        raise ValueError(
+            "a 3d plan needs tp >= 2 and per-leaf tp dims (shapes "
+            "must be the tp-LOCAL shards — use resolve_plan/"
+            "plan_for_mesh, not plan_buckets directly)"
         )
     leaves = jax.tree_util.tree_leaves(shapes_tree)
     shapes = tuple(tuple(int(d) for d in l.shape) for l in leaves)
@@ -458,6 +631,9 @@ def plan_buckets(
         fsdp=fsdp,
         auto_axes=tuple(auto_axes),
         model_shard=model_shard,
+        kind=kind,
+        tp=tp,
+        leaf_tp_dims=tuple(leaf_tp_dims),
     )
 
 
@@ -477,12 +653,15 @@ def note_gspmd_fallback(axis_sizes: dict, reason: str = "") -> None:
     if key in _GSPMD_FALLBACK_LOGGED:
         return
     _GSPMD_FALLBACK_LOGGED.add(key)
+    if not reason:
+        reason = fallback_reason(axis_sizes)
     sizes = {k: int(v) for k, v in axis_sizes.items() if int(v) > 1}
     logger.info(
         f"grad_sync: mesh {sizes or {'dp': 1}} keeps the GSPMD default "
         f"schedule{' (' + reason + ')' if reason else ''}; the explicit "
-        f"bucketed path supports pure-dp, dp x fsdp and dp x tp/sp "
-        f"meshes (grad_sync_path=gspmd)"
+        f"bucketed path supports pure-dp, dp x fsdp, dp x tp/sp, "
+        f"dp x ep, dp x fsdp x tp and pp x dp meshes "
+        f"(grad_sync_path=gspmd)"
     )
 
 
@@ -521,6 +700,74 @@ def resolve_bucket_bytes(
     )
 
 
+def _leaf_axis_dims(cfg, params_shape, mesh_axis: str):
+    """(flat leaves, treedef, per-leaf dim index sharded over
+    ``mesh_axis``) from the logical-axis rules (e.g. "mlp"/"heads"/
+    "kv_heads"/"vocab" → tp, "experts" → ep). None = replicated over
+    that mesh axis."""
+    import jax
+
+    from dlrover_tpu.models.transformer import logical_axes
+    from dlrover_tpu.parallel.sharding_rules import default_lm_rules
+
+    rules = default_lm_rules().rules
+    ax_tree = logical_axes(cfg)
+
+    def _is_axes(x):
+        return isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x
+        )
+
+    ax_leaves = jax.tree_util.tree_leaves(ax_tree, is_leaf=_is_axes)
+    leaves, treedef = jax.tree_util.tree_flatten(params_shape)
+    if len(ax_leaves) != len(leaves):
+        raise ValueError(
+            f"logical axes tree ({len(ax_leaves)} leaves) does not "
+            f"match the param tree ({len(leaves)} leaves)"
+        )
+    out_dims: List[Optional[int]] = []
+    for leaf, names in zip(leaves, ax_leaves):
+        dim = None
+        for i, nm in enumerate(names):
+            if nm and rules.get(nm) == mesh_axis:
+                dim = i
+                break
+        out_dims.append(dim)
+    return leaves, treedef, out_dims
+
+
+def _localize_axis(params_shape, degree: int, cfg, mesh_axis: str):
+    """params_shape with each ``mesh_axis``-sharded leaf dim divided by
+    ``degree`` (a dim the degree does not divide is treated as
+    replicated, matching what ``apply_rules`` produces). Returns the
+    localized ShapeDtypeStruct tree and the per-leaf dim tuple —
+    fully-manual sync regions bucket in these local coordinates."""
+    import jax
+
+    leaves, treedef, dims = _leaf_axis_dims(cfg, params_shape, mesh_axis)
+    out_leaves = []
+    out_dims: List[Optional[int]] = []
+    for leaf, dim in zip(leaves, dims):
+        shape = tuple(int(d) for d in leaf.shape)
+        if dim is not None and shape[dim] % degree == 0:
+            shape = tuple(
+                d // degree if i == dim else d
+                for i, d in enumerate(shape)
+            )
+            out_dims.append(dim)
+        else:
+            out_dims.append(None)
+        out_leaves.append(jax.ShapeDtypeStruct(shape, leaf.dtype))
+    return (
+        jax.tree_util.tree_unflatten(treedef, out_leaves),
+        tuple(out_dims),
+    )
+
+
+def _localize_tp(params_shape, tp: int, cfg):
+    return _localize_axis(params_shape, tp, cfg, "tp")
+
+
 def _plan_for_mode(
     cfg, mode: SyncMode, grad_compress: str, grad_bucket_mb: int,
     params_shape=None, slices: int = 1,
@@ -533,17 +780,24 @@ def _plan_for_mode(
         params_shape = jax.eval_shape(
             lambda: init_params(jax.random.PRNGKey(0), cfg)
         )
-    if mode.kind == "tp" and grad_compress != "none":
+    if mode.kind in ("tp", "ep", "3d") and grad_compress != "none":
         # the residual would inherit unstable auto-axis shardings
         # across steps (invalidating AOT executables); run the
         # explicit path uncompressed instead of falling back entirely
         from dlrover_tpu.common.log import default_logger as logger
 
         logger.info(
-            "grad_sync: int8 compression is not supported on dp x "
-            "tp/sp meshes; running the explicit bucketed sync at fp32"
+            f"grad_sync: int8 compression is not supported on "
+            f"model-sharded ({mode.kind}) meshes; running the "
+            f"explicit bucketed sync at fp32"
         )
         grad_compress = "none"
+    if mode.kind == "ep":
+        # the fully-manual (dp, ep) path has its own split plan
+        # (ep-local expert leaves + dense leaves)
+        return _plan_for_ep(
+            cfg, mode, grad_bucket_mb, params_shape, slices=slices
+        )
     if mode.kind == "tp":
         # the tp path syncs each bucket with one flat psum (see
         # _sync_one_bucket) — a two-level plan would mis-size auto
@@ -551,6 +805,19 @@ def _plan_for_mode(
         # describe()/dcn accounting, and break the legs probe
         slices = 1
     slices = slices if 1 < slices < mode.dp else 1
+    kind = mode.kind
+    leaf_tp_dims: Tuple[Optional[int], ...] = ()
+    tp = 1
+    model_shard = mode.model_shard
+    if kind == "3d":
+        # plan over each device's tp-LOCAL leaf shard: the 3d sync
+        # region is fully manual, so buckets/padding live in local
+        # coordinates and model_shard stays 1 (nothing left to divide)
+        tp = mode.model_shard
+        params_shape, leaf_tp_dims = _localize_tp(
+            params_shape, tp, cfg
+        )
+        model_shard = 1
     return plan_buckets(
         params_shape,
         dp=mode.dp,
@@ -562,7 +829,10 @@ def _plan_for_mode(
         slices=slices,
         fsdp=mode.fsdp,
         auto_axes=mode.auto_axes,
-        model_shard=mode.model_shard,
+        model_shard=model_shard,
+        kind=kind,
+        tp=tp,
+        leaf_tp_dims=leaf_tp_dims,
     )
 
 
@@ -584,6 +854,11 @@ def plan_for_mesh(
     mode = resolve_sync_mode(sizes)
     if mode is None:
         return None
+    if mode.kind == "pp":
+        # the NON-pipeline step builder asked about a pp mesh: its
+        # flat grad tree has no stage structure to key buckets on —
+        # the pipeline step builder plans via ``plan_for_pipeline``
+        return None
     if slices > 1 and mode.dp % slices:
         raise ValueError(
             f"slices={slices} does not divide dp={mode.dp}"
@@ -604,12 +879,15 @@ def resolve_plan(
 
     Engages iff ``comm_overlap`` (or int8 ``grad_compress``, which
     requires the explicit path) is requested AND the mesh qualifies
-    (``resolve_sync_mode``: pure-dp, dp x fsdp, or dp x tp/sp).
-    pp/ep and 3D meshes fall back with a once-per-mesh log
-    (``note_gspmd_fallback``) — candidate search stamps the opt names
-    onto every candidate, and such a candidate must still build. A
-    hybrid dp axis (``MeshConfig.dp_slices() > 1``) plans the
-    two-level ICI/DCN schedule on the dp legs.
+    (``resolve_sync_mode``: pure-dp, dp x fsdp ZeRO, dp x tp/sp,
+    dp x ep, dp x fsdp x tp 3D, or pp x dp — the last returns a
+    ``PPSyncPlan``). The remaining compositions fall back with a
+    once-per-mesh log naming the disqualifying axes
+    (``note_gspmd_fallback`` + ``fallback_reason``) — candidate
+    search stamps the opt names onto every candidate, and such a
+    candidate must still build. A hybrid dp axis
+    (``MeshConfig.dp_slices() > 1``) plans the two-level ICI/DCN
+    schedule on the dp legs.
     """
     if not strategy.resolved_comm_overlap():
         return None
@@ -618,6 +896,26 @@ def resolve_plan(
     if mode is None:
         note_gspmd_fallback(sizes)
         return None
+    if mode.kind == "ep" and strategy.grad_accum > 1:
+        # same gate build_train_step applies: the ep manual region
+        # syncs per call, so a grad-accum scan around it would pay K
+        # syncs — the step runs GSPMD, and this shared gate keeps the
+        # trainer's grad_sync_path and the cost model honest about it
+        note_gspmd_fallback(
+            sizes,
+            reason=f"ep explicit sync with grad_accum="
+            f"{strategy.grad_accum}: the manual region syncs per call",
+        )
+        return None
+    if mode.kind == "pp":
+        return plan_for_pipeline(
+            cfg,
+            sizes,
+            grad_bucket_mb=strategy.grad_bucket_mb,
+            slices=strategy.mesh.dp_slices(),
+            schedule=strategy.resolved_pp_schedule(),
+            virtual=strategy.resolved_virtual(),
+        )
     return _plan_for_mode(
         cfg,
         mode,
@@ -626,6 +924,293 @@ def resolve_plan(
         params_shape,
         slices=strategy.mesh.dp_slices(),
     )
+
+
+# -- pipeline (pp x dp) sync plans ------------------------------------------
+
+
+@dataclass(frozen=True)
+class PPSyncPlan:
+    """Per-stage bucketed sync for a pp x dp mesh (SyncMode "pp").
+
+    ``stage_plan`` buckets ONE stage's local param subtree — under
+    SPMD every stage runs the identical bucket walk over its own
+    slice, so one structural plan serves all ``pp`` stages and each
+    collective's replica groups stay within a stage's dp sub-axis
+    (the "keyed by stage id" property lives in the groups, not in pp
+    distinct programs). ``shared_plan`` covers the head/embed leaves
+    every stage holds replicated (synced identically on each stage —
+    the same redundancy GSPMD's own schedule has). The dp legs of
+    both compose with the flat and two-level schedules
+    (``BucketPlan.slices``).
+
+    Quacks like a ``BucketPlan`` for the trainer/bench surfaces
+    (``raw_bytes``/``wire_bytes``/``describe``/``compress``); the
+    in-step walk runs inside the pipeline step's manual region via
+    ``sync_local_tree`` (parallel/pipeline.py wires it)."""
+
+    stage_plan: BucketPlan
+    shared_plan: BucketPlan
+    pp: int
+    dp: int
+    schedule: str = "gpipe"
+    kind: str = "pp"
+    compress: str = "none"
+
+    @property
+    def num_buckets(self) -> int:
+        return self.stage_plan.num_buckets + self.shared_plan.num_buckets
+
+    @property
+    def two_level(self) -> bool:
+        return self.stage_plan.two_level
+
+    @property
+    def slices(self) -> int:
+        return self.stage_plan.slices
+
+    @property
+    def raw_bytes(self) -> int:
+        """Per-DEVICE raw bytes of one sync (a device owns 1/pp of
+        the stage leaves plus the shared head/embed leaves)."""
+        return self.stage_plan.raw_bytes + self.shared_plan.raw_bytes
+
+    @property
+    def wire_bytes(self) -> int:
+        return self.stage_plan.wire_bytes + self.shared_plan.wire_bytes
+
+    def explicit_wire_bytes(self) -> int:
+        return (
+            self.stage_plan.explicit_wire_bytes()
+            + self.shared_plan.explicit_wire_bytes()
+        )
+
+    def gspmd_allreduce_bytes(self) -> int:
+        return (
+            self.stage_plan.gspmd_allreduce_bytes()
+            + self.shared_plan.gspmd_allreduce_bytes()
+        )
+
+    def describe(self) -> str:
+        return (
+            f"pp{self.pp} x dp{self.dp} [{self.schedule}] per-stage "
+            f"sync: {self.stage_plan.num_buckets} stage buckets + "
+            f"{self.shared_plan.num_buckets} shared, "
+            f"{self.raw_bytes >> 10} KiB raw -> "
+            f"{self.wire_bytes >> 10} KiB wire per device/sync, "
+            f"scheduled into the pipeline bubble"
+        )
+
+
+def plan_for_pipeline(
+    cfg,
+    axis_sizes: dict,
+    grad_bucket_mb: int = 4,
+    slices: int = 1,
+    schedule: str = "gpipe",
+    virtual: int = 1,
+) -> Optional[PPSyncPlan]:
+    """Gate + plan for the pipeline step builder: a ``PPSyncPlan``
+    when the mesh is pp x dp (SyncMode "pp"), else None. int8 is not
+    supported on pipeline plans (the residual would have to live in
+    the stage-stacked state layout); the dp legs honor ``slices``
+    (two-level ICI/DCN)."""
+    mode = resolve_sync_mode(axis_sizes)
+    if mode is None or mode.kind != "pp":
+        return None
+    import jax
+
+    from dlrover_tpu.models.transformer import init_params
+    from dlrover_tpu.parallel.pipeline import (
+        _check_pipeline_cfg,
+        stack_pipeline_params,
+    )
+
+    pp, dp = mode.pp, mode.dp
+    try:
+        _check_pipeline_cfg(cfg, pp, virtual)
+    except ValueError:
+        # the model cannot pipeline at this degree at all — the step
+        # builder will reject the strategy; a plan would be fiction
+        return None
+    slices = slices if 1 < slices < dp else 1
+    full = jax.eval_shape(
+        lambda: stack_pipeline_params(
+            init_params(jax.random.PRNGKey(0), cfg), pp, virtual
+        )
+    )
+    stage_local = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(tuple(s.shape[1:]), s.dtype),
+        full["stages"],
+    )
+    shared = {k: v for k, v in full.items() if k != "stages"}
+    bucket_bytes = resolve_bucket_bytes(
+        grad_bucket_mb, dp=dp, slices=slices
+    )
+    stage_plan = plan_buckets(
+        stage_local, dp=dp, bucket_bytes=bucket_bytes, slices=slices,
+        kind="pp",
+    )
+    shared_plan = plan_buckets(
+        shared, dp=dp, bucket_bytes=bucket_bytes, slices=slices,
+        kind="pp",
+    )
+    return PPSyncPlan(
+        stage_plan=stage_plan,
+        shared_plan=shared_plan,
+        pp=pp,
+        dp=dp,
+        schedule=schedule,
+    )
+
+
+@dataclass(frozen=True)
+class EPSyncPlan:
+    """Per-bucket dp sync for a dp x ep mesh (SyncMode "ep").
+
+    The step's grads region runs FULLY manual over (dp, ep) — a
+    partial-manual region with ep auto hard-crashes XLA 0.4.x's
+    partitioner on the MoE einsums' collectives — with the MoE
+    dispatch/combine all-to-alls running inside it
+    (``moe_layer_local(axis_name="ep")`` on the LOCAL expert slices).
+    ``expert_plan`` buckets the ep-LOCAL expert-FFN leaves (each
+    device's 1/ep slice, synced over its dp sub-axis); ``dense_plan``
+    buckets the ep-replicated dense leaves. ``expert_leaf_ids``/
+    ``expert_leaf_dims`` mark which flatten-order param leaves are
+    expert-sharded (and on which dim) so the step builder can build
+    the region's in/out specs. Quacks like a BucketPlan for the
+    trainer/bench surfaces."""
+
+    expert_plan: BucketPlan
+    dense_plan: BucketPlan
+    ep: int
+    dp: int
+    expert_leaf_ids: Tuple[int, ...]
+    expert_leaf_dims: Tuple[int, ...]
+    kind: str = "ep"
+    compress: str = "none"
+
+    @property
+    def num_buckets(self) -> int:
+        return (
+            self.expert_plan.num_buckets + self.dense_plan.num_buckets
+        )
+
+    @property
+    def two_level(self) -> bool:
+        return self.dense_plan.two_level
+
+    @property
+    def slices(self) -> int:
+        return self.dense_plan.slices
+
+    @property
+    def raw_bytes(self) -> int:
+        """Per-DEVICE raw bytes of one sync (1/ep of the expert
+        leaves plus the dense leaves)."""
+        return self.expert_plan.raw_bytes + self.dense_plan.raw_bytes
+
+    @property
+    def wire_bytes(self) -> int:
+        return self.expert_plan.wire_bytes + self.dense_plan.wire_bytes
+
+    def explicit_wire_bytes(self) -> int:
+        return (
+            self.expert_plan.explicit_wire_bytes()
+            + self.dense_plan.explicit_wire_bytes()
+        )
+
+    def gspmd_allreduce_bytes(self) -> int:
+        return (
+            self.expert_plan.gspmd_allreduce_bytes()
+            + self.dense_plan.gspmd_allreduce_bytes()
+        )
+
+    def describe(self) -> str:
+        return (
+            f"dp{self.dp} x ep{self.ep} sync: "
+            f"{self.expert_plan.num_buckets} expert buckets "
+            f"(ep-local) + {self.dense_plan.num_buckets} dense, "
+            f"{self.raw_bytes >> 10} KiB raw -> "
+            f"{self.wire_bytes >> 10} KiB wire per device/sync; "
+            f"dispatch/combine all-to-alls inside the manual region"
+        )
+
+
+def _plan_for_ep(
+    cfg, mode: SyncMode, grad_bucket_mb: int, params_shape=None,
+    slices: int = 1,
+) -> EPSyncPlan:
+    """Split the param tree into ep-LOCAL expert leaves and
+    ep-replicated dense leaves, bucket each for the dp legs."""
+    import jax
+
+    if params_shape is None:
+        from dlrover_tpu.models.transformer import init_params
+
+        params_shape = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), cfg)
+        )
+    ep = mode.ep
+    local_tree, dims = _localize_axis(params_shape, ep, cfg, "ep")
+    leaves = jax.tree_util.tree_leaves(local_tree)
+    expert_ids = tuple(
+        i for i, d in enumerate(dims) if d is not None
+    )
+    expert_dims = tuple(dims[i] for i in expert_ids)
+    dense_ids = tuple(
+        i for i in range(len(leaves)) if i not in set(expert_ids)
+    )
+    slices = slices if 1 < slices < mode.dp else 1
+    bucket_bytes = resolve_bucket_bytes(
+        grad_bucket_mb, dp=mode.dp, slices=slices
+    )
+    expert_plan = plan_buckets(
+        [leaves[i] for i in expert_ids],
+        dp=mode.dp, bucket_bytes=bucket_bytes, slices=slices,
+        kind="ep",
+    )
+    dense_plan = plan_buckets(
+        [leaves[i] for i in dense_ids],
+        dp=mode.dp, bucket_bytes=bucket_bytes, slices=slices,
+        kind="ep",
+    )
+    return EPSyncPlan(
+        expert_plan=expert_plan,
+        dense_plan=dense_plan,
+        ep=ep,
+        dp=mode.dp,
+        expert_leaf_ids=expert_ids,
+        expert_leaf_dims=expert_dims,
+    )
+
+
+def sync_local_tree(tree: Any, plan: BucketPlan, legs: str = "all"):
+    """Bucket-walk dp sync of an ALREADY-LOCAL grad tree, for use
+    INSIDE a manual shard_map region (the pipeline step's body calls
+    this the moment a stage's grads are complete, so each stage's
+    collectives are independent ops XLA can schedule into the
+    fill/drain bubble): each bucket is flattened, synced over the
+    "dp" axis with the plan's flat or two-level schedule, and
+    mean-reduced by dp. Returns (synced tree, sum of squares of the
+    synced values — the caller's grad-norm contribution). ``legs``
+    threads the per-link timing probe's ICI-only mode through to the
+    two-level schedule (``_dp_leg_2level``)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    flats: List = []
+    sumsq = jnp.float32(0.0)
+    for b in plan.buckets:
+        flat = _bucket_flat(leaves, b, plan.dp)
+        mean, _, ss = _sync_one_bucket(flat, None, plan, legs=legs)
+        flats.append(mean)
+        sumsq = sumsq + ss
+    parts: List = []
+    for b, f in zip(plan.buckets, flats):
+        parts.extend(_unflatten_bucket(f, b, plan))
+    return jax.tree_util.tree_unflatten(treedef, parts), sumsq
 
 
 # -- in-step machinery ------------------------------------------------------
@@ -809,7 +1394,7 @@ def _sync_one_bucket(
         x = jax.lax.psum_scatter(
             x, "fsdp", scatter_dimension=0, tiled=True
         )
-    if plan.auto_axes:
+    if plan.auto_psum:
         full, new_residual = jax.lax.psum(x, "dp"), residual
     elif plan.two_level:
         full, new_residual = _dp_leg_2level(x, residual, plan, legs)
@@ -855,6 +1440,8 @@ def sync_grads(
 
     from dlrover_tpu.common.jax_compat import shard_map
 
+    if plan.three_d:
+        return _sync_grads_3d(stacked_grads, mesh, plan)
     leaves, treedef = jax.tree_util.tree_flatten(stacked_grads)
     ef = plan.compress == "int8" and residual is not None
     res_in = tuple(residual) if ef else ()
@@ -912,6 +1499,104 @@ def sync_grads(
         new_res if ef else None,
         gnorm,
     )
+
+
+def _sync_grads_3d(stacked_grads: Any, mesh, plan: BucketPlan):
+    """The composed dp x fsdp x tp schedule (SyncMode "3d").
+
+    The sync region is FULLY manual over (dp, fsdp, tp): XLA's
+    partitioner cannot mix manual-subgroup reduce-scatter/all-gather
+    with auto axes (the 0.4.x limit that forced the tp path onto
+    psum), so instead of leaving tp auto we bring it into the manual
+    region — each device flattens its own tp-LOCAL grad shard (the
+    plan's leaf shapes are local; see ``_localize_tp``), the ZeRO leg
+    reduce-scatters that vector over fsdp exactly as the PR-8 zero
+    path does, and the dp legs (flat or two-level) ride the 1/fsdp
+    chunk. Per bucket the HLO carries the SAME collectives as the
+    dp x fsdp plan — tp adds no dp-leg bytes, it only shrinks the
+    payload to 1/tp per device.
+
+    Buckets leave the region as flat vectors sharded ``P(("tp",
+    "fsdp"))`` (tp-major, so row t of the [tp, padded] view is tp
+    shard t's synced flat) and the leaves are sliced back out under
+    GSPMD along each param's own tp dim. Returns ``(grads, None,
+    None)`` — 3d plans never compress, and the grad norm is computed
+    by the caller over the reconstructed tree (a per-chunk sum here
+    would double-count tp-replicated leaves)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from dlrover_tpu.common.jax_compat import shard_map
+
+    leaves, treedef = jax.tree_util.tree_flatten(stacked_grads)
+    if len(leaves) != len(plan.leaf_shapes):
+        raise ValueError(
+            f"grad tree has {len(leaves)} leaves, plan expects "
+            f"{len(plan.leaf_shapes)}"
+        )
+    in_specs = []
+    for shape, dim in zip(plan.leaf_shapes, plan.leaf_tp_dims):
+        entries: List = [None] * len(shape)
+        if dim is not None:
+            entries[dim] = "tp"
+        # +1 for the stacked lead axis (dp, fsdp); shard_map reshards
+        # inputs to match, so callers need not pre-constrain the tp
+        # layout GSPMD picked in the local-grads region
+        in_specs.append(P(("dp", "fsdp"), *entries))
+
+    def body(leaves_in):
+        local = [l[0] for l in leaves_in]
+        flats: List = []
+        for b in plan.buckets:
+            flat = _bucket_flat(local, b, plan.dp)
+            mean, _, _ = _sync_one_bucket(flat, None, plan)
+            flats.append(mean)
+        return tuple(flats)
+
+    flats = shard_map(
+        body,
+        mesh=mesh,
+        # fully manual (size-1 ep/pp included): a partial-auto region
+        # would re-trip the manual-subgroup-RS-with-auto-axes
+        # partitioner CHECK on the fsdp scatter
+        in_specs=(tuple(in_specs),),
+        out_specs=tuple(P(("tp", "fsdp")) for _ in plan.buckets),
+        check_vma=False,
+    )(tuple(leaves))
+    out_parts: List = []
+    T = plan.tp
+    for b, flat in zip(plan.buckets, flats):
+        rows = flat.reshape(T, b.padded)  # row t = tp shard t's flat
+        off = 0
+        for i in range(b.start, b.stop):
+            shape = plan.leaf_shapes[i]  # tp-LOCAL
+            n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            seg = rows[:, off : off + n]
+            dim = plan.leaf_tp_dims[i]
+            if dim is None:
+                # tp-replicated leaf: every shard synced an identical
+                # copy — take shard 0's
+                leaf = seg[0].reshape(shape)
+            else:
+                # T-major merge of the tp pieces along their dim —
+                # moveaxis+reshape, NOT jnp.concatenate: XLA 0.4.x's
+                # partitioner miscompiles a concat of slices of this
+                # partially-replicated output (it sums the dp
+                # replicas into the result); the reshape form of the
+                # same gather compiles correctly
+                pieces = seg.reshape((T,) + shape)
+                moved = jnp.moveaxis(pieces, 0, dim)
+                gshape = tuple(
+                    d * T if j == dim else d
+                    for j, d in enumerate(shape)
+                )
+                leaf = moved.reshape(gshape)
+            out_parts.append(
+                leaf.astype(jnp.dtype(plan.leaf_dtypes[i]))
+            )
+            off += n
+    return jax.tree_util.tree_unflatten(treedef, out_parts), None, None
 
 
 def zero_residual(plan: BucketPlan, mesh=None) -> Tuple:
@@ -1011,22 +1696,37 @@ def comm_bytes_per_device(
     if n <= 1:
         return 0.0
     payload = float(n_param_bytes)
+    if m.pp > 1:
+        # stage-sharded grads: each device syncs its 1/pp stage share
+        # over dp — under BOTH schedules (GSPMD's post-drain sync is
+        # per stage too; the explicit path's win is the bubble
+        # overlap, priced by the dry-runner, not fewer bytes)
+        payload /= m.pp
     if compress is None:
         compress = strategy.resolved_grad_compress()
     mode = resolve_sync_mode(m.axis_sizes())
     explicit = mode is not None and strategy.resolved_comm_overlap()
-    if explicit and mode.kind == "tp":
+    if explicit and mode.kind in ("tp", "ep"):
         ring = 2.0 * (mode.dp - 1) / mode.dp
+        # tp shards every param ~1/model_shard; ep shards only the
+        # expert FFN weights, so its dense-majority payload is billed
+        # whole (ep modes carry model_shard=1)
         return ring * payload / mode.model_shard  # never compressed
     c = 1.0
     if compress == "int8":
         c = _INT8_BYTES / float(grad_itemsize)
-    if explicit and mode.kind == "zero":
+    if explicit and mode.kind in ("zero", "3d"):
         F = mode.fsdp
+        if mode.kind == "3d":
+            payload /= mode.model_shard  # tp-local buckets
+            c = 1.0  # 3d plans never compress
         total = (F - 1) / F * payload  # ZeRO RS, fp32, no gather
         if mode.dp > 1:
             total += 2.0 * (mode.dp - 1) / mode.dp * (payload / F) * c
         return total
+    if explicit and mode.kind == "pp":
+        ring = 2.0 * (mode.dp - 1) / mode.dp
+        return ring * payload  # pipeline plans never compress
     ring = 2.0 * (n - 1) / n
     return ring * payload * c
 
@@ -1055,8 +1755,15 @@ def comm_time_per_device_s(
       gather twin) rides ICI at that axis's measured rate, then the
       dp legs — flat, compressed, or two-level — ride the ``1/fsdp``
       chunk;
-    - dp x tp/sp (explicit path): the bucketed dp all-reduce moves
-      grads that are already ``1/model_shard`` per device.
+    - dp x tp/sp and dp x ep (explicit paths): the bucketed dp
+      all-reduce moves grads that are already ``1/model_shard``
+      per device (tp; ep's dense-majority payload bills whole);
+    - dp x fsdp x tp (explicit 3d path): the ZeRO legs on the
+      tp-local (``1/model_shard``) payload;
+    - pp x dp: each device's 1/pp stage share rides the dp legs,
+      under either schedule (the explicit path's win — the bubble
+      overlap — is the dry-runner's exposure credit, not a wire
+      discount).
 
     Per-collective latency (one ring's worth of hops) is added from
     the model so tiny syncs don't price as free."""
@@ -1071,6 +1778,8 @@ def comm_time_per_device_s(
     if compress is None:
         compress = strategy.resolved_grad_compress()
     payload = float(n_param_bytes)
+    if m.pp > 1:
+        payload /= m.pp  # stage-sharded grads under either schedule
     if compress == "int8":
         c = _INT8_BYTES / float(grad_itemsize)
     else:
@@ -1116,26 +1825,35 @@ def comm_time_per_device_s(
         rate, lat = _axis_rate("dp")
         return 2.0 * (dp - 1) / dp * chunk * c * rate + 2 * dp * lat
 
-    if explicit and mode.kind == "zero":
+    if explicit and mode.kind in ("zero", "3d"):
         F = mode.fsdp
+        if mode.kind == "3d":
+            payload /= mode.model_shard  # tp-local buckets
+            c = 1.0  # 3d plans never compress
         rate, lat = _axis_rate("fsdp")
         fsdp_s = (F - 1) / F * payload * rate + F * lat
         return fsdp_s + _dp_legs(payload / F, mode.dp)
-    if explicit and mode.kind == "tp":
-        # tp plans never compress and sync with one flat psum per
+    if explicit and mode.kind in ("tp", "ep"):
+        # tp/ep plans never compress and sync with one flat psum per
         # bucket over the WHOLE dp axis — if dp spans DCN anywhere
         # (whole-axis or hybrid), that ring crosses it and must be
-        # billed at DCN rate (there is no two-level split on this
-        # path; plans force slices=1)
+        # billed at DCN rate (there is no two-level split on these
+        # paths; plans force slices=1)
         dp = mode.dp
         if "dp" in m.dcn_axes:
             rate, lat = model.sec_per_dcn_byte(), model.dcn_lat_s
         else:
             rate, lat = _axis_rate("dp")
+        # ep modes carry model_shard=1 (dense-majority payload whole)
         return (
             2.0 * (dp - 1) / dp * (payload / mode.model_shard) * rate
             + 2 * dp * lat
         )
+    if explicit and mode.kind == "pp":
+        # per-stage dp legs on the stage share (flat or two-level;
+        # payload is already /pp above), never compressed
+        c = 1.0
+        return _dp_legs(payload, mode.dp)
     if explicit and slices > 1:
         return _dp_legs(payload, mode.dp)
     ring = 2.0 * (n - 1) / n
@@ -1190,12 +1908,33 @@ def _measure_sync(
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if isinstance(plan, PPSyncPlan):
+        return _measure_pp_sync(plan, mesh, iters, legs)
+    if isinstance(plan, EPSyncPlan):
+        return _measure_ep_sync(plan, mesh, iters, legs)
     sh = NamedSharding(mesh, P(plan.stack_axes))
+
+    def _global_shape(i):
+        shape = plan.leaf_shapes[i]
+        dim = (
+            plan.leaf_tp_dims[i]
+            if plan.three_d and plan.leaf_tp_dims
+            else None
+        )
+        if dim is None:
+            return shape
+        # 3d plans bucket tp-LOCAL shards; the probe's inputs are
+        # global arrays (sync_grads reshards them per its in_specs)
+        return tuple(
+            d * plan.tp if j == dim else d for j, d in enumerate(shape)
+        )
+
     stacked = [
         jax.device_put(
-            jnp.zeros((plan.total,) + shape, jnp.dtype(dt)), sh
+            jnp.zeros((plan.total,) + _global_shape(i), jnp.dtype(dt)),
+            sh,
         )
-        for shape, dt in zip(plan.leaf_shapes, plan.leaf_dtypes)
+        for i, dt in enumerate(plan.leaf_dtypes)
     ]
     res = (
         zero_residual(plan, mesh) if plan.compress == "int8" else None
@@ -1203,6 +1942,10 @@ def _measure_sync(
 
     def run(tree, r):
         g, _, gn = sync_grads(tree, mesh, plan, residual=r, _legs=legs)
+        if gn is None:  # 3d plans hand the norm back to the caller
+            import optax
+
+            gn = optax.global_norm(g)
         return gn
 
     fn = jax.jit(run)
@@ -1211,6 +1954,145 @@ def _measure_sync(
     for _ in range(iters):
         t0 = time.perf_counter()
         jax.block_until_ready(fn(stacked, res))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times) * 1e3)
+
+
+def _measure_ep_sync(
+    plan: "EPSyncPlan", mesh, iters: int, legs: str = "all"
+) -> float:
+    """Standalone wall-clock of one dp x ep sync: the same
+    ``sync_local_tree`` walks the ep step runs in its manual region,
+    over zero grads (expert leaves ep-sharded, dense replicated)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from dlrover_tpu.common.jax_compat import shard_map
+
+    def _global(shape, dim):
+        return tuple(
+            d * plan.ep if j == dim else d for j, d in enumerate(shape)
+        )
+
+    expert_zeros = [
+        jnp.zeros(_global(shape, dim), jnp.dtype(dt))
+        for shape, dt, dim in zip(
+            plan.expert_plan.leaf_shapes,
+            plan.expert_plan.leaf_dtypes,
+            plan.expert_leaf_dims,
+        )
+    ]
+    dense_zeros = [
+        jnp.zeros(shape, jnp.dtype(dt))
+        for shape, dt in zip(
+            plan.dense_plan.leaf_shapes, plan.dense_plan.leaf_dtypes
+        )
+    ]
+    e_specs = []
+    for shape, dim in zip(
+        plan.expert_plan.leaf_shapes, plan.expert_leaf_dims
+    ):
+        entries: List = [None] * len(shape)
+        entries[dim] = "ep"
+        e_specs.append(P(*entries))
+
+    def body(e_leaves, d_leaves):
+        e_s, ss_e = sync_local_tree(
+            list(e_leaves), plan.expert_plan, legs=legs
+        )
+        d_s, ss_d = sync_local_tree(
+            list(d_leaves), plan.dense_plan, legs=legs
+        )
+        return jnp.sqrt(jax.lax.psum(ss_e, "ep") + ss_d)[None]
+
+    fn = jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                tuple(e_specs),
+                tuple(P() for _ in dense_zeros),
+            ),
+            out_specs=P(("dp", "ep")),
+            check_vma=False,
+        )
+    )
+    args = (tuple(expert_zeros), tuple(dense_zeros))
+    jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times) * 1e3)
+
+
+def _measure_pp_sync(
+    plan: "PPSyncPlan", mesh, iters: int, legs: str = "all"
+) -> float:
+    """Standalone wall-clock of one per-stage pipeline sync: the same
+    ``sync_local_tree`` walk the pipeline step runs in its manual
+    region, over zero grads (stage leaves pp-sharded, shared leaves
+    replicated)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dlrover_tpu.common.jax_compat import shard_map
+
+    stage_zeros = [
+        jax.device_put(
+            jnp.zeros((plan.pp,) + shape, jnp.dtype(dt)),
+            NamedSharding(mesh, P("pp")),
+        )
+        for shape, dt in zip(
+            plan.stage_plan.leaf_shapes, plan.stage_plan.leaf_dtypes
+        )
+    ]
+    shared_zeros = [
+        jnp.zeros(shape, jnp.dtype(dt))
+        for shape, dt in zip(
+            plan.shared_plan.leaf_shapes, plan.shared_plan.leaf_dtypes
+        )
+    ]
+
+    def body(stage_leaves, shared_leaves):
+        stage_loc = [l[0] for l in stage_leaves]
+        s_synced, ss = sync_local_tree(
+            list(stage_loc), plan.stage_plan, legs=legs
+        )
+        h_synced, hs = sync_local_tree(
+            list(shared_leaves), plan.shared_plan, legs=legs
+        )
+        gn = jnp.sqrt(
+            jax.lax.psum(ss, ("pp", "dp")) / plan.dp + hs
+        )
+        return gn[None]
+
+    fn = jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                tuple(P("pp") for _ in stage_zeros),
+                tuple(P() for _ in shared_zeros),
+            ),
+            out_specs=P(("pp", "dp")),
+            check_vma=False,
+        )
+    )
+    jax.block_until_ready(fn(tuple(stage_zeros), tuple(shared_zeros)))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(
+            fn(tuple(stage_zeros), tuple(shared_zeros))
+        )
         times.append(time.perf_counter() - t0)
     return float(np.median(times) * 1e3)
 

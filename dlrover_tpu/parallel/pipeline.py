@@ -402,6 +402,24 @@ def schedule_occupancy(pp: int, M: int, virtual: int = 1):
     return n_ticks, busy, 2 * pp * n_ticks
 
 
+def _shared_grads(cfg: TransformerConfig, ghead: Any, gemb: Any) -> Any:
+    """Combine head/embed grads into the tree ``plan_for_pipeline``'s
+    shared plan was built over (the non-stage keys of
+    ``stack_pipeline_params``' output): {"embed", "final_norm"
+    [, "lm_head"]}, with the tied-embedding head contribution folded
+    into the embed leaf."""
+    if cfg.tie_embeddings:
+        embed = jax.tree_util.tree_map(
+            jnp.add, gemb, ghead["embed"]
+        )
+        return {"embed": embed, "final_norm": ghead["final_norm"]}
+    return {
+        "embed": gemb,
+        "final_norm": ghead["final_norm"],
+        "lm_head": ghead["lm_head"],
+    }
+
+
 def pipeline_value_and_grad_1f1b(
     pparams: Any,
     tokens: jnp.ndarray,
@@ -410,8 +428,20 @@ def pipeline_value_and_grad_1f1b(
     mesh,
     num_microbatches: int,
     virtual: int = 1,
+    sync_plan=None,
 ) -> Tuple[jnp.ndarray, Any]:
     """(loss, grads) under the 1F1B schedule; grads congruent to pparams.
+
+    ``sync_plan`` (a ``grad_sync.PPSyncPlan``, pp x dp meshes only):
+    the explicit per-stage sync path — the region goes manual over
+    (pp, dp), each dp rank runs the schedule on its ``mb/dp`` rows
+    and accumulates LOCAL grads, and the moment the scan drains each
+    stage's grads are bucket-synced over its dp sub-axis inside the
+    region (``grad_sync.sync_local_tree``): independent per-stage
+    collectives XLA schedules into the fill/drain bubble instead of
+    GSPMD's post-drain monolithic all-reduce. Returns
+    ``(loss, grads, grad_norm)`` in this mode (the norm falls out of
+    the bucket walk).
 
     Tick clock (``virtual=1``): stage i runs forward of microbatch j at
     tick ``i + j`` and backward of microbatch j at tick ``2(P-1) - i + j``
@@ -466,6 +496,14 @@ def pipeline_value_and_grad_1f1b(
     if B % M != 0:
         raise ValueError(f"batch {B} must divide into {M} microbatches")
     mb = B // M
+    dp = mesh.shape.get("dp", 1)
+    local_dp = sync_plan is not None and dp > 1
+    if local_dp and mb % dp:
+        raise ValueError(
+            f"explicit pp sync needs the microbatch ({mb}) to divide "
+            f"over dp={dp} (each rank runs the schedule on its rows)"
+        )
+    mb_loc = mb // dp if local_dp else mb
     D = cfg.model_dim
 
     head_params = {"final_norm": pparams["final_norm"]}
@@ -548,7 +586,9 @@ def pipeline_value_and_grad_1f1b(
         bwd_perm = [((i + 1) % pp, i) for i in range(pp)]
 
         def vary(a):
-            return pcast(a, ("pp",), to="varying")
+            return pcast(
+                a, ("pp", "dp") if local_dp else ("pp",), to="varying"
+            )
 
         tok_loc = vary(tok_all)
         tgt_loc = vary(tgt_all)
@@ -568,11 +608,11 @@ def pipeline_value_and_grad_1f1b(
             )
 
         act_dt = jnp.dtype(cfg.dtype)
-        zeros_mb = vary(jnp.zeros((mb, T, D), act_dt))
+        zeros_mb = vary(jnp.zeros((mb_loc, T, D), act_dt))
         carry0 = (
             zeros_mb,  # act: activation arriving from the previous stage
             zeros_mb,  # gin: cotangent arriving from the next stage
-            vary(jnp.zeros((buf_n, mb, T, D), act_dt)),
+            vary(jnp.zeros((buf_n, mb_loc, T, D), act_dt)),
             jax.tree_util.tree_map(jnp.zeros_like, stages_loc),
             jax.tree_util.tree_map(jnp.zeros_like, head_loc),
             jax.tree_util.tree_map(jnp.zeros_like, emb_loc),
@@ -715,9 +755,45 @@ def pipeline_value_and_grad_1f1b(
         gemb_out = jax.tree_util.tree_map(
             lambda g: lax.psum(g, "pp"), gemb
         )
+        if local_dp:
+            # the explicit per-stage sync, INSIDE the manual region:
+            # this stage's dp sub-axis collectives are issued the
+            # moment its grads are complete — independent ops the
+            # scheduler packs into the drain bubble
+            from dlrover_tpu.parallel.grad_sync import sync_local_tree
+
+            shared = _shared_grads(cfg, ghead_out, gemb_out)
+            gstage_s, ss_st = sync_local_tree(
+                gstage, sync_plan.stage_plan
+            )
+            shared_s, ss_sh = sync_local_tree(
+                shared, sync_plan.shared_plan
+            )
+            gnorm = jnp.sqrt(lax.psum(ss_st, "pp") + ss_sh)
+            gstage_out = jax.tree_util.tree_map(
+                lambda g: g[None], gstage_s
+            )
+            return (
+                gstage_out,
+                shared_s,
+                lax.pmean(loss_out, "dp"),
+                gnorm,
+            )
         gstage_out = jax.tree_util.tree_map(lambda g: g[None], gstage)
         return gstage_out, ghead_out, gemb_out, loss_out
 
+    if local_dp:
+        gstage, shared, loss, gnorm = shard_map(
+            pipelined,
+            mesh=mesh,
+            in_specs=(P("pp"), P(), P(), P(None, "dp"), P(None, "dp")),
+            out_specs=(P("pp"), P(), P(), P()),
+            axis_names={"pp", "dp"},
+            check_vma=False,
+        )(pparams["stages"], head_params, emb_params, tok, tgt)
+        grads = dict(shared)
+        grads["stages"] = gstage
+        return loss, grads, gnorm
     gstage, ghead, gemb, loss = shard_map(
         pipelined,
         mesh=mesh,
@@ -747,6 +823,174 @@ def pipeline_value_and_grad_1f1b(
     else:
         grads["lm_head"] = ghead["lm_head"]
     return loss, grads
+
+
+def pipeline_value_and_grad_gpipe_sync(
+    pparams: Any,
+    tokens: jnp.ndarray,
+    targets: jnp.ndarray,
+    cfg: TransformerConfig,
+    mesh,
+    num_microbatches: int,
+    sync_plan,
+) -> Tuple[jnp.ndarray, Any, jnp.ndarray]:
+    """(loss, grads, grad_norm) under the GPipe schedule with the
+    explicit per-stage dp sync (pp x dp meshes, ``PPSyncPlan``).
+
+    The region is fully manual over (pp, dp): each dp rank runs the
+    same M+P-1 tick rotation ``pipeline_forward`` uses — embedding
+    and head INSIDE the region on its ``mb/dp`` rows — and
+    reverse-mode AD through the scan-of-ppermute yields the backward
+    rotation, producing per-rank LOCAL grads (no GSPMD dp psum).
+    Each stage's grads are then bucket-synced over its dp sub-axis
+    in the region (``grad_sync.sync_local_tree``): per-stage
+    independent reduce-scatter/all-gather pairs the scheduler can
+    start during the drain, instead of one post-drain monolithic
+    all-reduce over the whole tree."""
+    pp = mesh.shape["pp"]
+    dp = mesh.shape.get("dp", 1)
+    M = num_microbatches
+    _check_pipeline_cfg(cfg, pp, 1)
+    if mesh.shape.get("sp", 1) > 1:
+        raise ValueError("sp (ring attention) inside pp stages not supported")
+    B, T = tokens.shape
+    if B % M != 0:
+        raise ValueError(f"batch {B} must divide into {M} microbatches")
+    mb = B // M
+    if dp > 1 and mb % dp:
+        raise ValueError(
+            f"explicit pp sync needs the microbatch ({mb}) to divide "
+            f"over dp={dp}"
+        )
+    mb_loc = mb // max(dp, 1)
+    D = cfg.model_dim
+
+    head_params = {"final_norm": pparams["final_norm"]}
+    if cfg.tie_embeddings:
+        head_params["embed"] = pparams["embed"]
+    else:
+        head_params["lm_head"] = pparams["lm_head"]
+    emb_params = pparams["embed"]
+
+    mb_axes = _microbatch_axes(mesh, mb)
+    tok = lax.with_sharding_constraint(
+        tokens.reshape(M, mb, T),
+        NamedSharding(mesh, P(None, mb_axes)),
+    )
+    tgt = lax.with_sharding_constraint(
+        targets.reshape(M, mb, T),
+        NamedSharding(mesh, P(None, mb_axes)),
+    )
+
+    def block(xx, layer):
+        positions = jnp.broadcast_to(jnp.arange(T), xx.shape[:2])
+        xx = _attention_block(xx, layer, cfg, None, positions)
+        xx, _ = _mlp_block(xx, layer, cfg, None)
+        return xx
+
+    def stage_fn(stage_layers, xx):
+        def body(xx, layer):
+            return block(xx, layer), None
+
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        xx, _ = lax.scan(body, xx, stage_layers)
+        return xx
+
+    def pipelined(stages, head_p, emb_p, tok_all, tgt_all):
+        from dlrover_tpu.parallel.grad_sync import sync_local_tree
+
+        stages_loc = jax.tree_util.tree_map(lambda a: a[0], stages)
+        idx = lax.axis_index("pp")
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def vary(a):
+            return pcast(a, ("pp", "dp"), to="varying")
+
+        tok_loc = vary(tok_all)
+        tgt_loc = vary(tgt_all)
+        head_loc = jax.tree_util.tree_map(vary, head_p)
+        emb_loc = jax.tree_util.tree_map(vary, emb_p)
+        act_dt = jnp.dtype(cfg.dtype)
+        last = idx == pp - 1
+
+        def local_loss(stages_l, head_l, emb_l):
+            x_all = embed_tokens(
+                {"embed": emb_l}, tok_loc, cfg
+            ).astype(act_dt)  # [M, mb_loc, T, D]
+            carry0 = (
+                jnp.zeros((mb_loc, T, D), act_dt),
+                jnp.zeros((M, mb_loc, T, D), act_dt),
+            )
+
+            def tick(carry, t):
+                st, outputs = carry
+                inject = lax.dynamic_index_in_dim(
+                    x_all, jnp.minimum(t, M - 1), 0, keepdims=False
+                )
+                cur = jnp.where(idx == 0, inject, st)
+                out = stage_fn(stages_l, cur)
+                oi = t - (pp - 1)
+                write = last & (oi >= 0)
+                upd = lax.dynamic_update_index_in_dim(
+                    outputs, out, jnp.clip(oi, 0, M - 1), 0
+                )
+                outputs = jnp.where(write, upd, outputs)
+                if pp > 1:
+                    st = lax.ppermute(out, "pp", perm)
+                else:
+                    st = out
+                return (st, outputs), None
+
+            (_, outputs), _ = lax.scan(
+                tick, carry0, jnp.arange(M + pp - 1)
+            )
+            y = outputs.reshape(M * mb_loc, T, D)
+            t_flat = tgt_loc.reshape(M * mb_loc, T)
+            loss_local = token_nll(lm_head(head_l, y, cfg), t_flat)
+            # only the last stage's outputs are real. The psum that
+            # shares the scalar happens OUTSIDE the AD below: psum
+            # transposes to psum, which would hand every rank a
+            # pp-scaled cotangent; seeding ct=1 on each rank's MASKED
+            # local loss is the correct seed (non-last ranks' zeros
+            # contribute nothing, and their params' influence arrives
+            # through the ppermute transpose)
+            return loss_local * last.astype(jnp.float32)
+
+        loss_l, (dstage, dhead, demb) = jax.value_and_grad(
+            local_loss, argnums=(0, 1, 2)
+        )(stages_loc, head_loc, emb_loc)
+        loss = lax.psum(loss_l, "pp")  # selection, not averaging
+        # head grads live only on the last stage, embed-gather grads
+        # only on stage 0 (masked zeros elsewhere): psum = selection
+        dhead = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, "pp"), dhead
+        )
+        demb = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, "pp"), demb
+        )
+        shared = _shared_grads(cfg, dhead, demb)
+        gstage_s, ss_st = sync_local_tree(dstage, sync_plan.stage_plan)
+        shared_s, ss_sh = sync_local_tree(
+            shared, sync_plan.shared_plan
+        )
+        gnorm = jnp.sqrt(lax.psum(ss_st, "pp") + ss_sh)
+        gstage_out = jax.tree_util.tree_map(
+            lambda g: g[None], gstage_s
+        )
+        return gstage_out, shared_s, lax.pmean(loss, "dp"), gnorm
+
+    gstage, shared, loss, gnorm = shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(P("pp"), P(), P(), P(None, "dp"), P(None, "dp")),
+        out_specs=(P("pp"), P(), P(), P()),
+        axis_names={"pp", "dp"},
+        check_vma=False,
+    )(pparams["stages"], head_params, emb_params, tok, tgt)
+    grads = dict(shared)
+    grads["stages"] = gstage
+    return loss, grads, gnorm
 
 
 # ---------------------------------------------------------------------------
@@ -794,6 +1038,9 @@ def build_pipeline_train_step(
     donate: bool = True,
     schedule: str = "gpipe",
     virtual_stages: int = 2,
+    comm_overlap: bool = False,
+    grad_bucket_mb: int = 4,
+    grad_slices: int = 1,
 ):
     """jitted (state, tokens, targets) → (state, metrics) over pp.
 
@@ -802,7 +1049,16 @@ def build_pipeline_train_step(
     (1F1B with ``virtual_stages`` chunks per device — smaller bubble,
     O(vP) footprint; state must come from
     ``init_pipeline_state(..., virtual=virtual_stages)``).
-    """
+
+    ``comm_overlap``: the explicit per-stage gradient sync for
+    pp x dp meshes (``grad_sync.plan_for_pipeline``) — each stage's
+    dp sync runs as independent bucketed collectives scheduled into
+    the pipeline bubble instead of GSPMD's post-drain monolithic
+    all-reduce; all three schedules are covered. Meshes that don't
+    qualify (pp composed with fsdp/tp/sp/ep, or dp=1) fall back to
+    the GSPMD schedule with a once-per-mesh log naming the axes.
+    ``grad_slices`` threads a hybrid dp axis's DCN slice count
+    (two-level dp legs)."""
     import optax
 
     if schedule not in ("gpipe", "1f1b", "interleaved"):
@@ -811,8 +1067,51 @@ def build_pipeline_train_step(
     if schedule == "interleaved" and virtual < 2:
         raise ValueError("interleaved schedule needs virtual_stages >= 2")
 
+    sync_plan = None
+    if comm_overlap:
+        from dlrover_tpu.parallel.grad_sync import (
+            note_gspmd_fallback,
+            plan_for_pipeline,
+        )
+
+        from dlrover_tpu.parallel.grad_sync import fallback_reason
+
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        sync_plan = plan_for_pipeline(
+            cfg,
+            sizes,
+            grad_bucket_mb=grad_bucket_mb,
+            slices=grad_slices,
+            schedule=schedule,
+            virtual=virtual,
+        )
+        if sync_plan is None:
+            # the mesh may QUALIFY (kind "pp") while the MODEL cannot
+            # pipeline at this degree — fallback_reason is empty then,
+            # so name the actual cause instead of logging a reasonless
+            # fallback for a "supported" mesh
+            reason = fallback_reason(sizes) or (
+                f"num_layers={cfg.num_layers} does not divide into "
+                f"pp={sizes.get('pp')} x virtual={virtual} stages "
+                f"(or the model cannot pipeline at all)"
+            )
+            note_gspmd_fallback(sizes, reason=reason)
+
     def train_step(state: TrainState, tokens, targets):
-        if schedule in ("1f1b", "interleaved"):
+        gnorm = None
+        if sync_plan is not None:
+            if schedule in ("1f1b", "interleaved"):
+                loss, grads, gnorm = pipeline_value_and_grad_1f1b(
+                    state.params, tokens, targets, cfg, mesh,
+                    num_microbatches, virtual=virtual,
+                    sync_plan=sync_plan,
+                )
+            else:
+                loss, grads, gnorm = pipeline_value_and_grad_gpipe_sync(
+                    state.params, tokens, targets, cfg, mesh,
+                    num_microbatches, sync_plan,
+                )
+        elif schedule in ("1f1b", "interleaved"):
             loss, grads = pipeline_value_and_grad_1f1b(
                 state.params, tokens, targets, cfg, mesh,
                 num_microbatches, virtual=virtual,
@@ -831,7 +1130,14 @@ def build_pipeline_train_step(
             TrainState(
                 step=state.step + 1, params=new_params, opt_state=new_opt
             ),
-            {"loss": loss, "grad_norm": optax.global_norm(grads)},
+            {
+                "loss": loss,
+                "grad_norm": (
+                    gnorm
+                    if gnorm is not None
+                    else optax.global_norm(grads)
+                ),
+            },
         )
 
     donate_argnums = (0,) if donate else ()

@@ -544,6 +544,28 @@ def bucket_bytes_for(
     return max(_BUCKET_MIN_BYTES, min(_BUCKET_MAX_BYTES, b))
 
 
+def alltoall_time_s(
+    nbytes: int,
+    n: int,
+    model: Optional[LinkModel] = None,
+    dcn: bool = False,
+) -> float:
+    """Seconds of one all-to-all over an ``n``-device group where each
+    device holds ``nbytes`` of payload: ``(n-1)/n`` of it leaves the
+    device, at the ICI rate (or DCN when the group crosses slices) plus
+    one collective's latency. The MoE dispatch/combine legs
+    (``parallel/moe.py``) are priced through here so the dry-runner's
+    est_step_s sees the same link physics the gradient collectives are
+    priced with."""
+    if n <= 1 or nbytes <= 0:
+        return 0.0
+    m = model if model is not None else get_link_model()
+    note_fallback_use(m)
+    rate = m.sec_per_dcn_byte() if dcn else m.sec_per_ici_byte()
+    lat = m.dcn_lat_s if dcn else m.ici_lat_s
+    return (n - 1) / n * nbytes * rate + n * lat
+
+
 # -- heterogeneous per-slice throughput weighting -----------------------------
 
 

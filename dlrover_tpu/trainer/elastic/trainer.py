@@ -115,6 +115,21 @@ class TrainerConfig:
     # measured topology.LinkModel (DCN-leg target on multi-slice
     # meshes, ICI otherwise)
     grad_bucket_mb: int = 4
+    # micro-batch rebalance on indivisible worker counts (ISSUE 13):
+    # instead of idling surplus ranks, pad the batch with zero-weight
+    # rows so it divides over ALL ranks — the dry-runner prices both
+    # options (accel/dry_runner.price_rebalance_options) and the
+    # cheaper wins; the pads land on the trailing ranks (the elastic
+    # data layer's slice_throughput_weights dealing already skews the
+    # REAL rows toward the faster slices). grad_accum>1 keeps the
+    # idle-ranks behavior (pads would multiply across microbatches).
+    mb_rebalance: bool = True
+    # >0: every this many steps, fold the measured per-expert routing
+    # load (moe_expert_load) into the CapacityRebalancer and — when
+    # the re-split changed — rebuild the step with the new
+    # cfg.capacity_splits (a recompile through the AOT cache,
+    # amortized over the interval). 0 = static capacity_factor.
+    moe_rebalance_interval: int = 0
     # -- eviction grace-window drain -----------------------------------
     # default grace window (seconds) for an eviction notice that does
     # not carry its own (SIGTERM, an `evict` command with arg=0);
@@ -398,6 +413,22 @@ class ElasticTrainer:
             self.install_eviction_handler()
         self.state = self.accel.init_fn(jax.random.PRNGKey(0))
         self._grad_sync_plan = None
+        # MoE capacity rebalancer (ISSUE 13): folds the measured
+        # per-expert routing load into a periodic capacity re-split
+        # (cfg.capacity_splits) — each applied re-split is a step
+        # rebuild through the AOT cache
+        self._moe_rebalancer = None
+        if (
+            self._model_cfg.num_experts
+            and self.tcfg.moe_rebalance_interval > 0
+        ):
+            from dlrover_tpu.parallel.moe import CapacityRebalancer
+
+            self._moe_rebalancer = CapacityRebalancer(
+                self._model_cfg.num_experts,
+                capacity_factor=self._model_cfg.capacity_factor,
+                top_k=self._model_cfg.moe_top_k,
+            )
         # measured link-cost model (parallel/topology.py): probe once
         # per device fingerprint (warm restarts hit the JSON cache);
         # the dry-runner and the auto bucket sizer price wire time
@@ -598,6 +629,65 @@ class ElasticTrainer:
                 )
         logger.info(f"grad sync: {plan.describe()}")
 
+    def _maybe_rebalance_experts(self, load) -> bool:
+        """Fold one measured per-expert routing-load vector into the
+        ``CapacityRebalancer``; when the re-split changed, rebuild the
+        train step with the new ``cfg.capacity_splits`` (static
+        shapes — one recompile through the AOT cache, amortized over
+        ``moe_rebalance_interval``). Returns True when a re-split was
+        applied."""
+        from dataclasses import replace as dc_replace
+
+        reb = self._moe_rebalancer
+        if reb is None:
+            return False
+        reb.observe(np.asarray(load))
+        m = self.accel.strategy.mesh
+        shards = max(m.dp * m.fsdp * m.sp, 1)
+        tokens = max(
+            1, self.tcfg.batch_size * self.tcfg.seq_len // shards
+        )
+        splits = reb.splits(tokens)
+        if tuple(splits) == tuple(self._model_cfg.capacity_splits):
+            return False
+        self._model_cfg = dc_replace(
+            self._model_cfg, capacity_splits=splits
+        )
+        logger.info(
+            f"moe capacity re-split #"
+            f"{self.pipeline_stats.moe_capacity_resplits + 1}: "
+            f"{splits} (load EMA "
+            f"{np.round(reb.load, 3).tolist()}); rebuilding the step"
+        )
+        devices = list(self.mesh.devices.flatten())
+        accel = auto_accelerate(
+            self._model_cfg,
+            self._tx,
+            batch=self.tcfg.batch_size,
+            seq=self.tcfg.seq_len,
+            devices=devices,
+            strategy=self.accel.strategy,
+            donate=False,
+            grad_accum=self.tcfg.grad_accum,
+        )
+        self.accel = accel
+        self.cfg = accel.cfg
+        self._step_fn = accel.step_fn
+        self._donating_step_fn = (
+            accel.donating_step_fn
+            if self.tcfg.donation_aware
+            else None
+        )
+        self._eval_step_fn = None
+        self._aot_exec = self._aot_shapes = None
+        self._aot_primed = False
+        self.pipeline_stats.moe_capacity_resplits += 1
+        self._registry.gauge(
+            "dlrover_moe_capacity_resplits",
+            "applied MoE capacity re-splits",
+        ).set(float(self.pipeline_stats.moe_capacity_resplits))
+        return True
+
     def measure_realized_overlap(self, iters: int = 3) -> Optional[float]:
         """A/B-measure how much of the sync's wire time the scheduler
         actually hides. The baseline twin uses GSPMD's monolithic
@@ -625,12 +715,12 @@ class ElasticTrainer:
         s = self.accel.strategy
         base_step = build_train_step(
             self.cfg, self.mesh, self._tx, donate=False,
-            grad_accum=s.grad_accum,
+            grad_accum=s.grad_accum, batch_pad=s.batch_pad,
         )
         rng = np.random.default_rng(0)
         x = rng.integers(
             0, self.cfg.vocab_size,
-            (self.tcfg.batch_size, self.tcfg.seq_len),
+            (self.tcfg.batch_size + s.batch_pad, self.tcfg.seq_len),
         ).astype(np.int32)
         b = shard_batch({"x": x, "y": x}, self.mesh)
 
@@ -984,11 +1074,32 @@ class ElasticTrainer:
     def global_step(self) -> int:
         return int(self.state.step)
 
-    def _device_batch(self, batch):
+    def _device_batch(self, batch, for_eval: bool = False):
         if isinstance(batch, dict):
             bx, by = batch["x"], batch["y"]
         else:  # tuple/list samples from the default collate
             bx, by = batch[0], batch[1]
+        pad = self.accel.strategy.batch_pad
+        if pad and for_eval:
+            # the eval loss takes no row weights, so zero-pad rows
+            # would bias it (and save-best/early-stopping built on
+            # it); TRIM to the largest shardable row count instead —
+            # unbiased, a few samples lighter
+            m = self.accel.strategy.mesh
+            shards = max(m.dp * m.fsdp, 1)
+            n = (int(np.asarray(bx).shape[0]) // shards) * shards
+            if n > 0:
+                bx = np.asarray(bx)[:n]
+                by = np.asarray(by)[:n]
+        elif pad:
+            # micro-batch rebalance: zero rows appended so the batch
+            # divides over ALL ranks; the step's pad_row_weights zero
+            # them out of the loss, so gradients match the real batch
+            from dlrover_tpu.models.train import pad_batch_rows
+
+            n = int(np.asarray(bx).shape[0]) + pad
+            bx = pad_batch_rows(bx, n)
+            by = pad_batch_rows(by, n)
         if self.accel.strategy.mesh.pp > 1:
             return bx, by  # pipeline step takes host arrays
         sharded = shard_batch({"x": bx, "y": by}, self.mesh)
@@ -1074,7 +1185,7 @@ class ElasticTrainer:
         max_batches = max_batches or self.tcfg.eval_steps
         losses = []
         for batch in self._eval_batches(max_batches):
-            x, y = self._device_batch(batch)
+            x, y = self._device_batch(batch, for_eval=True)
             losses.append(float(self._eval_step_fn(self.state.params, x, y)))
         if not losses:
             # a silent NaN here would poison every later metrics report
@@ -1218,16 +1329,24 @@ class ElasticTrainer:
             os.getenv(NodeEnv.JOB_NAME, ""),
         )
 
-    def _batch_specs(self, mesh):
-        """Abstract (x, y) for AOT lowering on ``mesh``, from the batch
-        avals recorded at the first real step."""
+    def _batch_specs(self, mesh, strategy=None):
+        """Abstract (x, y) for AOT lowering on ``mesh``, from the REAL
+        batch avals recorded at the first step — re-padded for the
+        target ``strategy``'s micro-batch rebalance (batch_pad differs
+        per world, so the same real batch lowers to different physical
+        shapes on different strategies)."""
         import jax
 
         from dlrover_tpu.parallel.mesh import batch_sharding
 
+        pad = int(getattr(strategy, "batch_pad", 0) or 0)
         sh = batch_sharding(mesh)
         return tuple(
-            jax.ShapeDtypeStruct(shape, np.dtype(dt), sharding=sh)
+            jax.ShapeDtypeStruct(
+                (shape[0] + pad,) + tuple(shape[1:]),
+                np.dtype(dt),
+                sharding=sh,
+            )
             for shape, dt in self._batch_avals
         )
 
@@ -1239,12 +1358,17 @@ class ElasticTrainer:
 
     def _record_batch_avals(self, x, y):
         """Shapes/dtypes of the live batch — speculative compiles for
-        other meshes lower against these."""
+        other meshes lower against these. Recorded at the REAL row
+        count: a rebalanced strategy's zero-weight pad rows are its
+        own physical artifact (``_batch_specs`` re-pads per target
+        strategy)."""
+        pad = int(getattr(self.accel.strategy, "batch_pad", 0) or 0)
         try:
             self._batch_avals = tuple(
-                (tuple(b.shape), str(b.dtype)) for b in (x, y)
+                ((int(b.shape[0]) - pad,) + tuple(b.shape[1:]), str(b.dtype))
+                for b in (x, y)
             )
-        except (AttributeError, TypeError):
+        except (AttributeError, TypeError, IndexError):
             pass
 
     def _prime_step_cache(self, x, y):
@@ -1432,24 +1556,115 @@ class ElasticTrainer:
             grad_bucket_mb=s.grad_bucket_mb,
         )
 
+    def _rebalanced_strategy_for(
+        self, n_devices: int
+    ) -> Optional[Strategy]:
+        """Micro-batch-rebalanced strategy using ALL ``n_devices`` on
+        an indivisible count: the data axes absorb the delta and the
+        batch is padded with ``batch_pad`` zero-weight rows so it
+        divides (heavier ranks effectively take one extra micro-batch
+        row; the pads land on the trailing ranks and carry loss
+        weight 0, so gradients are those of the real batch). None
+        when the count is exactly divisible (the exact path owns it),
+        the model axes don't divide ``n_devices``, or the trainer
+        runs grad_accum (pads would multiply across microbatches)."""
+        from dataclasses import replace as dc_replace
+
+        if not self.tcfg.mb_rebalance or self.tcfg.grad_accum > 1:
+            return None
+        if self._model_cfg.num_experts:
+            # pad rows would contaminate the router's aux losses (see
+            # build_train_step's batch_pad guard)
+            return None
+        s = self.accel.strategy
+        m = s.mesh
+        fixed = m.tp * m.sp * m.ep * m.pp
+        if n_devices <= 0 or n_devices % fixed or m.pp > 1:
+            return None
+        rem = n_devices // fixed
+        if m.fsdp == 1:
+            dp, fsdp = rem, 1
+        elif m.dp == 1:
+            dp, fsdp = 1, rem
+        else:
+            fsdp = min(m.fsdp, rem)
+            while rem % fsdp:
+                fsdp -= 1
+            dp = rem // fsdp
+        shards = dp * fsdp
+        pad = (-self.tcfg.batch_size) % shards
+        if pad == 0:
+            return None  # divisible: _strategy_for_exact handles it
+        return dc_replace(
+            s,
+            mesh=dc_replace(m, dp=dp, fsdp=fsdp),
+            batch_pad=pad,
+        )
+
     def _strategy_for(self, n_devices: int) -> Strategy:
-        """Strategy for a resized world, degrading gracefully: a
-        non-divisible count (e.g. 6 of 8 devices at batch 8) no longer
-        fails the resize with a ValueError — the largest valid mesh
-        <= ``n_devices`` wins and the surplus ranks sit idle;
-        ``resize`` trims the device list, logs the warning and sets
-        the ``dlrover_resize_idle_ranks`` gauge (NOT set here — this
-        is also the speculative-compile path, and a hypothetical
-        candidate must not corrupt the live metric). The descending
-        scan is pure-Python candidate enumeration (no compiles), so
-        even an exhaustive miss costs milliseconds. Raises a clear
-        ValueError only when NO device count down to 1 admits a valid
-        mesh (never a crash deep inside ``build_mesh``)."""
+        """Strategy for a resized world, degrading gracefully: on a
+        non-divisible count (e.g. 6 of 8 devices at batch 16) the
+        trainer prices BOTH alternatives through the dry-runner —
+        (a) the largest valid mesh <= ``n_devices`` with the surplus
+        ranks idle, and (b) the micro-batch rebalance using every
+        rank with a padded batch (``_rebalanced_strategy_for``) —
+        and the cheaper wins. ``resize`` trims the device list, logs
+        the choice and sets the ``dlrover_resize_idle_ranks`` /
+        ``dlrover_resize_mb_pad`` gauges (NOT set here — this is also
+        the speculative-compile path, and a hypothetical candidate
+        must not corrupt the live metric). The descending scan is
+        pure-Python candidate enumeration (no compiles), so even an
+        exhaustive miss costs milliseconds. Raises a clear ValueError
+        only when NO device count down to 1 admits a valid mesh
+        (never a crash deep inside ``build_mesh``)."""
+        from dataclasses import replace as dc_replace
+
         for n in range(n_devices, 0, -1):
             s = self._strategy_for_exact(n)
             if s is None:
                 continue
+            # the current strategy may carry a pad from a previous
+            # rebalance; an exact fit needs none
+            if s.batch_pad:
+                s = dc_replace(s, batch_pad=0)
             if n < n_devices:
+                reb = self._rebalanced_strategy_for(n_devices)
+                if reb is not None:
+                    from dlrover_tpu.accel.dry_runner import (
+                        price_rebalance_options,
+                    )
+
+                    measured = (
+                        self._step_time_sum / self._step_time_n
+                        if self._step_time_n
+                        else None
+                    )
+                    idle_s, reb_s = price_rebalance_options(
+                        self._model_cfg,
+                        self.tcfg.batch_size,
+                        self.tcfg.seq_len,
+                        s,
+                        reb,
+                        measured_step_s=measured,
+                        current_strategy=self.accel.strategy,
+                    )
+                    if reb_s < idle_s:
+                        logger.info(
+                            f"micro-batch rebalance: padding the "
+                            f"batch by {reb.batch_pad} rows to use "
+                            f"all {n_devices} devices "
+                            f"({reb.mesh.axis_sizes()}, est "
+                            f"{reb_s * 1e3:.2f} ms/step) instead of "
+                            f"idling {n_devices - n} rank(s) "
+                            f"(est {idle_s * 1e3:.2f} ms/step)"
+                        )
+                        return reb
+                    logger.info(
+                        f"micro-batch rebalance priced out (pad "
+                        f"{reb.batch_pad} rows, est "
+                        f"{reb_s * 1e3:.2f} ms/step vs idle "
+                        f"{idle_s * 1e3:.2f}); degrading instead"
+                    )
                 logger.info(
                     f"no valid mesh factorization uses all "
                     f"{n_devices} devices at batch="
@@ -1533,11 +1748,23 @@ class ElasticTrainer:
                 f"({strategy.mesh.axis_sizes()}), leaving "
                 f"{idle_ranks} rank(s) idle"
             )
+        if strategy.batch_pad:
+            logger.info(
+                f"resize: micro-batch rebalance active — batch padded "
+                f"by {strategy.batch_pad} zero-weight rows so "
+                f"{strategy.mesh.axis_sizes()} uses every rank "
+                f"(resize_idle_ranks=0)"
+            )
         self.pipeline_stats.resize_idle_ranks = idle_ranks
+        self.pipeline_stats.resize_mb_pad = strategy.batch_pad
         self._registry.gauge(
             "dlrover_resize_idle_ranks",
             "devices left idle by resize degradation",
         ).set(float(idle_ranks))
+        self._registry.gauge(
+            "dlrover_resize_mb_pad",
+            "zero-weight pad rows/step of the micro-batch rebalance",
+        ).set(float(strategy.batch_pad))
         # a resize is a DELIBERATE stall: the hang watchdog must not
         # dump forensics of a cold compile that is working as designed
         # (cleared on success below; a raise lets the window lapse — a
@@ -1686,7 +1913,7 @@ class ElasticTrainer:
         self._aot_exec = self._aot_shapes = None
         if self._batch_avals is not None:
             with span("resize_compile") as compile_sp:
-                xy = self._batch_specs(accel.mesh)
+                xy = self._batch_specs(accel.mesh, strategy)
                 key = self._step_cache_key(
                     strategy, accel.mesh, new_state, xy
                 )
@@ -1837,7 +2064,7 @@ class ElasticTrainer:
             # tree — the pre-lowered executable (and its cache key)
             # must see the same tree or the resize can never hit it
             spec = dc_replace(spec, grad_residual=residual_spec(plan, mesh))
-        xy = self._batch_specs(mesh)
+        xy = self._batch_specs(mesh, cand)
         key = self._step_cache_key(cand, mesh, spec, xy)
 
         def build():
@@ -2068,6 +2295,15 @@ class ElasticTrainer:
                     self._advance_stager()
                     if self._metrics_hook is not None:
                         self._metrics_hook(step, metrics)
+                    if (
+                        self._moe_rebalancer is not None
+                        and step % self.tcfg.moe_rebalance_interval
+                        == 0
+                        and "moe_expert_load" in metrics
+                    ):
+                        self._maybe_rebalance_experts(
+                            metrics["moe_expert_load"]
+                        )
                     if step % self.tcfg.log_interval == 0:
                         # the only host sync in the loop: loss is
                         # materialized at log cadence, not every step

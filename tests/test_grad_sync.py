@@ -563,15 +563,32 @@ class TestStrategyPlumbing:
         assert resolve_plan(
             cfg, Strategy(mesh=MeshConfig(dp=2))
         ) is None  # not requested
-        # pp/ep and 3D meshes keep the GSPMD schedule
+        # ISSUE 13: pp x dp and 3D meshes now get explicit plans; a
+        # model that cannot pipeline at the degree (1 layer over pp=2)
+        # still falls back
         assert resolve_plan(
             cfg,
             Strategy(mesh=MeshConfig(dp=2, pp=2), comm_overlap=True),
         ) is None
-        assert resolve_plan(
+        from dlrover_tpu.parallel.grad_sync import PPSyncPlan
+
+        ppp = resolve_plan(
+            tiny(num_layers=2),
+            Strategy(mesh=MeshConfig(dp=2, pp=2), comm_overlap=True),
+        )
+        assert isinstance(ppp, PPSyncPlan) and ppp.pp == 2
+        d3 = resolve_plan(
             cfg,
             Strategy(
                 mesh=MeshConfig(dp=2, fsdp=2, tp=2), comm_overlap=True
+            ),
+        )
+        assert d3 is not None and d3.three_d and d3.tp == 2
+        # a pp x ep composition stays GSPMD (the remaining exotica)
+        assert resolve_plan(
+            tiny(num_layers=2, num_experts=2),
+            Strategy(
+                mesh=MeshConfig(dp=2, pp=2, ep=2), comm_overlap=True
             ),
         ) is None
         plan = resolve_plan(
@@ -648,11 +665,11 @@ class TestDryRunnerCommCost:
         from dlrover_tpu.accel.strategy import Strategy
 
         plain = self._report(
-            Strategy(mesh=MeshConfig(dp=2, fsdp=2, tp=2))
+            Strategy(mesh=MeshConfig(dp=2, pp=2, ep=2))
         )
         compressed_opts = self._report(
             Strategy(
-                mesh=MeshConfig(dp=2, fsdp=2, tp=2),
+                mesh=MeshConfig(dp=2, pp=2, ep=2),
                 opts=("grad_compress",),
             )
         )

@@ -63,10 +63,13 @@ class TestSyncModeGate:
     def test_unsupported_meshes(self):
         assert resolve_sync_mode({"dp": 1}) is None
         assert resolve_sync_mode({"tp": 4}) is None  # no data axis
-        assert resolve_sync_mode({"dp": 2, "pp": 2}) is None
-        assert resolve_sync_mode({"dp": 2, "ep": 2}) is None
-        # 3D dp x fsdp x tp stays GSPMD
-        assert resolve_sync_mode({"dp": 2, "fsdp": 2, "tp": 2}) is None
+        # ISSUE 13: pp x dp, dp x ep and 3D now resolve (see
+        # tests/test_mesh_matrix.py); the remaining exotica stay GSPMD
+        assert resolve_sync_mode({"pp": 2, "dp": 1}) is None
+        assert resolve_sync_mode({"ep": 2, "dp": 1}) is None
+        assert resolve_sync_mode({"dp": 2, "pp": 2, "ep": 2}) is None
+        assert resolve_sync_mode({"dp": 2, "ep": 2, "fsdp": 2}) is None
+        assert resolve_sync_mode({"dp": 2, "pp": 2, "tp": 2}) is None
 
     def test_tp_plan_forces_compress_off(self):
         s = Strategy(
@@ -92,11 +95,17 @@ class TestSyncModeGate:
 
     def test_plan_buckets_rejects_bad_combos(self):
         shapes = [jax.ShapeDtypeStruct((16,), jnp.float32)]
-        with pytest.raises(ValueError, match="neither"):
+        with pytest.raises(ValueError, match="fsdp leg"):
             plan_buckets(shapes, dp=2, auto_axes=("tp",), fsdp=2)
-        with pytest.raises(ValueError, match="neither"):
+        with pytest.raises(ValueError, match="int8"):
             plan_buckets(
                 shapes, dp=2, auto_axes=("tp",), compress="int8"
+            )
+        # the fully-manual 3d kind composes fsdp with auto tp, but
+        # demands the localized-leaf metadata
+        with pytest.raises(ValueError, match="3d plan needs"):
+            plan_buckets(
+                shapes, dp=2, auto_axes=("tp",), fsdp=2, kind="3d"
             )
 
 

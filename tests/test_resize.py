@@ -487,6 +487,101 @@ class TestReshardAxisChange:
             assert report.stitched_shards > 0
 
 
+class TestReshardPipelineExpertAxes:
+    """ISSUE 13 satellite: warm-resize reshard coverage for pp/ep
+    axis-degree changes. ``ReshardReport.axis_changes`` already
+    reports them generically; these pin the bitwise grow/shrink
+    behavior for stage-stacked and expert-sharded trees (the state
+    layouts ``pipeline_state_shardings`` / the ep rules produce),
+    alongside ``TestReshardAxisChange``'s tp/dp cases. The timed
+    dp x pp warm resize through the AOT cache lives in the resize
+    bench (``resize_downtime_warm_pp_ms``)."""
+
+    def _staged_tree(self, mesh):
+        """Pipeline-shaped leaves: a stage-stacked layer weight
+        ([stages, lc, d, d] sharded over pp on dim 0), an
+        expert-stacked FFN weight ([E, d, f] over ep on dim 0), and a
+        replicated head. Dims divide by every degree used (2, 4)."""
+        rng = np.random.default_rng(13)
+        return {
+            "stages": jax.device_put(
+                rng.standard_normal((4, 2, 8, 8)).astype(np.float32),
+                _named_sharding(mesh, "pp"),
+            ),
+            "experts": jax.device_put(
+                rng.standard_normal((4, 8, 16)).astype(np.float32),
+                _named_sharding(mesh, "ep"),
+            ),
+            "head": jax.device_put(
+                rng.standard_normal((8, 12)).astype(np.float32),
+                _named_sharding(mesh),
+            ),
+        }
+
+    def _spec(self, tree, mesh):
+        specs = {
+            "stages": _named_sharding(mesh, "pp"),
+            "experts": _named_sharding(mesh, "ep"),
+            "head": _named_sharding(mesh),
+        }
+        return {
+            k: jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=specs[k])
+            for k, v in tree.items()
+        }
+
+    @pytest.mark.parametrize(
+        "old_mc,old_n,new_mc,new_n,axis",
+        [
+            # pp grow: pp2 -> pp4 (each new stage shard is a slice)
+            (
+                MeshConfig(pp=2, dp=2), 4,
+                MeshConfig(pp=4, dp=2), 8, "pp",
+            ),
+            # pp shrink: pp4 -> pp2 (multi-source concat per shard)
+            (
+                MeshConfig(pp=4, dp=2), 8,
+                MeshConfig(pp=2, dp=2), 4, "pp",
+            ),
+            # ep grow / shrink
+            (
+                MeshConfig(ep=2, dp=2), 4,
+                MeshConfig(ep=4, dp=2), 8, "ep",
+            ),
+            (
+                MeshConfig(ep=4, dp=2), 8,
+                MeshConfig(ep=2, dp=2), 4, "ep",
+            ),
+            # dp2 x pp2 -> dp4 x pp2: dp absorbs the delta, stages
+            # stay put (the warm-resize shape of a pipeline world)
+            (
+                MeshConfig(pp=2, dp=2), 4,
+                MeshConfig(pp=2, dp=4), 8, "dp",
+            ),
+        ],
+    )
+    def test_bitwise_grow_shrink(
+        self, old_mc, old_n, new_mc, new_n, axis
+    ):
+        from dlrover_tpu.ckpt.reshard import reshard_state
+
+        old = build_mesh(old_mc, jax.devices()[:old_n])
+        new = build_mesh(new_mc, jax.devices()[:new_n])
+        state = self._staged_tree(old)
+        spec = self._spec(state, new)
+        resharded, report = reshard_state(state, spec)
+        assert not report.fallback_paths
+        assert report.host_bytes == 0
+        assert axis in report.axis_changes
+        assert report.axis_changes[axis] == (
+            getattr(old_mc, axis), getattr(new_mc, axis)
+        )
+        for path in state:
+            a = np.asarray(resharded[path])
+            b = np.asarray(state[path])
+            assert a.tobytes() == b.tobytes(), path
+            assert resharded[path].sharding == spec[path].sharding
+
+
 class TestMeshCandidates:
     """Satellite: candidate enumeration with non-power-of-two device
     counts must produce a valid mesh or a clear error, never a crash."""

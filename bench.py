@@ -3273,6 +3273,157 @@ def run_mesh_matrix_bench(jax, results: dict, smoke: bool = False):
     )
 
 
+# control-plane gates (ISSUE 14): steady-state RPC fan-in, delta wire
+# compression, loopback p99, and the multi-path overlap A/B.
+# p99 is generous for a loopback call because CI boxes timeshare — the
+# number that matters is the ORDER (sub-second for a 1k-node tick);
+# the load harness's own CLI gates tighter on quiet hardware.
+CONTROL_PLANE_RPC_GATE = 1.25          # RPCs/node/tick, steady state
+CONTROL_PLANE_DELTA_GATE = 0.4         # delta bytes / full-payload bytes
+CONTROL_PLANE_P99_GATE_MS = 500.0      # loopback client-observed p99
+
+
+def _transfer_overlap_ab(steps=6, compute_s=0.04, chunks=4,
+                         chunk_s=0.003):
+    """Step-blocked host-transfer time, arbitrated vs serialized, on a
+    simulated workload: per run, TWO streams (a background checkpoint
+    stage and a backpressure spill) must each land ``steps * chunks``
+    transfers of ``chunk_s``.
+
+    - serialized (the pre-arbiter world): every transfer runs inline in
+      the inter-step host section — all of it is step-blocked;
+    - arbitrated: the streams run on their own threads acquiring link
+      grants while the trainer marks compute windows — transfers land
+      under compute and only the tail past the last step is blocked.
+
+    Returns ``(blocked_arb_ms, blocked_serial_ms)``. Transfers are
+    sleeps (the link physics, not the payload): the A/B isolates the
+    SCHEDULING, and the bitwise gates elsewhere in --smoke prove the
+    arbiter never touches contents."""
+    import threading
+
+    from dlrover_tpu.parallel.transfer_sched import (
+        Priority,
+        TransferArbiter,
+    )
+
+    total_transfers = steps * chunks
+
+    # serialized baseline
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        time.sleep(compute_s)
+        for _ in range(chunks):
+            time.sleep(chunk_s)  # ckpt chunk, inline
+            time.sleep(chunk_s)  # spill, queued behind it
+    wall_serial = time.perf_counter() - t0
+    blocked_serial = wall_serial - steps * compute_s
+
+    # arbitrated: same total work, scheduled into compute windows
+    arb = TransferArbiter(aging_s=1.0, enabled=True)
+    ckpt = arb.register("ckpt", Priority.BACKGROUND, "d2h")
+    spill = arb.register("spill", Priority.BACKPRESSURE, "d2h")
+
+    def worker(stream):
+        for _ in range(total_transfers):
+            with stream.transfer(1 << 20):
+                time.sleep(chunk_s)
+
+    threads = [
+        threading.Thread(target=worker, args=(s,), daemon=True)
+        for s in (ckpt, spill)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for _ in range(steps):
+        arb.note_compute(True)
+        time.sleep(compute_s)
+        arb.note_compute(False)
+    for t in threads:
+        t.join()
+    wall_arb = time.perf_counter() - t0
+    arb.shutdown()
+    blocked_arb = wall_arb - steps * compute_s
+    return max(blocked_arb, 0.0) * 1e3, max(blocked_serial, 0.0) * 1e3
+
+
+def run_control_plane_bench(jax, results: dict, smoke: bool = False):
+    """The ISSUE 14 acceptance legs (docs/control-plane.md):
+
+    - **load harness** (``tools/rpc_load.py``): 1k fake nodes (2k on
+      the full bench; 10k is the harness's own slow tier) against a
+      real gRPC master — steady-state RPCs/node/tick must stay ≤
+      ``CONTROL_PLANE_RPC_GATE``, delta wire bytes ≤
+      ``CONTROL_PLANE_DELTA_GATE`` × the full-payload baseline **at
+      identical reconstructed master-side scalars**, client p99 under
+      the loopback gate;
+    - **failover drill**: the master's delta state wiped mid-run —
+      every node resyncs and reconstruction converges;
+    - **multi-path overlap**: checkpoint staging + embedding spill
+      running concurrently under the arbiter expose strictly less
+      step-blocked time than serialized transfers (the
+      ``stage_sync_block_ms``-style A/B);
+    - **host-leg pricing**: the dry-runner's aggregate host term is
+      live — scheduled pricing strictly below serialized, both > 0
+      when demand is registered.
+    """
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tools"))
+    from rpc_load import run_load
+
+    from dlrover_tpu.parallel.transfer_sched import (
+        TransferArbiter,
+        aggregate_host_exposed_s,
+    )
+
+    nodes = 1000 if smoke else 2000
+    ticks = 6
+    delta = run_load(
+        nodes=nodes, ticks=ticks, nscalars=60, churn=0.1, mode="delta"
+    )
+    full = run_load(
+        nodes=nodes, ticks=ticks, nscalars=60, churn=0.1, mode="full"
+    )
+    results["control_plane_nodes"] = nodes
+    results["control_plane_rpcs_per_node_tick"] = delta[
+        "rpcs_per_node_per_tick"
+    ]
+    results["control_plane_rpc_p99_ms"] = delta["rpc_p99_ms"]
+    results["control_plane_master_s_per_tick"] = delta[
+        "master_service_s_per_tick"
+    ]
+    results["control_plane_delta_vs_full_bytes"] = round(
+        delta["wire_bytes_total"] / max(full["wire_bytes_total"], 1), 4
+    )
+    results["control_plane_reconstructed_ok"] = bool(
+        delta["reconstructed_ok"] and full["reconstructed_ok"]
+    )
+    # failover drill (small fleet: the property is protocol-level)
+    drill = run_load(
+        nodes=64, ticks=4, nscalars=60, churn=0.1, mode="delta",
+        master_restart_tick=2,
+    )
+    results["control_plane_resync_converged"] = bool(
+        drill["reconstructed_ok"] and drill["resyncs"] > 0
+    )
+    # multi-path overlap A/B
+    blocked_arb, blocked_serial = _transfer_overlap_ab()
+    results["transfer_blocked_ms_arbitrated"] = round(blocked_arb, 1)
+    results["transfer_blocked_ms_serialized"] = round(blocked_serial, 1)
+    # dry-runner host-leg pricing sensitivity
+    arb = TransferArbiter(enabled=True)
+    arb.set_demand("ckpt_stage", 64 << 20, direction="d2h")
+    arb.set_demand("emb_fault", 8 << 20, direction="h2d")
+    sched_s = aggregate_host_exposed_s(arbiter=arb)
+    arb.shutdown()  # serialized pricing: all of it exposed
+    serial_s = aggregate_host_exposed_s(arbiter=arb)
+    results["control_plane_host_sched_ms"] = round(sched_s * 1e3, 3)
+    results["control_plane_host_serial_ms"] = round(serial_s * 1e3, 3)
+    results["control_plane_host_priced"] = bool(
+        0.0 < sched_s < serial_s
+    )
+
+
 def run_smoke() -> int:
     """Fast CPU-only pass over the pipeline + resize keys (CI wiring:
     overlap and resize-fast-path regressions must fail loudly without a
@@ -3338,6 +3489,10 @@ def run_smoke() -> int:
         run_mesh_matrix_bench(jax, results, smoke=True)
     except Exception as e:
         results["mesh_matrix_error"] = repr(e)
+    try:
+        run_control_plane_bench(jax, results, smoke=True)
+    except Exception as e:
+        results["control_plane_error"] = repr(e)
     print(json.dumps(results))
     sys.stdout.flush()
     sys.stderr.flush()
@@ -3523,6 +3678,37 @@ def run_smoke() -> int:
         # warm pp resize recorded (reshard + AOT-cache hit)
         and "resize_pp_error" not in results
         and results.get("resize_downtime_warm_pp_ms") is not None
+        # the control-plane gates (ISSUE 14): 1k fake workers against a
+        # real gRPC master must hold steady state at ~1 RPC/node/tick,
+        # delta telemetry must stay under 0.4x the full-payload bytes
+        # WITH identical reconstructed master-side scalars, a master
+        # restart must converge through resync, the multi-path arbiter
+        # must expose strictly less step-blocked transfer time than
+        # serialized, and the dry-runner's host-leg pricing must be live
+        and "control_plane_error" not in results
+        and results.get("control_plane_rpcs_per_node_tick") is not None
+        and (
+            results["control_plane_rpcs_per_node_tick"]
+            <= CONTROL_PLANE_RPC_GATE
+        )
+        and results.get("control_plane_rpc_p99_ms") is not None
+        and (
+            results["control_plane_rpc_p99_ms"]
+            <= CONTROL_PLANE_P99_GATE_MS
+        )
+        and results.get("control_plane_delta_vs_full_bytes") is not None
+        and (
+            results["control_plane_delta_vs_full_bytes"]
+            <= CONTROL_PLANE_DELTA_GATE
+        )
+        and results.get("control_plane_reconstructed_ok") is True
+        and results.get("control_plane_resync_converged") is True
+        and results.get("transfer_blocked_ms_arbitrated") is not None
+        and (
+            results["transfer_blocked_ms_arbitrated"]
+            < results["transfer_blocked_ms_serialized"]
+        )
+        and results.get("control_plane_host_priced") is True
     )
     os._exit(0 if ok else 1)
 
@@ -3704,6 +3890,11 @@ def main() -> int:
     except Exception as e:
         results["sparse_step_overlap_on_vs_off"] = None
         results["sparse_error"] = repr(e)
+    try:
+        run_control_plane_bench(jax, results)
+    except Exception as e:
+        results["control_plane_rpcs_per_node_tick"] = None
+        results["control_plane_error"] = repr(e)
     try:
         run_mfu(jax, results)
     except Exception as e:

@@ -67,6 +67,13 @@ class DryRunReport:
     # OVERLAP_HIDDEN_FRACTION of it when comm_overlap is on)
     comm_bytes_per_device: float = 0.0
     comm_exposed_s: float = 0.0
+    # exposed seconds of the AGGREGATE host-link traffic registered
+    # with the transfer arbiter (checkpoint staging + embedding
+    # fault-in/spill streams, parallel/transfer_sched.py): scheduled
+    # into compute windows it exposes (1 - HOST_HIDDEN_FRACTION) of
+    # the wire time, serialized (arbiter off) all of it. 0.0 when no
+    # stream carries standing demand.
+    host_exposed_s: float = 0.0
 
 
 def hbm_fits(
@@ -404,12 +411,22 @@ def _finalize_estimate(
     else:
         _analytic_estimate(report, cfg, batch, seq, devices)
     _comm_estimate(report, cfg, batch, seq, devices)
+    # the host-leg term: aggregate staging/spill demand priced through
+    # the LinkModel host leg with the arbiter's scheduling credit —
+    # est_step_s (and therefore Brain plans) sees the real overlapped
+    # cost of the host link instead of assuming it free (or exclusive)
+    from dlrover_tpu.parallel.transfer_sched import (
+        aggregate_host_exposed_s,
+    )
+
+    report.host_exposed_s = aggregate_host_exposed_s()
     report.est_step_s = (
         max(
             report.flops_per_device * _SEC_PER_FLOP,
             report.bytes_per_device * _SEC_PER_BYTE,
         )
         + report.comm_exposed_s
+        + report.host_exposed_s
     )
 
 

@@ -21,8 +21,72 @@ from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.servicer import SERVICE_NAME
 
 
+def _env_flag(name: str, default: bool) -> bool:
+    v = os.getenv(name, "")
+    if not v:
+        return default
+    return v.strip().lower() not in ("0", "false", "no", "off")
+
+
+class _ClientRpcObs:
+    """``dlrover_rpc_client_*`` counters through the obs registry: the
+    worker-side view of a master brownout. Retries and budget
+    exhaustion ride the registry into flight-recorder bundles
+    (metrics.prom) and the runtime-metrics forward, so a master that
+    stops answering is visible in forensics, not just in logs."""
+
+    _instance = None
+
+    def __init__(self):
+        from dlrover_tpu.obs.metrics import default_registry
+
+        reg = default_registry()
+        self.requests = reg.counter(
+            "dlrover_rpc_client_requests_total",
+            "client RPC attempts, by message type",
+            ("message",),
+        )
+        self.retries = reg.counter(
+            "dlrover_rpc_client_retries_total",
+            "client RPC retries after a transport error",
+            ("message",),
+        )
+        self.budget_exhausted = reg.counter(
+            "dlrover_rpc_client_budget_exhausted_total",
+            "calls that gave up because retry_budget_s ran out",
+            ("message",),
+        )
+        self.unreachable = reg.counter(
+            "dlrover_rpc_client_unreachable_total",
+            "calls that exhausted every attempt (master unreachable)",
+            ("message",),
+        )
+        self.bytes = reg.counter(
+            "dlrover_rpc_client_bytes_total",
+            "request/response payload bytes through this client",
+            ("direction",),
+        )
+
+    @classmethod
+    def get(cls) -> "_ClientRpcObs":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+
 class MasterClient:
     _instance: Optional["MasterClient"] = None
+
+    # keepalive: a master failover leaves every agent holding a
+    # half-open channel; without pings the first RPC after it eats a
+    # full TCP timeout. Ping every 30s even when idle, declare the
+    # link dead after 10s of silence.
+    KEEPALIVE_OPTIONS = (
+        ("grpc.keepalive_time_ms", 30_000),
+        ("grpc.keepalive_timeout_ms", 10_000),
+        ("grpc.keepalive_permit_without_calls", 1),
+        ("grpc.http2.max_pings_without_data", 0),
+    )
 
     def __init__(
         self,
@@ -30,17 +94,32 @@ class MasterClient:
         node_id: int = 0,
         node_type: str = "worker",
         timeout: float = 30.0,
+        compression: Optional[bool] = None,
     ):
         self._master_addr = master_addr
         self._node_id = node_id
         self._node_type = node_type
         self._timeout = timeout
+        # on-wire gzip: telemetry batches are dictionaries of repeated
+        # key strings — they compress 5-10x, and at 10k nodes the
+        # master's NIC is the scarcer resource. Off by default only via
+        # DLROVER_TPU_RPC_COMPRESSION=0 (mixed fleets are fine either
+        # way: gRPC negotiates per-message, an uncompressing server
+        # still decodes).
+        if compression is None:
+            compression = _env_flag("DLROVER_TPU_RPC_COMPRESSION", True)
+        self._compression = (
+            grpc.Compression.Gzip if compression
+            else grpc.Compression.NoCompression
+        )
         self._channel = grpc.insecure_channel(
             master_addr,
             options=[
                 ("grpc.max_send_message_length", 256 * 1024 * 1024),
                 ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                *self.KEEPALIVE_OPTIONS,
             ],
+            compression=self._compression,
         )
         self._get_rpc = self._channel.unary_unary(
             f"/{SERVICE_NAME}/get"
@@ -48,6 +127,7 @@ class MasterClient:
         self._report_rpc = self._channel.unary_unary(
             f"/{SERVICE_NAME}/report"
         )
+        self._obs = _ClientRpcObs.get()
 
     @property
     def node_id(self) -> int:
@@ -85,15 +165,28 @@ class MasterClient:
         exponential tail."""
         err: Optional[Exception] = None
         deadline = time.monotonic() + retry_budget_s
+        msg_name = type(message).__name__
+        # getattr: test doubles build the client via __new__ without
+        # running __init__ — the registry singleton covers them
+        obs = getattr(self, "_obs", None) or _ClientRpcObs.get()
         for i in range(retries):
             try:
+                obs.requests.labels(msg_name).inc()
+                if i:
+                    # the retry counter feeds the goodput/forensics
+                    # path: a master brownout shows up as a retry ramp
+                    # in flight bundles, not just a log tail
+                    obs.retries.labels(msg_name).inc()
                 # fault point rpc.send: injected OSError/delay exercises
                 # exactly the retry/backoff path a flaky network does
                 faults.fire("rpc.send")
+                req_bytes = self._wrap(message)
+                obs.bytes.labels("out").inc(len(req_bytes))
                 resp_bytes = rpc(
-                    self._wrap(message),
+                    req_bytes,
                     timeout=rpc_timeout or self._timeout,
                 )
+                obs.bytes.labels("in").inc(len(resp_bytes))
                 # fault point rpc.recv: the RESPONSE leg — the server
                 # applied the request but the reply was lost/garbled.
                 # Must ride the same jittered-retry path as send-leg
@@ -103,7 +196,7 @@ class MasterClient:
                 resp: comm.BaseResponse = comm.deserialize_message(resp_bytes)
                 if not resp.success:
                     raise RuntimeError(
-                        f"master rejected {type(message).__name__}: "
+                        f"master rejected {msg_name}: "
                         f"{resp.message}"
                     )
                 return comm.deserialize_message(resp.data)
@@ -113,13 +206,15 @@ class MasterClient:
                     break
                 delay = random.uniform(0.0, min(2.0**i, 8.0))
                 if time.monotonic() + delay >= deadline:
+                    obs.budget_exhausted.labels(msg_name).inc()
                     logger.warning(
-                        f"{type(message).__name__}: retry budget "
+                        f"{msg_name}: retry budget "
                         f"({retry_budget_s}s) exhausted after "
                         f"{i + 1} attempts"
                     )
                     break
                 time.sleep(delay)
+        obs.unreachable.labels(msg_name).inc()
         raise ConnectionError(
             f"master {self._master_addr} unreachable: {err!r}"
         )
@@ -321,6 +416,21 @@ class MasterClient:
                 open_span=open_span,
                 open_span_elapsed_s=open_span_elapsed_s,
             )
+        )
+
+    def report_batch(
+        self, batch: comm.AgentReportBatch
+    ) -> comm.AgentBatchResponse:
+        """The aggregation tier's one-RPC-per-tick leg: the whole
+        node's coalesced delta telemetry plus the piggybacked poll
+        legs. Retried on transport errors — the delta protocol's
+        same-seq replay is idempotent server-side, so a lost response
+        costs nothing (``common/telemetry_delta.py``)."""
+        resp = self.report(batch)
+        return (
+            resp
+            if isinstance(resp, comm.AgentBatchResponse)
+            else comm.AgentBatchResponse()
         )
 
     def poll_worker_commands(

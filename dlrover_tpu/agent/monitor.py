@@ -72,6 +72,24 @@ def read_runtime_metrics(path: str = "") -> dict:
         return {}
 
 
+def process_tree_usage(proc):
+    """(cpu_percent, rss_mb) summed over ``proc`` and its recursive
+    children — THE process-tree sampling walk, shared by the legacy
+    ``ResourceMonitor`` and the batcher's piggybacked resource leg."""
+    import psutil
+
+    procs = [proc] + proc.children(recursive=True)
+    cpu = 0.0
+    rss = 0
+    for p in procs:
+        try:
+            cpu += p.cpu_percent(None)
+            rss += p.memory_info().rss
+        except psutil.Error:
+            continue
+    return cpu, rss // (1024 * 1024)
+
+
 class ResourceMonitor(PollingDaemon):
     """Report host CPU/memory usage of this node's process tree to the
     master (parity: resource.py:86)."""
@@ -85,18 +103,7 @@ class ResourceMonitor(PollingDaemon):
         self._proc.cpu_percent(None)  # prime the percent baseline
 
     def current_usage(self):
-        import psutil
-
-        procs = [self._proc] + self._proc.children(recursive=True)
-        cpu = 0.0
-        rss = 0
-        for p in procs:
-            try:
-                cpu += p.cpu_percent(None)
-                rss += p.memory_info().rss
-            except psutil.Error:
-                continue
-        return cpu, rss // (1024 * 1024)
+        return process_tree_usage(self._proc)
 
     def _tick(self):
         cpu, mem_mb = self.current_usage()
@@ -106,6 +113,66 @@ class ResourceMonitor(PollingDaemon):
             used_memory_mb=mem_mb,
             tpu_duty_cycle=float(metrics.get("tpu_duty_cycle", 0.0)),
         )
+
+
+# keys that are NOT training scalars: step/clock bookkeeping, span
+# plumbing, and the resource stats the ResourceMonitor (or the batch's
+# resource leg) reports through its own channel
+_SCALAR_SKIP_KEYS = (
+    "global_step", "timestamp", "span_heartbeat_ts",
+    "open_span_elapsed_s", "tpu_duty_cycle",
+    "tpu_hbm_used_mb", "cpu_percent", "used_memory_mb",
+)
+
+
+def extract_scalar_metrics(metrics: dict) -> dict:
+    """TRAINING scalars (loss / eval_loss / lr / registry exports …)
+    from a runtime-metrics payload — not bools, not bookkeeping keys.
+    One definition shared by the legacy ``TrainingMonitor`` forward
+    and the batched aggregation tier, so both wire formats carry the
+    same values."""
+    return {
+        k: float(v)
+        for k, v in metrics.items()
+        if k not in _SCALAR_SKIP_KEYS
+        and isinstance(v, (int, float))
+        and not isinstance(v, bool)
+    }
+
+
+class EvictionRelay:
+    """The eviction-notice leg of the metrics-file channel: the
+    draining trainer has no RPC client of its own — the metrics file
+    carries the notice and the agent daemon turns it into the master's
+    ``EvictionNotice`` (the proactive-resize trigger). Memoized so the
+    notice is re-reported only when it changes (the drain's final
+    write adds the measured drain_ms). Must run FIRST on a tick: the
+    whole point is the master acting while the worker still drains."""
+
+    def __init__(self, client):
+        self._client = client
+        # memo keyed by source (proc id) — one shared tuple would
+        # thrash between two draining procs with different grace/drain
+        # values and re-send both notices every tick
+        self._last: dict = {}
+
+    def maybe_relay(self, metrics: dict, key: int = 0) -> None:
+        if not metrics.get("eviction_pending"):
+            return
+        grace = float(metrics.get("eviction_grace_s", 0.0) or 0.0)
+        drain_ms = float(metrics.get("eviction_drain_ms", 0.0) or 0.0)
+        if self._last.get(key) == (grace, drain_ms):
+            return
+        self._last[key] = (grace, drain_ms)
+        try:
+            self._client.report_eviction_notice(
+                grace, drain_ms=drain_ms, reason="worker_drain"
+            )
+        except Exception as e:
+            # clear the memo so the next tick retries; the notice
+            # path must never kill the daemon
+            self._last.pop(key, None)
+            logger.warning(f"eviction notice relay failed: {e!r}")
 
 
 class TrainingMonitor(PollingDaemon):
@@ -123,44 +190,24 @@ class TrainingMonitor(PollingDaemon):
       refresh) and — worse — silenced the open-span channel exactly
       when a wedged step stopped advancing, which is when hang
       attribution matters.
-    """
+
+    This is the LEGACY (per-channel RPC) path; the default agent runs
+    the ``agent.aggregator.AgentReportBatcher`` instead, which carries
+    the same signals in one delta-encoded RPC per tick. Kept for mixed
+    fleets and as the documented fallback
+    (``DLROVER_TPU_AGENT_BATCH=0``)."""
 
     def __init__(self, client, interval: float = 10.0):
         super().__init__("training-monitor", interval)
         self._client = client
         self._last_step = -1
         self._last_payload_ts = 0.0
-        # (grace_s, drain_ms) last forwarded as an EvictionNotice —
-        # the notice is re-reported only when it changes (the drain's
-        # final write adds the measured drain_ms)
-        self._last_eviction: tuple = ()
+        self._eviction = EvictionRelay(client)
 
     def _tick(self):
         metrics = read_runtime_metrics()
         step = int(metrics.get("global_step", -1))
-        # eviction notice relay: the draining trainer has no RPC
-        # client of its own — the metrics file carries the notice and
-        # this daemon turns it into the master's EvictionNotice (the
-        # proactive-resize trigger). Forwarded FIRST: the whole point
-        # is the master acting while the worker still drains.
-        if metrics.get("eviction_pending"):
-            grace = float(metrics.get("eviction_grace_s", 0.0) or 0.0)
-            drain_ms = float(
-                metrics.get("eviction_drain_ms", 0.0) or 0.0
-            )
-            if (grace, drain_ms) != self._last_eviction:
-                self._last_eviction = (grace, drain_ms)
-                try:
-                    self._client.report_eviction_notice(
-                        grace, drain_ms=drain_ms, reason="worker_drain"
-                    )
-                except Exception as e:
-                    # clear the memo so the next tick retries; the
-                    # notice path must never kill the monitor
-                    self._last_eviction = ()
-                    logger.warning(
-                        f"eviction notice relay failed: {e!r}"
-                    )
+        self._eviction.maybe_relay(metrics)
         if step > self._last_step:
             self._last_step = step
             self._client.report_global_step(step)
@@ -170,21 +217,7 @@ class TrainingMonitor(PollingDaemon):
         )
         if step >= 0 and payload_ts > self._last_payload_ts:
             self._last_payload_ts = payload_ts
-            # forward TRAINING scalars (loss / eval_loss / lr …) to the
-            # master's collector — not bools, and not the resource stats
-            # the ResourceMonitor already reports through its own channel
-            skip = (
-                "global_step", "timestamp", "span_heartbeat_ts",
-                "open_span_elapsed_s", "tpu_duty_cycle",
-                "tpu_hbm_used_mb", "cpu_percent", "used_memory_mb",
-            )
-            scalars = {
-                k: float(v)
-                for k, v in metrics.items()
-                if k not in skip
-                and isinstance(v, (int, float))
-                and not isinstance(v, bool)
-            }
+            scalars = extract_scalar_metrics(metrics)
             open_span = str(metrics.get("open_span", "") or "")
             if scalars or open_span:
                 self._client.report_train_metrics(
@@ -228,6 +261,18 @@ def last_command_id(path: str = "") -> int:
     )
 
 
+def append_worker_commands(path: str, cmds, keep: int = 16) -> None:
+    """Append relayed commands to the bounded-tail command file the
+    training process polls (shared by the legacy relay daemon and the
+    batched aggregation tier)."""
+    existing = read_worker_commands(path)
+    for c in cmds:
+        existing.append(
+            {"id": c.id, "kind": c.kind, "arg": c.arg, "reason": c.reason}
+        )
+    atomic_write_json(path, {"commands": existing[-keep:]})
+
+
 class WorkerCommandRelay(PollingDaemon):
     """Mirror the master's pending worker commands (flight dumps,
     profiler captures) into the command file the training process
@@ -255,17 +300,7 @@ class WorkerCommandRelay(PollingDaemon):
         ]
         if not cmds:
             return
-        existing = read_worker_commands(self._path)
-        for c in cmds:
-            existing.append(
-                {
-                    "id": c.id, "kind": c.kind, "arg": c.arg,
-                    "reason": c.reason,
-                }
-            )
-        atomic_write_json(
-            self._path, {"commands": existing[-self._keep:]}
-        )
+        append_worker_commands(self._path, cmds, keep=self._keep)
         self._ack = max(c.id for c in cmds)
         logger.info(
             f"relayed {len(cmds)} worker command(s): "

@@ -95,12 +95,35 @@ class ChunkedStager:
         checkpoint_dir: str,
         sync: bool,
         chunk_bytes: int,
+        priority=None,
     ):
         self._engine = engine
         self.step = step
         self.checkpoint_dir = checkpoint_dir
         self._sync = sync
         self._chunk_bytes = max(int(chunk_bytes), 1 << 10)
+        # host-link arbitration (parallel/transfer_sched.py): each
+        # chunk's write rides one grant of the shared host link, so
+        # checkpoint staging interleaves with embedding spills by
+        # priority instead of queueing blindly. BACKGROUND by default;
+        # the eviction emergency save passes EMERGENCY and preempts
+        # background holders at their next chunk boundary. The arbiter
+        # reorders transfers, never contents.
+        from dlrover_tpu.parallel import transfer_sched
+
+        self._priority = (
+            transfer_sched.Priority.BACKGROUND
+            if priority is None
+            else priority
+        )
+        self._stream = transfer_sched.get_arbiter().register(
+            "ckpt_stage",
+            transfer_sched.Priority.BACKGROUND,
+            direction="d2h",
+        )
+        # standing demand hint while this drain is live (the
+        # dry-runner's aggregate host-leg pricing)
+        self._stream.demand_bytes_per_step = self._chunk_bytes
         # the plan holds live references to every device shard: the
         # buffers stay alive (and unmutated — jax.Array is immutable)
         # until the drain finishes, whatever the caller does to `state`
@@ -276,7 +299,22 @@ class ChunkedStager:
                         self._inflight
                     ):
                         break  # transfer still riding the async stream
-                    copied += self._write_one()
+                    # one link grant per chunk: higher-priority traffic
+                    # (emergency ckpt, spill backpressure) interleaves
+                    # between chunks instead of waiting out the drain
+                    # ignore_window: this advance IS the inter-step
+                    # host section's own budgeted work on the train
+                    # thread — the window gate must defer background
+                    # THREADS to it, never it to itself
+                    nbytes = sum(m[2] for m in self._inflight)
+                    with self._stream.transfer(
+                        nbytes,
+                        priority=self._priority,
+                        ignore_window=True,
+                    ) as grant:
+                        copied += self._write_one()
+                    if budget_s is not None and grant.should_yield():
+                        break  # yield the link to the preemptor
                     if (
                         budget_s is not None
                         and time.perf_counter() - t0 >= budget_s
@@ -321,6 +359,7 @@ class ChunkedStager:
             raise
         self._finished = True
         self._plan = []
+        self._stream.demand_bytes_per_step = 0
         if stats is not None:
             stats.stage_commits += 1
         self._engine._queue.put(
@@ -344,6 +383,7 @@ class ChunkedStager:
         self._failed = True
         self._plan = []
         self._inflight = None
+        self._stream.demand_bytes_per_step = 0
         # force_release, not release: abort may run from a thread other
         # than the acquirer's (same rationale as _stage_and_notify)
         self._engine._lock.force_release()
@@ -465,13 +505,17 @@ class CheckpointEngine:
         checkpoint_dir: str,
         sync: bool = False,
         chunk_bytes: int = 64 << 20,
+        priority=None,
     ):
         """Chunked variant of ``save_to_memory``: returns a stager whose
         ``advance(budget_s)`` the train loop calls between steps and
         whose ``commit()`` is the barrier, or None when the saver still
         holds the shard lock (save skipped, never blocked on — same
         contract as ``save_to_memory``). Without an agent the returned
-        stager falls back to a synchronous storage save at commit."""
+        stager falls back to a synchronous storage save at commit.
+        ``priority`` is the host-link arbitration class
+        (``transfer_sched.Priority``; the eviction drain passes
+        EMERGENCY so its chunks preempt background spills)."""
         if self._agent_mode:
             assert self._lock and self._shm and self._queue
             if not self._lock.acquire(blocking=False):
@@ -482,7 +526,8 @@ class CheckpointEngine:
                 return None
             try:
                 stager = ChunkedStager(
-                    self, step, state, checkpoint_dir, sync, chunk_bytes
+                    self, step, state, checkpoint_dir, sync,
+                    chunk_bytes, priority=priority,
                 )
             except BaseException:
                 self._lock.force_release()

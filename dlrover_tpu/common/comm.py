@@ -677,3 +677,73 @@ class WorkerCommandRequest(Message):
 @dataclass
 class WorkerCommands(Message):
     commands: List[WorkerCommand] = field(default_factory=list)
+
+
+# -- hierarchical control plane (agent aggregation tier) ---------------------
+@dataclass
+class ProcDelta(Message):
+    """One training process's slice of an ``AgentReportBatch``.
+
+    ``changed``/``removed`` are the delta-encoded scalar telemetry
+    (``common/telemetry_delta.py``): only keys whose value changed
+    since the last batch the master ACKED, plus keys that disappeared.
+    ``step_advanced`` gates the SpeedMonitor leg exactly the way the
+    legacy ``TrainingMonitor`` gated ``report_global_step`` — ``step``
+    itself always carries the current step for metric attribution."""
+
+    proc_id: int = 0
+    # global worker id for telemetry/collector attribution; -1 = use
+    # the batch's node_id (the single-proc-per-node common case)
+    worker_id: int = -1
+    step: int = -1  # -1 = no step known yet
+    step_ts: float = 0.0
+    step_advanced: bool = False
+    changed: Dict[str, float] = field(default_factory=dict)
+    removed: List[str] = field(default_factory=list)
+    open_span: str = ""
+    open_span_elapsed_s: float = 0.0
+
+
+@dataclass
+class AgentReportBatch(Message):
+    """One node's whole control-plane tick in a single RPC: the agent
+    aggregation tier coalesces every per-process runtime-metrics /
+    global-step / telemetry report into this message, delta-encoded
+    against the last acked snapshot, and piggybacks the poll legs
+    (worker commands, paral config) on the same round trip — steady
+    state is ~1 RPC per node per tick instead of one per process per
+    channel.
+
+    ``epoch``/``seq``/``full`` are the delta protocol
+    (``common/telemetry_delta.py``): the master reconstructs full
+    scalars from its per-node snapshot and answers ``resync=True``
+    when it cannot (restart, gap) — the next batch is then a full
+    snapshot under a fresh epoch. No scalar is ever dropped."""
+
+    node_id: int = 0
+    epoch: int = 0
+    seq: int = 0
+    full: bool = False
+    procs: List[ProcDelta] = field(default_factory=list)
+    # piggybacked command-poll leg (WorkerCommandRequest semantics:
+    # ack clears, the rest redelivers)
+    command_ack_id: int = 0
+    # piggybacked paral-config poll leg: the dataloader version the
+    # agent last wrote (-1 = none yet). The response carries the
+    # config only when the agent's copy is stale.
+    paral_version: int = -1
+    # piggybacked resource leg (the ResourceMonitor channel)
+    resource: Optional[ResourceStats] = None
+
+
+@dataclass
+class AgentBatchResponse(Message):
+    """Master's answer to an ``AgentReportBatch``: the batched poll
+    legs ride back on the same round trip. ``resync=True`` means the
+    delta could not be applied (nothing was) — the agent must re-send
+    a full snapshot."""
+
+    resync: bool = False
+    commands: List[WorkerCommand] = field(default_factory=list)
+    # only set when the agent's paral_version is stale
+    paral_config: Optional[ParallelConfig] = None

@@ -11,6 +11,7 @@ codegen step.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent import futures
 from typing import Optional
 
@@ -18,8 +19,70 @@ import grpc
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.telemetry_delta import DeltaDecoder
 
 SERVICE_NAME = "dlrover_tpu.Master"
+
+# dispatch-latency buckets: master-side service time is tens of µs to
+# a few ms per message — the default seconds-scale buckets would dump
+# everything into the first bucket and p99 would read as 5 ms forever
+RPC_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 1.0, 5.0,
+)
+
+
+class _RpcObs:
+    """Per-message-type ``dlrover_rpc_*`` counters and latency
+    histograms through the obs registry (docs/observability.md). One
+    instance per servicer; metric names are registry-global so every
+    export path (prometheus_text, scalars→runtime-metrics, flight
+    bundles) sees them without extra wiring."""
+
+    def __init__(self, registry=None):
+        from dlrover_tpu.obs.metrics import default_registry
+
+        reg = registry or default_registry()
+        labels = ("rpc", "message")
+        self.requests = reg.counter(
+            "dlrover_rpc_requests_total",
+            "master RPCs dispatched, by entrypoint and message type",
+            labels,
+        )
+        self.errors = reg.counter(
+            "dlrover_rpc_errors_total",
+            "master RPC dispatches that raised",
+            labels,
+        )
+        self.latency = reg.histogram(
+            "dlrover_rpc_latency_seconds",
+            "master-side dispatch service time",
+            labels,
+            buckets=RPC_LATENCY_BUCKETS,
+        )
+        self.bytes = reg.counter(
+            "dlrover_rpc_bytes_total",
+            "request/response payload bytes through the master",
+            ("rpc", "message", "direction"),
+        )
+        self.resyncs = reg.counter(
+            "dlrover_rpc_delta_resyncs_total",
+            "agent delta batches the master could not reconstruct "
+            "(answered resync: restart, epoch change or seq gap)",
+        )
+        self.batch_procs = reg.counter(
+            "dlrover_rpc_batch_procs_total",
+            "per-process sub-reports coalesced into AgentReportBatch "
+            "RPCs (the fan-in the aggregation tier saves)",
+        )
+
+    def observe(self, rpc, message_name, seconds, in_bytes, out_bytes, ok):
+        self.requests.labels(rpc, message_name).inc()
+        if not ok:
+            self.errors.labels(rpc, message_name).inc()
+        self.latency.labels(rpc, message_name).observe(seconds)
+        self.bytes.labels(rpc, message_name, "in").inc(in_bytes)
+        self.bytes.labels(rpc, message_name, "out").inc(out_bytes)
 
 
 def _event_status(report) -> str:
@@ -76,6 +139,9 @@ class MasterServicer:
         # silently never run
         self._delivered_commands: dict = {}  # node_id -> {id, ...}
         self._command_seq = 0
+        # agent aggregation tier: per-node delta-telemetry reconstruction
+        self._delta = DeltaDecoder()
+        self._rpc_obs = _RpcObs()
 
     # ------------------------------------------------------------------
     # RPC entrypoints (bytes in/out)
@@ -84,6 +150,7 @@ class MasterServicer:
         req: comm.BaseRequest = comm.deserialize_message(request_bytes)
         message = comm.deserialize_message(req.data)
         response = comm.BaseResponse()
+        t0 = time.perf_counter()
         try:
             result = self._dispatch_get(req, message)
             if result is not None:
@@ -92,12 +159,22 @@ class MasterServicer:
             logger.error(f"get({type(message).__name__}) failed: {e!r}")
             response.success = False
             response.message = repr(e)
-        return comm.serialize_message(response)
+        out = comm.serialize_message(response)
+        self._rpc_obs.observe(
+            "get",
+            type(message).__name__,
+            time.perf_counter() - t0,
+            len(request_bytes),
+            len(out),
+            response.success,
+        )
+        return out
 
     def report(self, request_bytes: bytes, context=None) -> bytes:
         req: comm.BaseRequest = comm.deserialize_message(request_bytes)
         message = comm.deserialize_message(req.data)
         response = comm.BaseResponse()
+        t0 = time.perf_counter()
         try:
             result = self._dispatch_report(req, message)
             if result is False:
@@ -108,7 +185,16 @@ class MasterServicer:
             logger.error(f"report({type(message).__name__}) failed: {e!r}")
             response.success = False
             response.message = repr(e)
-        return comm.serialize_message(response)
+        out = comm.serialize_message(response)
+        self._rpc_obs.observe(
+            "report",
+            type(message).__name__,
+            time.perf_counter() - t0,
+            len(request_bytes),
+            len(out),
+            response.success,
+        )
+        return out
 
     # ------------------------------------------------------------------
     # GET dispatch
@@ -198,22 +284,27 @@ class MasterServicer:
         if isinstance(message, comm.WorkerCommandRequest):
             node_id = message.node_id if message.node_id >= 0 else req.node_id
             ack = getattr(message, "ack_id", 0)
-            with self._lock:
-                pending = self._worker_commands.get(node_id, [])
-                # clear only what the agent ACKED (its previous poll's
-                # ids): a lost response redelivers rather than drops
-                pending[:] = [c for c in pending if c.id > ack]
-                delivered = self._delivered_commands.setdefault(
-                    node_id, set()
-                )
-                delivered.difference_update(
-                    i for i in list(delivered) if i <= ack
-                )
-                delivered.update(c.id for c in pending)
-                if not pending:
-                    self._worker_commands.pop(node_id, None)
-                return comm.WorkerCommands(commands=list(pending))
+            return comm.WorkerCommands(
+                commands=self._drain_commands(node_id, ack)
+            )
         raise ValueError(f"unknown get message: {type(message).__name__}")
+
+    def _drain_commands(self, node_id: int, ack: int) -> list:
+        """Pending commands for ``node_id``, clearing only what the
+        agent ACKED (its previous poll's ids): a lost response
+        redelivers rather than drops. Shared by the legacy
+        ``WorkerCommandRequest`` poll and the batched report leg."""
+        with self._lock:
+            pending = self._worker_commands.get(node_id, [])
+            pending[:] = [c for c in pending if c.id > ack]
+            delivered = self._delivered_commands.setdefault(node_id, set())
+            delivered.difference_update(
+                i for i in list(delivered) if i <= ack
+            )
+            delivered.update(c.id for c in pending)
+            if not pending:
+                self._worker_commands.pop(node_id, None)
+            return list(pending)
 
     # ------------------------------------------------------------------
     # worker command queue (master-side producers: hang handler,
@@ -463,6 +554,8 @@ class MasterServicer:
             with self._lock:
                 self._ckpt_steps[message.node_id] = message.step
             return True
+        if isinstance(message, comm.AgentReportBatch):
+            return self._handle_agent_batch(req, message)
         if isinstance(message, comm.ScaleRequest):
             # has_scaler gate: a scalerless master executing scale_to
             # would fabricate node entries nothing launches (the ghost-
@@ -476,6 +569,91 @@ class MasterServicer:
             self._auto_scaler.scale_to(message.count)
             return comm.SyncResult(done=True)
         raise ValueError(f"unknown report message: {type(message).__name__}")
+
+    # ------------------------------------------------------------------
+    # agent aggregation tier (AgentReportBatch)
+    # ------------------------------------------------------------------
+    def _handle_agent_batch(
+        self, req: comm.BaseRequest, message: comm.AgentReportBatch
+    ) -> comm.AgentBatchResponse:
+        """One node's whole tick: reconstruct the delta-encoded
+        per-process telemetry and feed it to exactly the subsystems the
+        legacy per-message reports fed (SpeedMonitor, collector,
+        telemetry aggregator, resource usage), then answer the
+        piggybacked poll legs on the same round trip. A delta the
+        decoder cannot reconstruct applies NOTHING and answers
+        ``resync=True`` — the agent re-sends a full snapshot next tick,
+        so a master restart costs one tick of latency, never a dropped
+        scalar."""
+        node_id = message.node_id
+        resp = comm.AgentBatchResponse()
+        snaps = self._delta.apply(
+            node_id,
+            message.epoch,
+            message.seq,
+            message.full,
+            {
+                p.proc_id: (p.changed, p.removed)
+                for p in message.procs
+            },
+        )
+        if snaps is None:
+            self._rpc_obs.resyncs.inc()
+            resp.resync = True
+        else:
+            self._rpc_obs.batch_procs.inc(max(len(message.procs), 1))
+            for p in message.procs:
+                worker_id = p.worker_id if p.worker_id >= 0 else node_id
+                scalars = snaps.get(p.proc_id, {})
+                if (
+                    p.step_advanced
+                    and p.step >= 0
+                    and self._speed_monitor
+                ):
+                    # same wire-0.0→None sentinel mapping as the
+                    # GlobalStepReport branch
+                    self._speed_monitor.collect_global_step(
+                        p.step,
+                        p.step_ts if p.step_ts else None,
+                        node_id=worker_id,
+                    )
+                if p.step >= 0 and (scalars or p.open_span):
+                    if self._metric_collector is not None:
+                        self._metric_collector.report_train_metrics(
+                            worker_id, p.step, dict(scalars)
+                        )
+                    if self._telemetry is not None:
+                        self._telemetry.observe_metrics(
+                            worker_id,
+                            p.step,
+                            dict(scalars),
+                            open_span=p.open_span,
+                            open_span_elapsed_s=p.open_span_elapsed_s,
+                        )
+            if message.resource is not None and self._job_manager:
+                self._job_manager.update_node_resource_usage(
+                    req.node_type or "worker",
+                    node_id,
+                    message.resource.cpu_percent,
+                    message.resource.used_memory_mb,
+                )
+        # the poll legs ride back even on resync: a wedged telemetry
+        # stream must not also stall the forensics command channel
+        resp.commands = self._drain_commands(
+            node_id, message.command_ack_id
+        )
+        if self._paral_config_service:
+            # version mismatch INCLUDING the agent's initial -1 ("I
+            # have nothing — send whatever you have"): the response
+            # carries the config only when the agent's copy is stale
+            cfg = self._paral_config_service.get_config(node_id)
+            if (
+                cfg is not None
+                and getattr(cfg.dataloader, "version", 0)
+                != message.paral_version
+            ):
+                resp.paral_config = cfg
+        return resp
 
     def _join_rendezvous(
         self, req: comm.BaseRequest, message: comm.JoinRendezvousRequest
@@ -510,6 +688,12 @@ def create_master_service(
         options=[
             ("grpc.max_send_message_length", 256 * 1024 * 1024),
             ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+            # accept the agents' keepalive pings (master_client.py sets
+            # them so half-open channels die fast after a master
+            # failover) instead of GOAWAY-ing ping-happy clients
+            ("grpc.keepalive_permit_without_calls", 1),
+            ("grpc.http2.min_ping_interval_without_data_ms", 10_000),
+            ("grpc.http2.max_ping_strikes", 0),
         ],
     )
     handlers = {

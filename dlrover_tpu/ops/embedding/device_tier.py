@@ -552,6 +552,24 @@ class DeviceSparseEmbedding:
         self._beta1 = float(beta1)
         self._beta2 = float(beta2)
         self.stats = EmbeddingTierStats()
+        # host-link arbitration (parallel/transfer_sched.py): the
+        # fault-in H2D leg and the spill D2H leg register as streams so
+        # they interleave with checkpoint staging by priority instead
+        # of queueing blindly. Grants wrap whole transfers — ordering
+        # changes, contents never do. Acquired OUTSIDE self._lock
+        # always (the arbiter is a leaf lock).
+        from dlrover_tpu.parallel import transfer_sched
+
+        self._fault_stream = transfer_sched.get_arbiter().register(
+            f"emb_fault:{table_name}",
+            transfer_sched.Priority.BACKPRESSURE,
+            direction="h2d",
+        )
+        self._spill_stream = transfer_sched.get_arbiter().register(
+            f"emb_spill:{table_name}",
+            transfer_sched.Priority.BACKGROUND,
+            direction="d2h",
+        )
         # one lock serializes every table mutation: the pipeline
         # thread's fault-in scatter vs the train thread's grad scatter
         # (jax arrays are immutable — the hazard is lost updates via
@@ -601,11 +619,39 @@ class DeviceSparseEmbedding:
                         int(k) for k in item[1]
                     )
 
-    def _import_spill(self, t_enq: float, ids, dev_rows, n: int):
+    def _import_spill(
+        self, t_enq: float, ids, dev_rows, n: int, arbitrate: bool = True
+    ):
+        from contextlib import nullcontext
+
+        from dlrover_tpu.parallel import transfer_sched
+
+        # link-grant ordering is ALWAYS link → emb/host locks: the
+        # drain thread holds no lock here, so it arbitrates; the
+        # synchronous (async_spill=False) path runs INLINE under
+        # self._lock from _allocate and must NOT wait on the link — a
+        # grant-holding fault-in briefly takes self._lock inside
+        # _host_rows, and emb→link here would be the ABBA half of a
+        # deadlock
+        if arbitrate:
+            # backlog escalates priority: a deep spill queue is about
+            # to stall _allocate (the step path), so it outranks
+            # background checkpoint staging
+            prio = (
+                transfer_sched.Priority.BACKPRESSURE
+                if self._spill_q.qsize() >= 2
+                else transfer_sched.Priority.BACKGROUND
+            )
+            grant = self._spill_stream.transfer(
+                n * self.host.dim * 4, priority=prio
+            )
+        else:
+            grant = nullcontext()
         # lands the (already async) D2H; the device array is
         # bucket-padded, the tail rows are scratch filler
-        rows = np.asarray(dev_rows)[:n]
-        self.host.import_rows(ids, rows)
+        with grant:
+            rows = np.asarray(dev_rows)[:n]
+            self.host.import_rows(ids, rows)
         self.stats.spill_rows += len(ids)
         self.stats.spill_bytes += rows.nbytes
         self.stats.scatter_lag_s += time.perf_counter() - t_enq
@@ -670,7 +716,9 @@ class DeviceSparseEmbedding:
             if self._async_spill:
                 self._spill_q.put(item)
             else:
-                self._import_spill(*item)
+                # inline under self._lock: no link arbitration (see
+                # _import_spill's ordering note)
+                self._import_spill(*item, arbitrate=False)
         self.hot.clear_dirty(victim_slots)
 
     # -- prepare / gather / update -------------------------------------
@@ -702,8 +750,13 @@ class DeviceSparseEmbedding:
             # H2D dispatch are the slow part and must overlap the train
             # thread's compute, not serialize against its scatter.
             # Rows stay numpy until the (bucket-padded) scatter so no
-            # ragged-shape eager op ever reaches the device
-            rows_np = self._host_rows(missing)
+            # ragged-shape eager op ever reaches the device. The link
+            # grant (BACKPRESSURE: a consumer may be waiting on this
+            # prep) orders the leg against spills/staging
+            with self._fault_stream.transfer(
+                len(missing) * self.host.dim * 4
+            ):
+                rows_np = self._host_rows(missing)
             with self._lock:
                 if self._gen != gen0:
                     # an import_state/evict resharded the world while
@@ -1064,6 +1117,16 @@ class DeviceSparseEmbedding:
         scalars = self.stats.as_dict()
         scalars["emb_hot_rows"] = float(len(self.hot))
         scalars["emb_hbm_bytes"] = float(self.hot.hbm_bytes)
+        # refresh the arbiter's standing-demand hints (the dry-runner
+        # prices aggregate host traffic from these): average bytes per
+        # gather cycle so far
+        gathers = max(self.stats.gathers, 1)
+        self._fault_stream.demand_bytes_per_step = (
+            self.stats.fault_bytes // gathers
+        )
+        self._spill_stream.demand_bytes_per_step = (
+            self.stats.spill_bytes // gathers
+        )
         for name, value in scalars.items():
             registry.gauge(
                 f"dlrover_embedding_{name[4:]}",

@@ -1,0 +1,452 @@
+"""Multi-path host-link transfer scheduling: one arbiter owns the host
+link.
+
+Before this module the host link's consumers were invisible to each
+other: the chunked checkpoint stager (PR 1) drained D2H between steps,
+the sparse-embedding pipeline (PR 11) faulted rows H2D and spilled
+victims D2H from its own threads, and each priced itself as if it had
+the link alone. Under load they queue behind one another at the worst
+moments — an emergency checkpoint during an eviction window can sit
+behind a background spill — and the dry-runner's ``est_step_s`` saw
+none of it.
+
+``TransferArbiter`` is the single owner (FlexLink's scheduling idea,
+PAPERS.md 2510.15882, applied to the one heterogeneous idle path this
+host has):
+
+- **Streams** register once (``register(name, priority, direction)``)
+  and wrap each physical transfer in ``with stream.transfer(nbytes):``.
+  The arbiter grants the link one holder at a time, in priority order:
+  ``EMERGENCY`` (eviction-window checkpoint) > ``BACKPRESSURE`` (spill
+  backlog / fault-in a consumer is waiting on) > ``BACKGROUND``
+  (steady-state checkpoint staging).
+- **Preemption** is cooperative: a higher-priority waiter flags the
+  current holder, which checks ``grant.should_yield()`` at chunk
+  boundaries and releases early. The arbiter reorders transfers, NEVER
+  contents — bitwise checkpoint/spill correctness is untouched.
+- **Compute windows**: the trainer marks its compute span
+  (``note_compute``); while the marks are fresh, BACKGROUND grants
+  outside a window wait (the inter-step host section belongs to the
+  step's own host work) until priority aging rescues them. Marks
+  expire after ``WINDOW_TTL_S`` so a finished/absent trainer can never
+  gate anything — standalone users see a pass-through arbiter.
+- **Aging** bounds starvation: a waiter's effective priority improves
+  by one class per ``aging_s`` waited, so even a BACKGROUND stream
+  under a constant EMERGENCY storm is granted within
+  ``~2 * aging_s``.
+- **Shutdown** mid-transfer releases the link: waiters wake with
+  pass-through grants, new acquires never block, holders' release
+  becomes a no-op. Teardown cannot deadlock on a wedged transfer.
+
+Pricing: registered streams carry a ``demand_bytes_per_step`` hint;
+``aggregate_host_exposed_s`` prices the AGGREGATE host traffic through
+the PR-6 ``LinkModel`` host leg — scheduled into compute windows it
+exposes ``(1 - HOST_HIDDEN_FRACTION)`` of the wire time, serialized
+(arbiter disabled) it exposes all of it. ``accel/dry_runner.py`` adds
+this term to ``est_step_s`` so strategy ranking and Brain plans see
+the real overlap instead of assuming an exclusive link.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from enum import IntEnum
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class Priority(IntEnum):
+    """Lower value = more urgent."""
+
+    EMERGENCY = 0     # eviction-window emergency checkpoint drain
+    BACKPRESSURE = 1  # spill backlog / fault-in a consumer waits on
+    BACKGROUND = 2    # steady-state staging, warmup prefetch
+
+
+# fraction of aggregate host wire time hidden behind compute when the
+# arbiter schedules transfers into compute windows (the documented
+# analytic constant, the host-leg sibling of grad_sync's
+# OVERLAP_HIDDEN_FRACTION; measured on the bench's A/B leg)
+HOST_HIDDEN_FRACTION = 0.7
+
+# compute-window marks older than this are ignored: a trainer that
+# stopped marking (exit, crash, not wired) must not gate background
+# streams forever
+WINDOW_TTL_S = 10.0
+
+ENV_ARBITER = "DLROVER_TPU_TRANSFER_ARBITER"
+
+
+class Grant:
+    """One granted (or pass-through) hold of the host link."""
+
+    __slots__ = ("stream", "nbytes", "priority", "passthrough",
+                 "_preempt", "_released", "t0")
+
+    def __init__(self, stream, nbytes, priority, passthrough=False):
+        self.stream = stream
+        self.nbytes = int(nbytes)
+        self.priority = priority
+        self.passthrough = passthrough
+        self._preempt = False
+        self._released = False
+        self.t0 = time.perf_counter()
+
+    def should_yield(self) -> bool:
+        """A higher-priority waiter wants the link: release at the next
+        chunk boundary and re-acquire. Cooperative — ignoring it only
+        costs latency, never correctness."""
+        return self._preempt
+
+    def release(self):
+        if self.stream is not None:
+            self.stream.arbiter.release(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class TransferStream:
+    """One registered consumer of the host link."""
+
+    def __init__(self, arbiter: "TransferArbiter", name: str,
+                 priority: Priority, direction: str):
+        self.arbiter = arbiter
+        self.name = name
+        self.priority = Priority(priority)
+        self.direction = direction  # "d2h" | "h2d"
+        # pricing hint for the dry-runner: average bytes this stream
+        # moves per train step (0 = no standing demand)
+        self.demand_bytes_per_step = 0
+        self.bytes_total = 0
+        self.grants = 0
+        self.wait_s = 0.0
+        self.yields = 0
+
+    def acquire(
+        self,
+        nbytes: int,
+        priority: Optional[Priority] = None,
+        timeout: Optional[float] = None,
+        ignore_window: bool = False,
+    ) -> Grant:
+        return self.arbiter.acquire(
+            self, nbytes,
+            priority=self.priority if priority is None else priority,
+            timeout=timeout,
+            ignore_window=ignore_window,
+        )
+
+    def transfer(
+        self,
+        nbytes: int,
+        priority: Optional[Priority] = None,
+        ignore_window: bool = False,
+    ):
+        """``with stream.transfer(n):`` — acquire around one physical
+        transfer. ``ignore_window=True`` for transfers the TRAIN THREAD
+        issues inside its own budget (the stager's advance): the
+        compute-window gate exists to keep background threads off the
+        inter-step host section, and deferring the section's own work
+        behind its own gate would put the aging bound on the step's
+        critical path."""
+        return self.acquire(
+            nbytes, priority=priority, ignore_window=ignore_window
+        )
+
+
+class _Waiter:
+    __slots__ = ("stream", "priority", "enq", "grant", "ignore_window")
+
+    def __init__(self, stream, priority, ignore_window=False):
+        self.stream = stream
+        self.priority = priority
+        self.enq = time.perf_counter()
+        self.grant: Optional[Grant] = None
+        self.ignore_window = ignore_window
+
+
+class TransferArbiter:
+    """See module docstring. ``aging_s`` is the starvation knob: one
+    priority class of credit per ``aging_s`` seconds waited."""
+
+    # forced-grant backstop: an acquire never blocks longer than this
+    # even if the holder wedges — the link is an optimization, not a
+    # correctness gate, so a stuck arbiter must degrade to pass-through
+    DEFAULT_TIMEOUT_S = 30.0
+
+    def __init__(self, aging_s: float = 2.0, enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = os.getenv(ENV_ARBITER, "1").strip().lower() not in (
+                "0", "false", "no", "off"
+            )
+        self.enabled = enabled
+        self.aging_s = max(float(aging_s), 1e-3)
+        self._cond = threading.Condition()
+        self._streams: Dict[str, TransferStream] = {}
+        self._holder: Optional[Grant] = None
+        self._waiters: List[_Waiter] = []
+        self._shutdown = False
+        # compute-window marks (note_compute); 0.0 = never marked
+        self._in_compute = False
+        self._last_mark = 0.0
+        self.preemptions = 0
+        self.forced_grants = 0
+
+    # -- registration --------------------------------------------------
+    def register(
+        self,
+        name: str,
+        priority: Priority = Priority.BACKGROUND,
+        direction: str = "d2h",
+    ) -> TransferStream:
+        """Get-or-create a stream (call sites don't coordinate)."""
+        with self._cond:
+            st = self._streams.get(name)
+            if st is None:
+                st = TransferStream(self, name, priority, direction)
+                self._streams[name] = st
+            return st
+
+    def streams(self) -> List[TransferStream]:
+        with self._cond:
+            return list(self._streams.values())
+
+    # -- compute windows ----------------------------------------------
+    def note_compute(self, active: bool) -> None:
+        """Trainer hook: the device is (not) computing. While marks are
+        fresh, BACKGROUND grants are deferred OUTSIDE compute windows —
+        the inter-step host section belongs to the step's own host
+        work (stager memcpy, metric sync)."""
+        with self._cond:
+            self._in_compute = bool(active)
+            self._last_mark = time.perf_counter()
+            self._cond.notify_all()
+
+    def _window_gating(self, now: float) -> bool:
+        return (
+            self._last_mark > 0.0
+            and now - self._last_mark < WINDOW_TTL_S
+        )
+
+    # -- scheduling ----------------------------------------------------
+    def _effective(self, w: _Waiter, now: float) -> float:
+        return float(w.priority) - (now - w.enq) / self.aging_s
+
+    def _eligible(self, w: _Waiter, now: float) -> bool:
+        if w.priority < Priority.BACKGROUND or w.ignore_window:
+            return True
+        if not self._window_gating(now) or self._in_compute:
+            return True
+        # aged past one class: window gating may no longer starve it
+        return self._effective(w, now) <= float(Priority.BACKPRESSURE)
+
+    def _best(self, now: float) -> Optional[_Waiter]:
+        cands = [w for w in self._waiters if self._eligible(w, now)]
+        if not cands:
+            return None
+        return min(cands, key=lambda w: (self._effective(w, now), w.enq))
+
+    def acquire(
+        self,
+        stream: TransferStream,
+        nbytes: int,
+        priority: Priority = Priority.BACKGROUND,
+        timeout: Optional[float] = None,
+        ignore_window: bool = False,
+    ) -> Grant:
+        if not self.enabled or self._shutdown:
+            return self._passthrough(stream, nbytes, priority)
+        timeout = self.DEFAULT_TIMEOUT_S if timeout is None else timeout
+        deadline = time.perf_counter() + timeout
+        w = _Waiter(stream, Priority(priority), ignore_window)
+        with self._cond:
+            self._waiters.append(w)
+            # cooperative preemption: flag a strictly lower-priority
+            # holder so it yields at its next chunk boundary
+            if (
+                self._holder is not None
+                and not self._holder._preempt
+                and w.priority < self._holder.priority
+            ):
+                self._holder._preempt = True
+                self._holder.stream.yields += 1
+                self.preemptions += 1
+                self._cond.notify_all()
+            while True:
+                now = time.perf_counter()
+                if self._shutdown:
+                    self._waiters.remove(w)
+                    return self._passthrough(stream, nbytes, priority)
+                if self._holder is None and self._best(now) is w:
+                    self._waiters.remove(w)
+                    g = Grant(stream, nbytes, w.priority)
+                    self._holder = g
+                    stream.grants += 1
+                    stream.bytes_total += int(nbytes)
+                    stream.wait_s += now - w.enq
+                    self._export()
+                    return g
+                if now >= deadline:
+                    # backstop: never block a training thread on a
+                    # wedged holder — degrade to pass-through
+                    self._waiters.remove(w)
+                    self.forced_grants += 1
+                    logger.warning(
+                        f"transfer arbiter: {stream.name} waited "
+                        f"{timeout:.1f}s for the host link; forcing a "
+                        f"pass-through grant (holder wedged?)"
+                    )
+                    return self._passthrough(stream, nbytes, priority)
+                # bounded wait: aging/window eligibility changes with
+                # wall time, not only with notify
+                self._cond.wait(timeout=min(0.05, deadline - now))
+
+    def _passthrough(self, stream, nbytes, priority) -> Grant:
+        stream.grants += 1
+        stream.bytes_total += int(nbytes)
+        return Grant(stream, nbytes, Priority(priority), passthrough=True)
+
+    def release(self, grant: Grant) -> None:
+        if grant._released:
+            return
+        grant._released = True
+        if grant.passthrough:
+            return
+        with self._cond:
+            if self._holder is grant:
+                self._holder = None
+            self._export()
+            self._cond.notify_all()
+
+    def shutdown(self) -> None:
+        """Release the link and never block again (idempotent). Safe
+        mid-transfer: the in-flight holder finishes on its own, its
+        release becomes a no-op, and every waiter wakes with a
+        pass-through grant."""
+        with self._cond:
+            self._shutdown = True
+            self._holder = None
+            self._cond.notify_all()
+
+    @property
+    def scheduling_active(self) -> bool:
+        return self.enabled and not self._shutdown
+
+    # -- introspection / pricing hints ---------------------------------
+    def set_demand(
+        self,
+        name: str,
+        bytes_per_step: int,
+        priority: Priority = Priority.BACKGROUND,
+        direction: str = "d2h",
+    ) -> TransferStream:
+        """Register-or-update a stream's standing per-step demand (the
+        dry-runner pricing hint)."""
+        st = self.register(name, priority, direction)
+        st.demand_bytes_per_step = int(bytes_per_step)
+        return st
+
+    def demand(self) -> Dict[str, TransferStream]:
+        with self._cond:
+            return {
+                n: s
+                for n, s in self._streams.items()
+                if s.demand_bytes_per_step > 0
+            }
+
+    def _export(self) -> None:
+        """Registry gauges (lock held; cheap sets)."""
+        try:
+            from dlrover_tpu.obs.metrics import default_registry
+
+            reg = default_registry()
+            reg.gauge(
+                "dlrover_transfer_link_busy",
+                "1 while a stream holds the host link",
+            ).set(0.0 if self._holder is None else 1.0)
+            reg.gauge(
+                "dlrover_transfer_preemptions_total",
+                "holders flagged to yield to a higher-priority stream",
+            ).set(float(self.preemptions))
+            g_b = reg.gauge(
+                "dlrover_transfer_stream_bytes_total",
+                "bytes moved per registered host-link stream",
+                ("stream",),
+            )
+            g_w = reg.gauge(
+                "dlrover_transfer_stream_wait_seconds_total",
+                "seconds streams waited for the host link",
+                ("stream",),
+            )
+            for name, st in self._streams.items():
+                g_b.labels(name).set(float(st.bytes_total))
+                g_w.labels(name).set(st.wait_s)
+        except Exception:  # metrics must never break a transfer
+            pass
+
+
+# -- process-wide arbiter ----------------------------------------------------
+
+_default: Optional[TransferArbiter] = None
+_default_lock = threading.Lock()
+
+
+def get_arbiter() -> TransferArbiter:
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = TransferArbiter()
+    return _default
+
+
+def set_arbiter(arbiter: Optional[TransferArbiter]) -> None:
+    """Install (tests) or reset (None → fresh lazy default) the
+    process arbiter."""
+    global _default
+    with _default_lock:
+        _default = arbiter
+
+
+def note_compute(active: bool) -> None:
+    """Module-level trainer hook (no-op cost when nothing contends)."""
+    get_arbiter().note_compute(active)
+
+
+# -- pricing -----------------------------------------------------------------
+
+
+def aggregate_host_exposed_s(
+    model=None, arbiter: Optional[TransferArbiter] = None
+) -> float:
+    """Exposed (step-blocking) seconds per train step of the AGGREGATE
+    registered host-link demand, priced through the PR-6 ``LinkModel``
+    host leg. The link is ONE resource: concurrent streams serialize on
+    the wire, so the base cost is the sum of their per-stream transfer
+    times — but the arbiter schedules that total into compute windows,
+    hiding ``HOST_HIDDEN_FRACTION`` of it behind the step. Disabled
+    (or shut down) arbitration prices fully exposed: that is exactly
+    the serialized, exclusive-link assumption this module replaces."""
+    from dlrover_tpu.parallel.topology import price_host_transfer
+
+    a = arbiter or get_arbiter()
+    total = 0.0
+    for st in a.demand().values():
+        total += price_host_transfer(
+            st.demand_bytes_per_step,
+            h2d=st.direction == "h2d",
+            model=model,
+        )
+    if total <= 0.0:
+        return 0.0
+    if a.scheduling_active:
+        return total * (1.0 - HOST_HIDDEN_FRACTION)
+    return total

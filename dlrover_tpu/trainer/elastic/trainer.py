@@ -43,6 +43,7 @@ from dlrover_tpu.obs.flight_recorder import (
 from dlrover_tpu.obs.goodput import GoodputLedger, install_default_ledger
 from dlrover_tpu.obs.metrics import default_registry, fold_pipeline_stats
 from dlrover_tpu.obs.trace import SpanHeartbeat, span
+from dlrover_tpu.parallel import transfer_sched
 from dlrover_tpu.trainer.elastic.dataloader import ElasticDataLoader
 from dlrover_tpu.trainer.elastic.sampler import ElasticDistributedSampler
 
@@ -959,10 +960,14 @@ class ElasticTrainer:
             # stale stage — abort is safe)
             self._abort_stager()
             try:
+                # EMERGENCY link priority: this drain races a platform
+                # kill — its chunks preempt any in-flight background
+                # spill/stage at their next chunk boundary
                 stager = self._ckptr.begin_chunked_save(
                     step,
                     self._ckpt_state(),
                     chunk_bytes=self.tcfg.stage_chunk_mb << 20,
+                    priority=transfer_sched.Priority.EMERGENCY,
                 )
                 if stager is not None:
                     # leave a commit-sized margin before the deadline
@@ -2283,12 +2288,20 @@ class ElasticTrainer:
                         step_sp.cancel()
                         break
                     with span("compute"):
-                        metrics = self._run_step(x, y)
-                        # materializing the step count forces the
-                        # dispatched update on synchronous backends —
-                        # that wall time is compute, so it must land
-                        # inside this span
-                        step = self.global_step
+                        # compute-window mark for the host-link
+                        # arbiter: background transfers (spill drain,
+                        # staging D2H) are scheduled INTO this window,
+                        # off the inter-step host section
+                        transfer_sched.note_compute(True)
+                        try:
+                            metrics = self._run_step(x, y)
+                            # materializing the step count forces the
+                            # dispatched update on synchronous backends
+                            # — that wall time is compute, so it must
+                            # land inside this span
+                            step = self.global_step
+                        finally:
+                            transfer_sched.note_compute(False)
                     # interleave checkpoint chunks while the step
                     # computes (the engine emits its own ckpt_stage
                     # span)

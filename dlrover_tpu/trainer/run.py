@@ -265,23 +265,41 @@ def run(args) -> int:
             )
         )
         # agent-side daemons (parity: launch_agent starts the monitors at
-        # training.py:721): resource usage + global step to the master,
-        # master-tuned paral config to the dataloader's file
-        from dlrover_tpu.agent.monitor import (
-            ParalConfigTuner,
-            ResourceMonitor,
-            TrainingMonitor,
-            WorkerCommandRelay,
-        )
+        # training.py:721). Default: the aggregation tier — ONE
+        # delta-encoded RPC per tick coalescing telemetry/step/resource
+        # and the command + paral-config poll legs (docs/control-plane.md).
+        # DLROVER_TPU_AGENT_BATCH=0 falls back to the legacy per-channel
+        # daemons (mixed-version fleets against an old master).
+        if os.getenv("DLROVER_TPU_AGENT_BATCH", "1").strip().lower() not in (
+            "0", "false", "no", "off"
+        ):
+            from dlrover_tpu.agent.aggregator import (
+                AgentReportBatcher,
+                host_resource_fn,
+            )
 
-        monitors += [
-            ResourceMonitor(client),
-            TrainingMonitor(client),
-            ParalConfigTuner(client),
-            # master->worker forensics channel: flight-dump / profile
-            # requests land in the command file the trainer polls
-            WorkerCommandRelay(client),
-        ]
+            monitors += [
+                AgentReportBatcher(
+                    client, resource_fn=host_resource_fn(client.node_id)
+                ),
+            ]
+        else:
+            from dlrover_tpu.agent.monitor import (
+                ParalConfigTuner,
+                ResourceMonitor,
+                TrainingMonitor,
+                WorkerCommandRelay,
+            )
+
+            monitors += [
+                ResourceMonitor(client),
+                TrainingMonitor(client),
+                ParalConfigTuner(client),
+                # master->worker forensics channel: flight-dump /
+                # profile requests land in the command file the
+                # trainer polls
+                WorkerCommandRelay(client),
+            ]
         for m in monitors:
             m.start()
         agent = ElasticTrainingAgent(

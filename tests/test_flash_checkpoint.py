@@ -449,6 +449,69 @@ class TestChunkedStaging:
         finally:
             engine.close()
 
+    def test_chunked_commit_bitwise_under_link_contention(
+        self, saver, tmp_path
+    ):
+        """ISSUE 14 (multi-path arbiter): a chunked save racing
+        EMERGENCY-priority link traffic commits byte-identically to the
+        synchronous drain — the arbiter reorders transfers, never
+        contents."""
+        import threading
+
+        from dlrover_tpu.parallel.transfer_sched import (
+            Priority,
+            TransferArbiter,
+            set_arbiter,
+        )
+
+        arb = TransferArbiter(aging_s=0.05, enabled=True)
+        set_arbiter(arb)
+        engine = CheckpointEngine()
+        stop = threading.Event()
+
+        def contender():
+            st = arb.register("emergency_rival", Priority.EMERGENCY)
+            while not stop.is_set():
+                with st.transfer(1 << 20):
+                    time.sleep(0.002)
+
+        t = threading.Thread(target=contender, daemon=True)
+        try:
+            state = self._state()
+            d_sync = str(tmp_path / "sync")
+            d_chunk = str(tmp_path / "chunk")
+            assert engine.save_to_memory(1, state, d_sync, block=True)
+            _, recs, _ = engine._shm.load_records(copy=True)
+            sync_bytes = {
+                (r.path, r.index): r.data.tobytes() for r in recs
+            }
+            deadline = time.time() + 60
+            while engine.latest_step(d_sync) < 1:
+                time.sleep(0.05)
+                assert time.time() < deadline
+            t.start()
+            stager = engine.begin_chunked_save(
+                2, state, d_chunk, chunk_bytes=2048
+            )
+            assert stager is not None
+            yielded = 0
+            while not stager.done:
+                before = stager.chunks_written
+                stager.advance(budget_s=0.002)
+                yielded += stager.chunks_written == before
+            assert stager.commit()
+            stop.set()
+            step, recs2, _ = engine._shm.load_records(copy=True)
+            assert step == 2
+            assert {
+                (r.path, r.index): r.data.tobytes() for r in recs2
+            } == sync_bytes
+        finally:
+            stop.set()
+            t.join(timeout=2)
+            set_arbiter(None)
+            engine.close()
+
     def test_lock_busy_skips(self, saver, tmp_path):
         """Starting a chunked save while the saver owns the lock is a
         skip, never a block (the save_to_memory contract)."""

@@ -3424,6 +3424,37 @@ def run_control_plane_bench(jax, results: dict, smoke: bool = False):
     )
 
 
+def run_graftlint_gate(results: dict):
+    """Static-analysis gate (ISSUE 15): the tree must be graftlint-clean
+    — zero unsuppressed findings over ``dlrover_tpu/`` + ``tools/``
+    (every suppression carries a reason by construction: a reasonless
+    one is itself a finding). Consumes the ``--json`` output so the
+    bench artifact records the counts next to the perf keys."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--json"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    payload = json.loads(proc.stdout)
+    results["graftlint_unsuppressed"] = payload["unsuppressed"]
+    results["graftlint_suppressed"] = payload["suppressed"]
+    results["graftlint_clean"] = (
+        proc.returncode == 0 and payload["unsuppressed"] == 0
+    )
+    if not results["graftlint_clean"]:
+        # surface the first few findings in the bench artifact so the
+        # CI log names the regression without a second run
+        results["graftlint_findings"] = [
+            f"{f['path']}:{f['line']}: [{f['checker']}] {f['message']}"
+            for f in payload["findings"]
+            if not f["suppressed"]
+        ][:10]
+
+
 def run_smoke() -> int:
     """Fast CPU-only pass over the pipeline + resize keys (CI wiring:
     overlap and resize-fast-path regressions must fail loudly without a
@@ -3493,6 +3524,10 @@ def run_smoke() -> int:
         run_control_plane_bench(jax, results, smoke=True)
     except Exception as e:
         results["control_plane_error"] = repr(e)
+    try:
+        run_graftlint_gate(results)
+    except Exception as e:
+        results["graftlint_error"] = repr(e)
     print(json.dumps(results))
     sys.stdout.flush()
     sys.stderr.flush()
@@ -3709,6 +3744,13 @@ def run_smoke() -> int:
             < results["transfer_blocked_ms_serialized"]
         )
         and results.get("control_plane_host_priced") is True
+        # the static-analysis gate (ISSUE 15): the tree must be
+        # graftlint-clean — an unsuppressed invariant violation
+        # (lock discipline, span leak, RPC matrix hole, metric/doc
+        # drift, dead fault site, unfsynced rename) fails CI like a
+        # perf regression does
+        and "graftlint_error" not in results
+        and results.get("graftlint_clean") is True
     )
     os._exit(0 if ok else 1)
 

@@ -38,6 +38,7 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+from dlrover_tpu.common import storage
 from dlrover_tpu.common.log import default_logger as logger
 
 
@@ -248,10 +249,13 @@ class CompileCache:
             return
         try:
             os.makedirs(self._cache_dir, exist_ok=True)
-            tmp = f"{self._disk_path(key)}.tmp.{os.getpid()}"
-            with open(tmp, "wb") as f:
-                f.write(blob)
-            os.replace(tmp, self._disk_path(key))
+            # durable, not just atomic: a torn cache entry after a crash
+            # deserializes garbage on the NEXT process's warm resize —
+            # fsync costs µs against a multi-second compile (graftlint
+            # durable-rename)
+            storage.durable_replace(
+                self._disk_path(key), lambda f: f.write(blob), mode="wb"
+            )
         except OSError as e:
             logger.warning(f"compile cache disk write failed: {e!r}")
 

@@ -469,6 +469,29 @@ class MasterClient:
             retries=1,
         )
 
+    def report_node_event(
+        self,
+        event_type: str,
+        status: str = "",
+        exit_reason: str = "",
+        message: str = "",
+    ):
+        """Node lifecycle event → the master's job manager (the agent's
+        analog of the platform watcher feed: a non-k8s launcher reports
+        its own ADDED/DELETED/FAILED transitions through this leg).
+        Idempotent: the job manager's event processing is keyed by node
+        and status, so a replayed event re-applies the same transition."""
+        return self.report(
+            comm.NodeEventReport(
+                event_type=event_type,
+                node_type=self._node_type,
+                node_id=self._node_id,
+                status=status,
+                exit_reason=exit_reason,
+                message=message,
+            )
+        )
+
     def report_training_status(self, status: int):
         return self.report(
             comm.TrainingStatusReport(
@@ -531,6 +554,12 @@ class MasterClient:
         return bool(resp and resp.done)
 
     # -- paral config / misc -------------------------------------------
+    def get_elastic_run_config(self) -> Dict[str, str]:
+        """The master's run-config registry (operator-set feature flags;
+        parity: the reference MasterClient.get_elastic_run_config)."""
+        resp = self.get(comm.ElasticRunConfigRequest())
+        return dict(resp.configs) if resp else {}
+
     def get_paral_config(self) -> comm.ParallelConfig:
         resp = self.get(comm.ParallelConfigRequest(node_id=self._node_id))
         return resp if resp else comm.ParallelConfig()
@@ -581,6 +610,14 @@ class MasterClient:
     def sync_finished(self, sync_name: str) -> bool:
         resp = self.get(comm.SyncJoinRequest(sync_name=sync_name))
         return bool(resp and resp.done)
+
+    def finish_sync(self, sync_name: str) -> bool:
+        """Close a named sync barrier so late joiners stop waiting
+        (idempotent: finishing a finished sync is a no-op). The leg the
+        servicer always dispatched but no client could send. A rejected
+        or unreachable report raises; reaching here means it applied."""
+        self.report(comm.SyncFinishRequest(sync_name=sync_name))
+        return True
 
     def barrier(self, barrier_name: str, notify: bool = False) -> bool:
         if notify:

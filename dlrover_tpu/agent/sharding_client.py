@@ -105,18 +105,52 @@ class IndexShardingClient(ShardingClient):
         self._lock = threading.Lock()
         # records consumed but not yet credited against a pending shard
         self._uncredited = 0
+        # fills whose RPC is in flight (all under self._lock): the
+        # end-of-dataset sentinel may only land once this drains to 0,
+        # so a concurrently fetched real shard's indices always order
+        # BEFORE the sentinel
+        self._fills_in_flight = 0
+        self._sentinel_put = False
 
     def _fill(self):
-        waiting = False
+        # the master RPC runs OUTSIDE self._lock (graftlint
+        # lock-discipline.blocking, the real finding this suite was
+        # built on): get_task retries with a 60 s budget, and holding
+        # the lock through a master brownout starved report_batch_done
+        # — the training thread's shard-ack path — for the whole stall.
+        # Concurrent fillers each fetch a distinct task; state changes
+        # and index enqueues stay atomic under the lock below. A real
+        # task fetched concurrently with the filler that observed
+        # end-of-dataset must NOT be dropped (the master already moved
+        # its shard to `doing` — dropping it loses the shard until node
+        # death): the sentinel is deferred until every in-flight fill
+        # has applied its result, so indices always precede it.
         with self._lock:
             if self._exhausted:
-                return
+                sentinel_pending = not self._sentinel_put
+            else:
+                sentinel_pending = None
+                self._fills_in_flight += 1
+        if sentinel_pending is not None:
+            if sentinel_pending:
+                # the sentinel waits on an in-flight peer fill: yield
+                # instead of busy-spinning the consumer loop
+                time.sleep(0.01)
+            return
+        try:
             task = self._client.get_task(self.dataset_name)
+        except BaseException:
+            with self._lock:
+                self._fills_in_flight -= 1
+                self._maybe_put_sentinel_locked()
+            raise
+        waiting = False
+        with self._lock:
+            self._fills_in_flight -= 1
             if task.task_type == TaskType.WAIT:
                 waiting = True  # streaming producer behind; retry later
             elif task.is_empty:
                 self._exhausted = True
-                self._index_queue.put(None)
             else:
                 shard = task.shard
                 indices = shard.record_indices or range(
@@ -125,10 +159,24 @@ class IndexShardingClient(ShardingClient):
                 for idx in indices:
                     self._index_queue.put(int(idx))
                 self._pending_tasks.put(task)
+            self._maybe_put_sentinel_locked()
         if waiting:
             # back off OUTSIDE the lock: report_batch_done must not be
             # starved while the producer is behind
             time.sleep(0.2)
+
+    def _maybe_put_sentinel_locked(self):
+        """Caller holds ``self._lock``: place the end-of-dataset
+        sentinel exactly once, and only after the last in-flight fill
+        has applied — any concurrently fetched shard's indices are
+        already queued ahead of it."""
+        if (
+            self._exhausted
+            and self._fills_in_flight == 0
+            and not self._sentinel_put
+        ):
+            self._sentinel_put = True
+            self._index_queue.put(None)
 
     def fetch_sample_index(self) -> int:
         while True:
@@ -147,21 +195,49 @@ class IndexShardingClient(ShardingClient):
         once it is *fully* consumed (parity: client.py report_batch_done
         counts records — acking early would forfeit crash recovery for the
         still-in-flight remainder)."""
+        # credit under the lock, ACK outside it: the ack RPC retries
+        # with a 60 s budget, and holding self._lock through it blocked
+        # every _fill/report peer for the duration of a master brownout
+        # (graftlint lock-discipline.blocking). Acks are independent —
+        # one failing RPC must not abort the rest of the batch — and a
+        # FAILED ack re-queues its task with its credit restored, so
+        # the next report_batch_done retries it: the brownout surfaces
+        # (first error re-raised) but no completed shard's ack is ever
+        # dropped (the master would hold it `doing` until node death).
+        done = []
         with self._lock:
             self._uncredited += batch_size
             while True:
                 try:
                     task = self._pending_tasks.queue[0]
                 except IndexError:
-                    return
+                    break
                 size = task.shard.end - task.shard.start
                 if self._uncredited < size:
-                    return
+                    break
                 self._uncredited -= size
                 self._pending_tasks.get_nowait()
+                done.append(task)
+        failed: list = []
+        first_err: Optional[BaseException] = None
+        for task in done:
+            try:
                 self._client.report_task_result(
                     self.dataset_name, task.task_id
                 )
+            except Exception as e:
+                failed.append(task)
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            with self._lock:
+                # oldest-first back at the HEAD so retry order matches
+                # consumption order
+                for task in reversed(failed):
+                    self._uncredited += task.shard.end - task.shard.start
+                    with self._pending_tasks.mutex:
+                        self._pending_tasks.queue.appendleft(task)
+            raise first_err
 
     def __iter__(self):
         while True:

@@ -224,12 +224,20 @@ class BrainServicer:
     # -- datastore ------------------------------------------------------
     def persist_metrics(self, job: str, s: comm.JobMetricsSample):
         with self._lock:
+            # guarded insert, not a blind one: BrainMetricsReport rides
+            # the RETRIED client leg, and a lost response used to
+            # double-insert the sample on replay (graftlint
+            # rpc-idempotency). A row with the same (job, ts, step)
+            # identity is the same sample — replays are no-ops.
             self._conn.execute(
-                "INSERT INTO job_metrics VALUES (?,?,?,?,?,?,?,?)",
+                "INSERT INTO job_metrics SELECT ?,?,?,?,?,?,?,? "
+                "WHERE NOT EXISTS (SELECT 1 FROM job_metrics "
+                "WHERE job = ? AND ts = ? AND global_step = ?)",
                 (
                     job, s.timestamp, s.global_step, s.steps_per_sec,
                     s.alive_nodes, s.total_cpu_percent, s.total_memory_mb,
                     getattr(s, "goodput_pct", 0.0),
+                    job, s.timestamp, s.global_step,
                 ),
             )
             # bound the series per job (parity: the reference prunes by

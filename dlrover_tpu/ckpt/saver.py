@@ -815,6 +815,7 @@ class AsyncCheckpointSaver:
                         # quarantined + stale step dirs, back off with
                         # full jitter, try again
                         self._free_space(ckpt_dir)
+                        # graftlint: disable=lock-discipline.blocking reason=the persist pass owns _persist_mutex across its retry loop by design; the only other taker (reset_shared_memory) documents that it waits for the in-flight persist
                         time.sleep(
                             random.uniform(
                                 0.0,

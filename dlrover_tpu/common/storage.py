@@ -11,10 +11,71 @@ import os
 import pickle
 import shutil
 import tempfile
+import threading
 from abc import ABC, abstractmethod
-from typing import Any, List, Optional
+from typing import Any, Callable, List, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
+
+
+_FSYNC_DIR_WARNED: set = set()
+
+
+def fsync_dir(dirname: str) -> None:
+    """fsync a directory, making the renames/creates inside it durable
+    — a renamed file whose directory entry is still only in the page
+    cache when the host dies rolls back to the previous generation.
+
+    Best-effort: some filesystems reject directory fsync (EINVAL/
+    ENOTSUP on 9p, vboxsf, object-store FUSE mounts). By the time this
+    runs the rename has already committed, so failing the save here
+    would turn a durability *upgrade* into a crash on mounts where the
+    plain rename used to work — warn once per directory instead (the
+    file's own fsync already happened, so real I/O errors surfaced
+    there)."""
+    dirname = dirname or "."
+    try:
+        dfd = os.open(dirname, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError as e:
+        if dirname not in _FSYNC_DIR_WARNED:
+            _FSYNC_DIR_WARNED.add(dirname)
+            logger.warning(
+                f"directory fsync unsupported on {dirname!r} ({e!r}): "
+                "renames there are atomic but their durability rides "
+                "on the filesystem's own metadata ordering"
+            )
+
+
+def durable_replace(path: str, write_fn: Callable, mode: str = "w") -> str:
+    """Atomic AND durable publish: ``write_fn(f)`` writes the payload to
+    a pid+thread-unique tmp file, which is flushed, fsynced, and
+    ``os.replace``d onto ``path``. The rename being the commit point
+    only helps if the bytes reached the platter first (the PR-11 /
+    graftlint durable-rename class) — use this for anything a reader
+    treats as committed state. Telemetry that only needs atomic reads
+    can keep a plain unfsynced rename (suppressed in place where
+    deliberate, cf. agent/monitor.py)."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        with open(tmp, mode) as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        # the file's bytes being durable means nothing if the rename's
+        # directory entry isn't
+        fsync_dir(os.path.dirname(path))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
 
 
 class CheckpointDeletionStrategy(ABC):
@@ -146,6 +207,7 @@ class PosixDiskStorage(CheckpointStorage):
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)
+            fsync_dir(os.path.dirname(path))
         except Exception:
             if os.path.exists(tmp):
                 os.unlink(tmp)

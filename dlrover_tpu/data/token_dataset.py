@@ -25,6 +25,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from dlrover_tpu.common import storage
+
 
 def write_tokens(path: str, tokens: np.ndarray) -> str:
     """Persist a 1-D token array as ``<path>.g<nonce>`` +
@@ -42,18 +44,20 @@ def write_tokens(path: str, tokens: np.ndarray) -> str:
     )
     gen = f"{os.path.basename(path)}.g{secrets.token_hex(4)}"
     data_path = os.path.join(os.path.dirname(path) or ".", gen)
-    tmp = f"{data_path}.tmp.{os.getpid()}"
-    tokens.astype(dtype).tofile(tmp)
-    os.replace(tmp, data_path)
+    # a materialized dataset claims durability: fsync data before the
+    # meta commit below, or a crash can commit a generation whose token
+    # bytes never hit the platter (graftlint durable-rename)
+    storage.durable_replace(
+        data_path, lambda f: tokens.astype(dtype).tofile(f), mode="wb"
+    )
     meta = {
         "dtype": np.dtype(dtype).name,
         "count": int(tokens.size),
         "data_file": gen,
     }
-    mtmp = f"{path}.meta.json.tmp.{os.getpid()}"
-    with open(mtmp, "w") as f:
-        json.dump(meta, f)
-    os.replace(mtmp, f"{path}.meta.json")  # the commit point
+    storage.durable_replace(
+        f"{path}.meta.json", lambda f: json.dump(meta, f)
+    )  # the commit point
     _gc_generations(path)
     return path
 

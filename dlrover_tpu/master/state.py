@@ -31,6 +31,7 @@ import os
 import threading
 from typing import Optional
 
+from dlrover_tpu.common import storage
 from dlrover_tpu.common.log import default_logger as logger
 
 STATE_ENV = "DLROVER_TPU_MASTER_STATE"
@@ -116,14 +117,11 @@ class MasterStateBackend:
         self.path = path
 
     def save(self, state: dict) -> None:
-        # pid+thread-unique tmp (repo convention, cf. agent/monitor.py):
-        # an old master's lagging saver thread and its successor's can
-        # coexist in one process on the same path
-        tmp = f"{self.path}.tmp.{os.getpid()}.{threading.get_ident()}"
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-        os.replace(tmp, self.path)
+        # durable (fsync-before-rename): failover state whose rename
+        # survives a host crash while the bytes don't would restore an
+        # EMPTY master (graftlint durable-rename, the PR-11 class)
+        storage.durable_replace(self.path, lambda f: json.dump(state, f))
 
     def load(self) -> Optional[dict]:
         try:

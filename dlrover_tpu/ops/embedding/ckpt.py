@@ -44,6 +44,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from dlrover_tpu.common import faults
+from dlrover_tpu.common.storage import durable_replace, fsync_dir
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.ops.embedding.store import ShardedKvEmbedding
 
@@ -146,6 +147,9 @@ class EmbeddingDeltaStager:
             self._f.close()
             path = os.path.join(self._manager._dir, self.name)
             os.replace(self._tmp, path)
+            # post-commit means DURABLE for the rename too: the dir
+            # entry must survive the crash, not just the bytes
+            fsync_dir(self._manager._dir)
         except BaseException:
             self.abort()
             raise
@@ -225,12 +229,9 @@ class IncrementalCheckpointManager:
             return []
 
     def _write_manifest(self, entries: List[dict]):
-        tmp = f"{self._manifest_path()}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(entries, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._manifest_path())
+        durable_replace(
+            self._manifest_path(), lambda f: json.dump(entries, f)
+        )
 
     # -- save -----------------------------------------------------------
     def _next_save_kind(self) -> str:

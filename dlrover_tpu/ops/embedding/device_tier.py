@@ -752,11 +752,30 @@ class DeviceSparseEmbedding:
             # Rows stay numpy until the (bucket-padded) scatter so no
             # ragged-shape eager op ever reaches the device. The link
             # grant (BACKPRESSURE: a consumer may be waiting on this
-            # prep) orders the leg against spills/staging
+            # prep) orders the leg against spills/staging.
+            if self._spills_racing(missing):
+                # one of these ids was just evicted and its spill has
+                # not landed host-side: reading now would fault the
+                # PRE-spill value back in and lose the victim's
+                # training. Join BEFORE taking the link grant — the
+                # drain needs the link to land its import, and joining
+                # while HOLDING the grant deadlocks against it (the
+                # arbiter's forced-grant backstop outlasts the join
+                # timeout; graftlint lock-discipline.grant, found as a
+                # flaky 30 s wedge in the spill-lifetime test)
+                self.join_spills()
             with self._fault_stream.transfer(
                 len(missing) * self.host.dim * 4
             ):
-                rows_np = self._host_rows(missing)
+                racing = self._spills_racing(missing)
+                rows_np = None if racing else self._host_rows(missing)
+            if racing:
+                # re-armed between the join and the export (a
+                # concurrent prepare faulted one of these ids in and
+                # evicted it again): the grant is released now, so
+                # join and retry from the top
+                self.join_spills()
+                continue
             with self._lock:
                 if self._gen != gen0:
                     # an import_state/evict resharded the world while
@@ -804,23 +823,24 @@ class DeviceSparseEmbedding:
             generation=gen,
         )
 
+    def _spills_racing(self, ids: np.ndarray) -> bool:
+        """True if any of ``ids`` has an in-flight spill whose import
+        has not landed host-side yet (reading it now would return the
+        pre-spill value)."""
+        with self._lock:
+            return bool(
+                self._pending_spill_ids.intersection(
+                    int(k) for k in ids
+                )
+            )
+
     def _host_rows(self, missing: np.ndarray) -> np.ndarray:
         """Full rows for ``missing`` from the host tier; keys the host
         has never seen are created there first (deterministic C++ init)
-        so both tiers agree on the row's birth value."""
-        with self._lock:
-            racing = bool(
-                self._pending_spill_ids.intersection(
-                    int(k) for k in missing
-                )
-            )
-        if racing:
-            # one of these ids was just evicted and its spill has not
-            # landed host-side yet: reading now would fault the
-            # PRE-spill value back in and silently lose the victim's
-            # training. Rare (immediate re-request of an LRU victim),
-            # so a drain barrier is the simple correct answer.
-            self.join_spills()
+        so both tiers agree on the row's birth value. Callers must have
+        joined any racing spill of these ids FIRST — and before taking
+        the link grant: the drain needs the link to land its import,
+        so a grant-holding join deadlocks (prepare does this)."""
         rows, _f, _t, present = self.host.export_rows(missing)
         absent = missing[~present]
         if len(absent):
@@ -898,13 +918,7 @@ class DeviceSparseEmbedding:
                 vals[resident] = rows[:, :dim]
         missing = unique[~resident]
         if len(missing):
-            with self._lock:
-                racing = bool(
-                    self._pending_spill_ids.intersection(
-                        int(k) for k in missing
-                    )
-                )
-            if racing:
+            if self._spills_racing(missing):
                 self.join_spills()
             vals[~resident] = self.host.gather(
                 missing, insert_missing=False

@@ -31,7 +31,7 @@ import numpy as np
 from dlrover_tpu.accel.accelerate import AccelerateResult, auto_accelerate
 from dlrover_tpu.accel.strategy import Strategy
 from dlrover_tpu.agent.monitor import report_runtime_metrics
-from dlrover_tpu.common import faults
+from dlrover_tpu.common import faults, storage
 from dlrover_tpu.ckpt.checkpointer import FlashCheckpointer, StorageType
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.models.config import TransformerConfig
@@ -928,106 +928,112 @@ class ElasticTrainer:
         grace = self._evict_grace_s or self.tcfg.eviction_grace_s
         step = self.global_step
         self._goodput.eviction_begin()
-        self._flight.suppress_watchdog(grace + 60.0)
-        self._flight.note_event(
-            "eviction",
-            f"{self._evict_reason}: grace={grace:.1f}s step={step}",
-        )
-        # announce FIRST: the master's proactive resize (rendezvous
-        # exclusion, speculative n-1 compile) runs while we drain
-        if self.tcfg.report_metrics:
-            report_runtime_metrics(
-                step,
-                eviction_pending=1.0,
-                eviction_grace_s=float(grace),
+        try:
+            self._flight.suppress_watchdog(grace + 60.0)
+            self._flight.note_event(
+                "eviction",
+                f"{self._evict_reason}: grace={grace:.1f}s step={step}",
             )
-        if self._event_reporter is not None:
-            try:
-                self._event_reporter(
-                    "eviction",
-                    f"grace={grace:.1f}s step={step} "
-                    f"reason={self._evict_reason}",
-                )
-            except Exception as e:
-                logger.warning(f"eviction event report failed: {e!r}")
-        # the prefetcher's lookahead dies with us; the checkpoint's
-        # sampler snapshot rewinds it (same contract as _ckpt_state)
-        committed = False
-        persisted = False
-        if self._ckptr is not None:
-            # a half-staged OLDER step holds the shard lock; the
-            # emergency save wants the CURRENT step (nobody saw the
-            # stale stage — abort is safe)
-            self._abort_stager()
-            try:
-                # EMERGENCY link priority: this drain races a platform
-                # kill — its chunks preempt any in-flight background
-                # spill/stage at their next chunk boundary
-                stager = self._ckptr.begin_chunked_save(
+            # announce FIRST: the master's proactive resize (rendezvous
+            # exclusion, speculative n-1 compile) runs while we drain
+            if self.tcfg.report_metrics:
+                report_runtime_metrics(
                     step,
-                    self._ckpt_state(),
-                    chunk_bytes=self.tcfg.stage_chunk_mb << 20,
-                    priority=transfer_sched.Priority.EMERGENCY,
+                    eviction_pending=1.0,
+                    eviction_grace_s=float(grace),
                 )
-                if stager is not None:
-                    # leave a commit-sized margin before the deadline
-                    while (
-                        not stager.done
-                        and time.monotonic() < deadline - 0.5
-                    ):
-                        stager.advance(
-                            budget_s=0.05, stats=self.pipeline_stats
-                        )
-                    if stager.done:
-                        committed = stager.commit(
-                            stats=self.pipeline_stats
-                        )
-                    else:
-                        # the window closed mid-stage: commit() would
-                        # drain the whole backlog UNBOUNDED and the
-                        # platform's kill would land mid-commit —
-                        # losing not just this checkpoint but the
-                        # forensics flush below. Abort; the previous
-                        # committed step stands (bounded loss <= one
-                        # save interval, the same contract as a hard
-                        # kill)
-                        stager.abort()
-                        logger.warning(
-                            f"eviction: emergency stage incomplete at "
-                            f"the deadline; aborted — the previous "
-                            f"committed step stands"
-                        )
-                else:
-                    # saver busy with an uncommitted save: the plain
-                    # memory save path skips-never-blocks too
-                    committed = self.save(StorageType.MEMORY)
-            except Exception as e:
-                logger.error(f"eviction emergency save failed: {e!r}")
-            remaining = deadline - time.monotonic()
-            if committed and not self._ckptr.engine._agent_mode:
-                # the sync (no-agent) engine's commit already wrote
-                # storage — the shm/persist split only exists under an
-                # agent saver
-                persisted = True
-            elif committed and remaining > self.tcfg.eviction_persist_floor_s:
+            if self._event_reporter is not None:
                 try:
-                    persisted = self.save(StorageType.DISK)
-                except Exception as e:
-                    logger.warning(
-                        f"eviction persist skipped ({e!r}); shm "
-                        f"handoff covers it"
+                    self._event_reporter(
+                        "eviction",
+                        f"grace={grace:.1f}s step={step} "
+                        f"reason={self._evict_reason}",
                     )
-            elif committed:
-                logger.info(
-                    f"eviction: {remaining:.1f}s left of the grace "
-                    f"window — skipping the DISK persist (shm handoff "
-                    f"covers it)"
-                )
-        self._close_prefetcher()
-        drain_ms = (time.perf_counter() - t0) * 1e3
-        self.eviction_drain_ms = drain_ms
-        self._goodput.eviction_end()
-        self.evicted = True
+                except Exception as e:
+                    logger.warning(f"eviction event report failed: {e!r}")
+            # the prefetcher's lookahead dies with us; the checkpoint's
+            # sampler snapshot rewinds it (same contract as _ckpt_state)
+            committed = False
+            persisted = False
+            if self._ckptr is not None:
+                # a half-staged OLDER step holds the shard lock; the
+                # emergency save wants the CURRENT step (nobody saw the
+                # stale stage — abort is safe)
+                self._abort_stager()
+                try:
+                    # EMERGENCY link priority: this drain races a platform
+                    # kill — its chunks preempt any in-flight background
+                    # spill/stage at their next chunk boundary
+                    stager = self._ckptr.begin_chunked_save(
+                        step,
+                        self._ckpt_state(),
+                        chunk_bytes=self.tcfg.stage_chunk_mb << 20,
+                        priority=transfer_sched.Priority.EMERGENCY,
+                    )
+                    if stager is not None:
+                        # leave a commit-sized margin before the deadline
+                        while (
+                            not stager.done
+                            and time.monotonic() < deadline - 0.5
+                        ):
+                            stager.advance(
+                                budget_s=0.05, stats=self.pipeline_stats
+                            )
+                        if stager.done:
+                            committed = stager.commit(
+                                stats=self.pipeline_stats
+                            )
+                        else:
+                            # the window closed mid-stage: commit() would
+                            # drain the whole backlog UNBOUNDED and the
+                            # platform's kill would land mid-commit —
+                            # losing not just this checkpoint but the
+                            # forensics flush below. Abort; the previous
+                            # committed step stands (bounded loss <= one
+                            # save interval, the same contract as a hard
+                            # kill)
+                            stager.abort()
+                            logger.warning(
+                                f"eviction: emergency stage incomplete at "
+                                f"the deadline; aborted — the previous "
+                                f"committed step stands"
+                            )
+                    else:
+                        # saver busy with an uncommitted save: the plain
+                        # memory save path skips-never-blocks too
+                        committed = self.save(StorageType.MEMORY)
+                except Exception as e:
+                    logger.error(f"eviction emergency save failed: {e!r}")
+                remaining = deadline - time.monotonic()
+                if committed and not self._ckptr.engine._agent_mode:
+                    # the sync (no-agent) engine's commit already wrote
+                    # storage — the shm/persist split only exists under an
+                    # agent saver
+                    persisted = True
+                elif committed and remaining > self.tcfg.eviction_persist_floor_s:
+                    try:
+                        persisted = self.save(StorageType.DISK)
+                    except Exception as e:
+                        logger.warning(
+                            f"eviction persist skipped ({e!r}); shm "
+                            f"handoff covers it"
+                        )
+                elif committed:
+                    logger.info(
+                        f"eviction: {remaining:.1f}s left of the grace "
+                        f"window — skipping the DISK persist (shm handoff "
+                        f"covers it)"
+                    )
+            self._close_prefetcher()
+        finally:
+            # the episode MUST close on every path (graftlint
+            # span-leak): an exception escaping the drain used to
+            # leak the eviction episode open, and the goodput
+            # ledger then booked every later second to `eviction`
+            drain_ms = (time.perf_counter() - t0) * 1e3
+            self.eviction_drain_ms = drain_ms
+            self._goodput.eviction_end()
+            self.evicted = True
         # flush: goodput + registry + the final runtime-metrics write
         # (carries the measured drain latency the master forwards to
         # the Brain's dwell pricing)
@@ -1253,11 +1259,16 @@ class ElasticTrainer:
             ):
                 # the sidecar records the PERSISTED best — written only
                 # after the commit, so a crash mid-save cannot leave it
-                # claiming a checkpoint that isn't there
-                tmp = f"{self._best_sidecar_path()}.tmp.{os.getpid()}"
-                with open(tmp, "w") as f:
-                    json.dump({"eval_loss": loss, "step": step}, f)
-                os.replace(tmp, self._best_sidecar_path())
+                # claiming a checkpoint that isn't there; durable
+                # (fsync-before-rename) because its whole contract is
+                # being as durable as the checkpoint it describes
+                # (graftlint durable-rename)
+                storage.durable_replace(
+                    self._best_sidecar_path(),
+                    lambda f: json.dump(
+                        {"eval_loss": loss, "step": step}, f
+                    ),
+                )
                 self._best_eval_loss = loss
                 self._last_best_save = time.time()
         return (

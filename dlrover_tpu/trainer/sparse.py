@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from dlrover_tpu.common import faults
+from dlrover_tpu.common.storage import fsync_dir
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.ops.embedding import ShardedKvEmbedding
 from dlrover_tpu.ops.embedding.device_tier import DeviceSparseEmbedding
@@ -387,6 +388,9 @@ class SparseTrainer:
             os.fsync(f.fileno())
         os.replace(self._meta_path(path) + ".tmp", self._meta_path(path))
         os.replace(tmp, path)
+        # both renames' directory entries must be durable before this
+        # save is treated as the rollback target
+        fsync_dir(os.path.dirname(path) or ".")
         logger.info(
             f"saved embedding state ({len(state['keys'])} rows, "
             f"crc {meta['crc32']:08x}) at step {self.step}"

@@ -437,6 +437,52 @@ class TestSpillLifetime:
         np.testing.assert_array_equal(got, trained)
         emb.close()
 
+    def test_fault_in_join_does_not_hold_the_link_grant(self):
+        """graftlint lock-discipline.grant regression — the real wedge
+        behind this suite's flakiness: prepare used to call join_spills
+        INSIDE its fault-in link grant while the drain's import waited
+        on that same link. The deadlock resolved only via the
+        arbiter's 30 s forced-grant backstop — AFTER join_spills' own
+        30 s timeout had fired ("embedding spill drain wedged"). With
+        the join hoisted before the grant, a gate-controlled slow
+        import must complete the fault-in as soon as it lands."""
+        base = _host()
+        drain_gate = threading.Event()
+
+        emb = DeviceSparseEmbedding(
+            base, capacity=64, sparse_optimizer="adagrad", lr=1.0
+        )
+        # gate the DRAIN's link acquisition (not its import): the wedge
+        # needed prepare to win the link while the spill was still
+        # pending — holding the drain here before its transfer() makes
+        # that ordering deterministic instead of a coin flip
+        real_stream = emb._spill_stream
+
+        class _GatedStream:
+            def transfer(self, *a, **kw):
+                assert drain_gate.wait(10.0), "test gate never released"
+                return real_stream.transfer(*a, **kw)
+
+            def __getattr__(self, name):
+                return getattr(real_stream, name)
+
+        emb._spill_stream = _GatedStream()
+        ids = np.arange(8, dtype=np.int64)
+        prep = emb.prepare(ids)
+        emb.apply_grads(prep, np.ones((8, DIM), np.float32), step=1)
+        trained = np.asarray(emb.gather(ids)).copy()
+        emb.evict_to_host(keep_rows=0)  # spill queued, drain GATED
+        threading.Timer(0.3, drain_gate.set).start()
+        t0 = time.perf_counter()
+        got = np.asarray(emb.gather(ids))  # fault the victims back in
+        elapsed = time.perf_counter() - t0
+        np.testing.assert_array_equal(got, trained)
+        assert elapsed < 8.0, (
+            f"fault-in stalled {elapsed:.1f}s — join running under the "
+            "held link grant again?"
+        )
+        emb.close()
+
     def test_join_spills_waits_for_import_not_queue(self):
         base = _host()
         emb = DeviceSparseEmbedding(

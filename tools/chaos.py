@@ -273,6 +273,7 @@ def _worker_train(args) -> int:
         progress["end_step"] = step
         with open(args.progress + ".tmp", "w") as f:
             json.dump(progress, f)
+        # graftlint: disable=durable-rename reason=harness progress telemetry at step cadence; the parent only needs atomic reads, and the scripted kill losing the last write is the scenario under test
         os.replace(args.progress + ".tmp", args.progress)
 
     t = _make_trainer(args.ckpt_dir, args.seed, hook)

@@ -1,0 +1,19 @@
+"""Checker registry. Each checker mechanizes one recurring review
+finding — docs/static-analysis.md maps every id to the historical PR
+finding it came from."""
+
+from tools.graftlint.checkers.locks import LockDisciplineChecker
+from tools.graftlint.checkers.spans import SpanLeakChecker
+from tools.graftlint.checkers.rpc import RpcIdempotencyChecker
+from tools.graftlint.checkers.metrics_docs import MetricDocDriftChecker
+from tools.graftlint.checkers.fault_sites import FaultSiteChecker
+from tools.graftlint.checkers.durable_rename import DurableRenameChecker
+
+ALL_CHECKERS = (
+    LockDisciplineChecker(),
+    SpanLeakChecker(),
+    RpcIdempotencyChecker(),
+    MetricDocDriftChecker(),
+    FaultSiteChecker(),
+    DurableRenameChecker(),
+)

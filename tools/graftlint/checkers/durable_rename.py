@@ -1,0 +1,104 @@
+"""durable-rename: a rename that commits freshly written bytes must
+fsync them first.
+
+Mechanizes the PR-11 finding (embedding checkpoints published with
+``os.replace`` but no ``fsync`` — after a power loss the rename can
+survive while the data doesn't, i.e. a "committed" checkpoint full of
+zeros). The write-tmp-then-rename idiom gives ATOMICITY; only
+``fsync`` before the rename gives DURABILITY, and every function in
+this repo that writes bytes and then renames them into place is
+claiming both unless it says otherwise.
+
+Rule (per function): ``os.replace``/``os.rename`` is flagged when the
+same function also writes a file (``open`` in a write mode,
+``os.fdopen``, ``ndarray.tofile``) but never calls ``fsync``.
+Rename-only moves (quarantines, rotations of already-durable files)
+have no write in scope and pass. Deliberate atomicity-only publishes
+(telemetry files readers re-poll) get a suppression with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graftlint.core import (
+    Context,
+    Finding,
+    call_name,
+    own_nodes,
+    const_str,
+    last_segment,
+    walk_functions,
+)
+
+_RENAMES = {"os.replace", "os.rename"}
+
+
+class DurableRenameChecker:
+    id = "durable-rename"
+    scope = "file"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for path in ctx.iter_files():
+            try:
+                tree = ctx.tree(path)
+            except (OSError, SyntaxError):
+                continue
+            rel = ctx.rel(path)
+            for fn in walk_functions(tree):
+                findings.extend(self._check(fn, rel))
+        return findings
+
+    def _check(self, fn, rel: str) -> List[Finding]:
+        renames = []
+        writes = False
+        fsynced = False
+        for node in own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in _RENAMES:
+                renames.append(node)
+            elif last_segment(name) == "fsync":
+                fsynced = True
+            elif _is_file_write(node, name):
+                writes = True
+        if not renames or not writes or fsynced:
+            return []
+        return [
+            Finding(
+                checker="durable-rename",
+                path=rel,
+                line=node.lineno,
+                message=(
+                    f"`{call_name(node)}` commits bytes this function "
+                    "wrote without an fsync — the rename can survive a "
+                    "crash the data doesn't"
+                ),
+                hint=(
+                    "flush+os.fsync(f.fileno()) before the rename (or "
+                    "suppress with a reason if this publish only needs "
+                    "atomicity)"
+                ),
+            )
+            for node in renames
+        ]
+
+
+
+def _is_file_write(node: ast.Call, name: str) -> bool:
+    seg = last_segment(name)
+    if name == "open" or seg == "fdopen":
+        mode_node = node.args[1] if len(node.args) >= 2 else None
+        for k in node.keywords:
+            if k.arg == "mode":
+                mode_node = k.value
+        if mode_node is None:
+            return False  # absent mode defaults to read for both
+        mode = const_str(mode_node)
+        if mode is None:
+            return True  # dynamic mode: conservatively a write
+        return any(c in mode for c in "wax+")
+    return seg == "tofile"

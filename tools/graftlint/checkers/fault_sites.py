@@ -1,0 +1,194 @@
+"""fault-site: the fault-point registry and its call sites must agree,
+and every registered site must be exercised by a test.
+
+``common/faults.py`` already makes arming a typo'd site a hard error;
+this closes the remaining gaps structurally:
+
+- a ``faults.fire("x")`` / ``faults.corrupt("x", ...)`` literal whose
+  site is NOT in ``FAULT_SITES`` can never be armed — the fault point
+  is dead on arrival (the module tolerates it at runtime, which is
+  exactly why only a static check catches it);
+- a registered site nothing in production fires is registry rot;
+- a registered site no test references (as a string literal — chaos
+  specs like ``"ckpt.persist:enospc:1.0"`` count) is a fault-injection
+  hook the chaos matrix silently stopped testing — the PR-8 "silent
+  fallback" class applied to the failure harness itself.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.core import (
+    Context,
+    Finding,
+    call_name,
+    last_segment,
+)
+
+_FIRE_FUNCS = {"fire", "corrupt", "corrupt_array"}
+_FAULTS_SUFFIX = "common/faults.py"
+
+
+class FaultSiteChecker:
+    id = "fault-site"
+    scope = "repo"
+
+    # tests that arm/assert sites; relative to ctx.root
+    tests_dir = "tests"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        faults_path = ctx.find_file(_FAULTS_SUFFIX)
+        if faults_path is None:
+            return []
+        registry = self._registry(ctx, faults_path)
+        if registry is None:
+            return []
+        sites, site_lines = registry
+
+        fired: Dict[str, List[Tuple[str, int]]] = {}
+        findings: List[Finding] = []
+        for path in ctx.iter_files(respect_changed=False):
+            if os.path.abspath(path) == os.path.abspath(faults_path):
+                continue
+            try:
+                tree = ctx.tree(path)
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                site_node = _fired_site(node)
+                if site_node is None:
+                    continue
+                site, lineno = site_node
+                fired.setdefault(site, []).append((path, lineno))
+                if site not in sites:
+                    findings.append(
+                        Finding(
+                            checker="fault-site",
+                            path=ctx.rel(path),
+                            line=lineno,
+                            message=(
+                                f"fault point {site!r} is not in "
+                                "FAULT_SITES — it can never be armed"
+                            ),
+                            hint=(
+                                "register it in common/faults.py "
+                                "FAULT_SITES (and give it a chaos test)"
+                            ),
+                        )
+                    )
+
+        test_literals = self._test_literals(ctx)
+        for site in sorted(sites):
+            line = site_lines.get(site, 1)
+            if site not in fired:
+                findings.append(
+                    Finding(
+                        checker="fault-site",
+                        path=ctx.rel(faults_path),
+                        line=line,
+                        message=(
+                            f"registered fault site {site!r} is never "
+                            "fired by production code"
+                        ),
+                        hint="remove it or wire the fault point back in",
+                    )
+                )
+            if not any(site in lit for lit in test_literals):
+                findings.append(
+                    Finding(
+                        checker="fault-site",
+                        path=ctx.rel(faults_path),
+                        line=line,
+                        message=(
+                            f"registered fault site {site!r} is not "
+                            "referenced by any test"
+                        ),
+                        hint=(
+                            "add a chaos-matrix test arming it (see "
+                            "tests/test_faults.py) or remove the site "
+                            "with rationale"
+                        ),
+                    )
+                )
+        return findings
+
+    def _registry(
+        self, ctx, faults_path: str
+    ) -> Optional[Tuple[Set[str], Dict[str, int]]]:
+        try:
+            tree = ctx.tree(faults_path)
+        except (OSError, SyntaxError):
+            return None
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == "FAULT_SITES"
+                for t in node.targets
+            ):
+                continue
+            value = node.value
+            if (
+                isinstance(value, ast.Call)
+                and last_segment(call_name(value)) == "frozenset"
+                and value.args
+            ):
+                value = value.args[0]
+            if isinstance(value, (ast.Set, ast.List, ast.Tuple)):
+                sites: Set[str] = set()
+                lines: Dict[str, int] = {}
+                for el in value.elts:
+                    if isinstance(el, ast.Constant) and isinstance(
+                        el.value, str
+                    ):
+                        sites.add(el.value)
+                        lines[el.value] = el.lineno
+                return sites, lines
+        return None
+
+    def _test_literals(self, ctx) -> List[str]:
+        out: List[str] = []
+        tests = os.path.join(ctx.root, self.tests_dir)
+        if not os.path.isdir(tests):
+            return out
+        for dirpath, dirnames, filenames in os.walk(tests):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    tree = ast.parse(
+                        open(path, "r", encoding="utf-8").read()
+                    )
+                except (OSError, SyntaxError):
+                    continue
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.Constant) and isinstance(
+                        node.value, str
+                    ):
+                        out.append(node.value)
+        return out
+
+
+def _fired_site(node: ast.AST) -> Optional[Tuple[str, int]]:
+    if not isinstance(node, ast.Call) or not node.args:
+        return None
+    name = call_name(node)
+    seg = last_segment(name)
+    if seg not in _FIRE_FUNCS:
+        return None
+    recv = name.rsplit(".", 1)[0] if "." in name else ""
+    if "faults" not in recv and recv != "":
+        return None
+    if recv == "" and seg not in ("fire",):
+        # bare corrupt()/corrupt_array() could be anything; bare fire()
+        # only exists as the faults module's re-export
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, node.lineno
+    return None

@@ -1,0 +1,636 @@
+"""lock-discipline: the static lock-acquisition graph.
+
+Mechanizes the PR-14 review finding (an ABBA deadlock between the
+embedding store lock and a transfer-arbiter grant) and the standing
+rule that the host-link arbiter is a LEAF lock, plus the brownout
+class PR 5/14 kept re-fixing by hand: a ``MasterClient`` RPC (full-
+jitter retries, up to a 60 s budget) or other unbounded blocking call
+executed while a lock is held starves every peer of that lock for the
+whole stall.
+
+Two sub-ids:
+
+- ``lock-discipline.cycle`` — a cycle in the cross-class lock graph:
+  lock A is held while (possibly through one level of calls) lock B is
+  acquired, and elsewhere B is held while A is acquired.
+- ``lock-discipline.blocking`` — a blocking call under a held lock:
+  ``time.sleep``, client RPCs (receiver named ``*client``), zero-arg
+  ``.join()``, untimed ``.wait()`` on an object other than the held
+  lock, untimed queue ``.get()``, file I/O
+  (``open``/``os.replace``/``os.rename``/``os.fsync``), subprocess
+  calls, and host-link arbiter acquisition (``.transfer(...)`` /
+  arbiter ``.acquire(...)`` — the leaf-lock rule).
+
+The graph is built from ``with self._x:`` regions over attributes
+assigned a ``threading.Lock/RLock/Condition/Semaphore`` (or any class
+whose name ends in ``Lock``), module-level locks included; calls are
+resolved one level deep: ``self.method()`` through the class's own
+summary, ``self._attr.method()`` through constructor assignments
+``self._attr = ClassName(...)`` matched repo-wide by class name.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.core import (
+    Context,
+    Finding,
+    call_name,
+    last_segment,
+    own_nodes,
+    walk_functions,
+)
+
+# constructors whose result is a lock-like object
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+# one pseudo-node for the host-link arbiter: every stream/arbiter
+# acquisition converges on TransferArbiter._cond, and the repo rule is
+# that it is a leaf (never acquired while any other lock is held)
+ARBITER_NODE = "parallel/transfer_sched:TransferArbiter._cond"
+
+_CLIENT_RE = re.compile(r"(^|[._])client$")
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = last_segment(call_name(node))
+    return name in _LOCK_CTORS or name.endswith("Lock")
+
+
+@dataclass
+class _ClassInfo:
+    module: str  # repo-relative path without .py
+    name: str
+    lock_attrs: Set[str] = field(default_factory=set)
+    # method name -> set of lock node ids acquired directly
+    method_locks: Dict[str, Set[str]] = field(default_factory=dict)
+    # method name -> same-class methods it calls (for closure)
+    method_calls: Dict[str, Set[str]] = field(default_factory=dict)
+    # attr name -> class NAME it was constructed from (one-level types)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    # method name -> why it waits (sleep/join/untimed wait), if it does
+    # — resolved one level deep through self-calls like method_locks,
+    # so `self._helper()` under a link grant is checked through the
+    # helper's body
+    method_waits: Dict[str, str] = field(default_factory=dict)
+
+    def lock_node(self, attr: str) -> str:
+        return f"{self.module}:{self.name}.{attr}"
+
+
+def _module_key(ctx: Context, path: str) -> str:
+    rel = ctx.rel(path).replace(os.sep, "/")
+    return rel[:-3] if rel.endswith(".py") else rel
+
+
+class LockDisciplineChecker:
+    id = "lock-discipline"
+    scope = "repo"  # the graph is cross-file even if sites are local
+
+    def run(self, ctx: Context) -> List[Finding]:
+        classes: Dict[str, _ClassInfo] = {}  # by class NAME (repo-wide)
+        module_locks: Dict[str, Set[str]] = {}  # path -> lock var names
+        parsed: List[Tuple[str, ast.AST]] = []
+        for path in ctx.iter_files(respect_changed=False):
+            try:
+                tree = ctx.tree(path)
+            except (OSError, SyntaxError):
+                continue
+            parsed.append((path, tree))
+            self._collect(ctx, path, tree, classes, module_locks)
+        # resolve raw acquired-attr names to lock node ids ONCE, after
+        # every file's lock_attrs are known (doing it per file would
+        # re-filter — and empty — earlier files' summaries)
+        for info in classes.values():
+            for meth, attrs in list(info.method_locks.items()):
+                info.method_locks[meth] = {
+                    info.lock_node(a)
+                    for a in attrs
+                    if a in info.lock_attrs
+                }
+        self._close_over_self_calls(classes)
+
+        findings: List[Finding] = []
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        changed = (
+            None
+            if ctx.changed is None
+            else {os.path.abspath(c) for c in ctx.changed}
+        )
+        for path, tree in parsed:
+            emit = changed is None or os.path.abspath(path) in changed
+            self._analyze(
+                ctx, path, tree, classes, module_locks,
+                edges, findings if emit else [],
+            )
+        findings.extend(self._find_cycles(edges))
+        return findings
+
+    # -- phase 1: summaries -------------------------------------------
+    def _collect(self, ctx, path, tree, classes, module_locks):
+        mod = _module_key(ctx, path)
+        mlocks: Set[str] = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        mlocks.add(t.id)
+        module_locks[os.path.abspath(path)] = mlocks
+
+        for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+            info = _ClassInfo(module=mod, name=cls.name)
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                acquired: Set[str] = set()
+                calls: Set[str] = set()
+                annotations = _param_annotations(fn)
+                # attribute DISCOVERY walks the whole function, nested
+                # defs included — a closure assigning self._x types the
+                # same instance. lock ACQUISITION and self-calls are
+                # scoped to own_nodes: a nested def's body (a daemon
+                # loop, a thread target) does not run when the method
+                # runs, and attributing its `with self._lock` to the
+                # enclosing method fabricates held-edges (phase 2
+                # analyzes nested defs as their own units)
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Assign) and isinstance(
+                        sub.value, ast.Call
+                    ):
+                        for t in sub.targets:
+                            attr = _self_attr(t)
+                            if attr is None:
+                                continue
+                            if _is_lock_ctor(sub.value):
+                                info.lock_attrs.add(attr)
+                            else:
+                                ctor = last_segment(call_name(sub.value))
+                                if ctor and ctor[0].isupper():
+                                    info.attr_types[attr] = ctor
+                    elif isinstance(sub, ast.Assign) and isinstance(
+                        sub.value, ast.Name
+                    ):
+                        # `self._x = param` with an annotated param:
+                        # the annotation names the class (one-level
+                        # nominal typing, enough for the ABBA class)
+                        ann = annotations.get(sub.value.id)
+                        if ann:
+                            for t in sub.targets:
+                                attr = _self_attr(t)
+                                if attr is not None:
+                                    info.attr_types[attr] = ann
+                for sub in own_nodes(fn):
+                    if isinstance(sub, ast.With):
+                        for item in sub.items:
+                            attr = _self_attr(item.context_expr)
+                            if attr is not None:
+                                acquired.add(attr)
+                    if isinstance(sub, ast.Call):
+                        fname = call_name(sub)
+                        if fname.startswith("self.") and "." not in fname[5:]:
+                            calls.add(fname[5:])
+                        # explicit self._x.acquire() counts as acquiring
+                        m = re.fullmatch(
+                            r"self\.(\w+)\.acquire(?:_read|_write)?", fname
+                        )
+                        if m:
+                            acquired.add(m.group(1))
+                        wait = _grant_wait_reason(
+                            sub, fname, last_segment(fname)
+                        )
+                        if wait and fn.name not in info.method_waits:
+                            info.method_waits[fn.name] = wait
+                # raw attr names for now; resolved to lock nodes below
+                # once lock_attrs is fully known (locks may be assigned
+                # in a different method than the one acquiring them)
+                info.method_calls[fn.name] = calls
+                info.method_locks[fn.name] = acquired  # type: ignore
+            classes[cls.name] = info
+
+    def _close_over_self_calls(self, classes: Dict[str, _ClassInfo]):
+        """Transitive closure of method lock summaries within a class
+        (``self.foo()`` acquiring through ``self.bar()``)."""
+        for info in classes.values():
+            changed = True
+            while changed:
+                changed = False
+                for meth, calls in info.method_calls.items():
+                    cur = info.method_locks.setdefault(meth, set())
+                    for callee in calls:
+                        extra = info.method_locks.get(callee, set())
+                        if not extra <= cur:
+                            cur |= extra
+                            changed = True
+                        wait = info.method_waits.get(callee)
+                        if wait and meth not in info.method_waits:
+                            info.method_waits[meth] = (
+                                f"via self.{callee}(): {wait}"
+                            )
+                            changed = True
+
+    # -- phase 2: per-function held-region analysis --------------------
+    def _analyze(
+        self, ctx, path, tree, classes, module_locks, edges, findings
+    ):
+        rel = ctx.rel(path)
+        mlocks = module_locks.get(os.path.abspath(path), set())
+        mod = _module_key(ctx, path)
+
+        # which class encloses each function
+        encl: Dict[ast.AST, Optional[_ClassInfo]] = {}
+        for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+            info = classes.get(cls.name)
+            for fn in ast.walk(cls):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    encl[fn] = info
+
+        checker = self
+
+        for fn in walk_functions(tree):
+            info = encl.get(fn)
+
+            class V(ast.NodeVisitor):
+                def __init__(self):
+                    # held: list of (node_id, unparsed acquire expr)
+                    self.held: List[Tuple[str, str]] = []
+                    # host-link grant regions (`with x.transfer(...)`)
+                    self.grants: List[str] = []
+
+                def _resolve_lock(self, expr: ast.AST) -> Optional[str]:
+                    attr = _self_attr(expr)
+                    if (
+                        attr is not None
+                        and info is not None
+                        and attr in info.lock_attrs
+                    ):
+                        return info.lock_node(attr)
+                    if isinstance(expr, ast.Name) and expr.id in mlocks:
+                        return f"{mod}:{expr.id}"
+                    return None
+
+                def visit_With(self, node: ast.With):
+                    pushed = 0
+                    granted = 0
+                    for item in node.items:
+                        lock = self._resolve_lock(item.context_expr)
+                        if lock is not None:
+                            self._acquire(lock, item.context_expr, node)
+                            self.held.append(
+                                (lock, _safe_unparse(item.context_expr))
+                            )
+                            pushed += 1
+                        elif _is_grant_expr(item.context_expr):
+                            self.grants.append(
+                                _safe_unparse(item.context_expr)
+                            )
+                            granted += 1
+                    # non-lock context exprs (e.g. `with x.transfer():`)
+                    # are plain Calls — generic_visit dispatches them to
+                    # visit_Call below with the held stack up to date
+                    self.generic_visit(node)
+                    for _ in range(pushed):
+                        self.held.pop()
+                    for _ in range(granted):
+                        self.grants.pop()
+
+                visit_AsyncWith = visit_With
+
+                def _acquire(self, lock: str, expr, node):
+                    for held, _ in self.held:
+                        if held != lock:
+                            edges.setdefault(
+                                (held, lock), (rel, node.lineno)
+                            )
+
+                def visit_Call(self, node: ast.Call):
+                    self._check_call(node)
+                    self.generic_visit(node)
+
+                def _check_call(self, node: ast.Call):
+                    fname = call_name(node)
+                    seg = last_segment(fname)
+                    recv = fname.rsplit(".", 1)[0] if "." in fname else ""
+
+                    if self.grants:
+                        # a wait under a held host-link grant: the
+                        # thread being waited on may itself need the
+                        # link (the device-tier spill drain did — the
+                        # grant-holding join_spills deadlocked against
+                        # the drain's own acquire)
+                        wait = _grant_wait_reason(node, fname, seg)
+                        if (
+                            wait is None
+                            and info is not None
+                            and fname.startswith("self.")
+                            and "." not in fname[5:]
+                        ):
+                            via = info.method_waits.get(fname[5:])
+                            if via:
+                                wait = f"`{fname}(...)` waits ({via})"
+                        if wait:
+                            findings.append(
+                                Finding(
+                                    checker="lock-discipline.grant",
+                                    path=rel,
+                                    line=node.lineno,
+                                    message=(
+                                        f"{wait} while holding the "
+                                        "host-link grant "
+                                        f"{self.grants[-1]}"
+                                    ),
+                                    hint=(
+                                        "wait BEFORE acquiring the "
+                                        "grant (or release it first): "
+                                        "the waited-on thread may need "
+                                        "the link, and the arbiter "
+                                        "backstop outlasts most join "
+                                        "timeouts"
+                                    ),
+                                )
+                            )
+
+                    if not self.held:
+                        # still record nothing: edges need a held lock
+                        return
+                    lock = self._resolve_lock(node.func.value) if isinstance(
+                        node.func, ast.Attribute
+                    ) else None
+
+                    # direct acquire of another lock object
+                    if seg in ("acquire", "acquire_read", "acquire_write"):
+                        if lock is not None:
+                            self._acquire(lock, node, node)
+                            return
+                        if _is_arbiterish(recv):
+                            self._arbiter_edge(node)
+                            return
+                    if seg == "transfer" and recv:
+                        # the only `.transfer(...)` receivers in this
+                        # repo are host-link streams — leaf-lock rule
+                        self._arbiter_edge(node)
+                        return
+
+                    # interprocedural one level: self.method() and
+                    # typed-attr method calls
+                    target_locks = checker._callee_locks(
+                        node, info, classes
+                    )
+                    for tl in target_locks:
+                        for held, _ in self.held:
+                            if held != tl:
+                                edges.setdefault(
+                                    (held, tl), (rel, node.lineno)
+                                )
+
+                    blocked = _blocking_reason(node, fname, seg, self.held)
+                    if blocked:
+                        findings.append(
+                            Finding(
+                                checker="lock-discipline.blocking",
+                                path=rel,
+                                line=node.lineno,
+                                message=(
+                                    f"{blocked} while holding "
+                                    f"{self.held[-1][1]}"
+                                ),
+                                hint=(
+                                    "move the blocking call outside the "
+                                    "lock (collect under the lock, act "
+                                    "after releasing)"
+                                ),
+                            )
+                        )
+
+                def _arbiter_edge(self, node: ast.Call):
+                    for held, expr in self.held:
+                        edges.setdefault(
+                            (held, ARBITER_NODE), (rel, node.lineno)
+                        )
+                    findings.append(
+                        Finding(
+                            checker="lock-discipline.blocking",
+                            path=rel,
+                            line=node.lineno,
+                            message=(
+                                "host-link arbiter acquired while "
+                                f"holding {self.held[-1][1]} (the "
+                                "arbiter is a leaf lock: grants can "
+                                "wait tens of seconds behind an "
+                                "emergency drain)"
+                            ),
+                            hint=(
+                                "acquire the grant before taking the "
+                                "lock, or release around the transfer"
+                            ),
+                        )
+                    )
+
+                # a nested def is its own analysis unit: its body does
+                # not run under the enclosing with
+                def visit_FunctionDef(self, node):
+                    if node is not fn:
+                        return
+                    self.generic_visit(node)
+
+                visit_AsyncFunctionDef = visit_FunctionDef
+
+                def visit_Lambda(self, node):
+                    return
+
+            V().visit(fn)
+
+    def _callee_locks(
+        self,
+        node: ast.Call,
+        info: Optional[_ClassInfo],
+        classes: Dict[str, _ClassInfo],
+    ) -> Set[str]:
+        fname = call_name(node)
+        if info is not None and fname.startswith("self."):
+            rest = fname[5:]
+            if "." not in rest:
+                return info.method_locks.get(rest, set())
+            attr, meth = rest.split(".", 1)
+            if "." not in meth:
+                cls_name = info.attr_types.get(attr)
+                target = classes.get(cls_name) if cls_name else None
+                if target is not None:
+                    return target.method_locks.get(meth, set())
+        return set()
+
+    # -- cycles --------------------------------------------------------
+    def _find_cycles(
+        self, edges: Dict[Tuple[str, str], Tuple[str, int]]
+    ) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+        findings: List[Finding] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        for start in sorted(graph):
+            stack = [(start, [start])]
+            while stack:
+                node, trail = stack.pop()
+                for nxt in sorted(graph.get(node, ())):
+                    if nxt == start:
+                        cyc = trail + [start]
+                        key = _canonical_cycle(cyc)
+                        if key in seen_cycles:
+                            continue
+                        seen_cycles.add(key)
+                        site = edges[(trail[-1], start)]
+                        findings.append(
+                            Finding(
+                                checker="lock-discipline.cycle",
+                                path=site[0],
+                                line=site[1],
+                                message=(
+                                    "lock-order cycle: "
+                                    + " -> ".join(cyc)
+                                ),
+                                hint=(
+                                    "pick one global order for these "
+                                    "locks (or drop one edge by moving "
+                                    "the inner acquisition outside)"
+                                ),
+                            )
+                        )
+                    elif nxt not in trail and len(trail) < 8:
+                        stack.append((nxt, trail + [nxt]))
+        return findings
+
+
+def _param_annotations(fn) -> Dict[str, str]:
+    """``{param: ClassName}`` from simple annotations (``x: Store`` or
+    ``x: "Store"``)."""
+    out: Dict[str, str] = {}
+    args = list(fn.args.posonlyargs) + list(fn.args.args) + list(
+        fn.args.kwonlyargs
+    )
+    for a in args:
+        ann = a.annotation
+        name = None
+        if isinstance(ann, ast.Name):
+            name = ann.id
+        elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            name = ann.value
+        elif isinstance(ann, ast.Attribute):
+            name = ann.attr
+        if name and name[:1].isupper():
+            out[a.arg] = name
+    return out
+
+
+def _canonical_cycle(cyc: List[str]) -> Tuple[str, ...]:
+    """Rotation-invariant key: the cycle starting at its smallest
+    node (``cyc`` arrives closed, first == last)."""
+    nodes = cyc[:-1]
+    pivot = nodes.index(min(nodes))
+    return tuple(nodes[pivot:] + nodes[:pivot])
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _safe_unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return "<lock>"
+
+
+def _is_arbiterish(recv: str) -> bool:
+    low = last_segment(recv).lower()
+    return "arbiter" in low or low.endswith("stream") or "_stream" in low
+
+
+def _has_timeout(node: ast.Call) -> bool:
+    if any(k.arg == "timeout" for k in node.keywords):
+        return True
+    return bool(node.args)
+
+
+def _is_grant_expr(expr: ast.AST) -> bool:
+    """``with <recv>.transfer(...):`` — the only ``.transfer``
+    receivers in this repo are host-link streams."""
+    if not isinstance(expr, ast.Call):
+        return False
+    fname = call_name(expr)
+    return last_segment(fname) == "transfer" and "." in fname
+
+
+def _grant_wait_reason(
+    node: ast.Call, fname: str, seg: str
+) -> Optional[str]:
+    """Why this call waits on another thread — the calls that must not
+    run under a held host-link grant (even TIMED joins: the arbiter's
+    forced-grant backstop outlasts most join timeouts, so the deadlock
+    resolves as two cascading 30 s stalls instead of a hang)."""
+    recv = fname.rsplit(".", 1)[0] if "." in fname else ""
+    if seg == "sleep":
+        return f"`{fname}(...)` sleeps"
+    if seg.startswith("join") and recv and not recv.endswith("path"):
+        threadish = "thread" in recv.lower() or recv == "self"
+        if seg != "join" or threadish or (
+            not node.args and not node.keywords
+        ):
+            return f"`{fname}(...)` is a join barrier"
+    if seg == "wait" and not _has_timeout(node):
+        return f"untimed `{fname}()`"
+    if seg == "get" and _is_queueish(recv) and not _has_timeout(node):
+        return f"untimed queue `{fname}()`"
+    return None
+
+
+def _blocking_reason(
+    node: ast.Call,
+    fname: str,
+    seg: str,
+    held: List[Tuple[str, str]],
+) -> Optional[str]:
+    recv = fname.rsplit(".", 1)[0] if "." in fname else ""
+    if seg == "sleep":
+        return f"`{fname}(...)` sleeps"
+    if fname == "open":
+        return "file I/O (`open`)"
+    if fname in ("os.replace", "os.rename", "os.fsync"):
+        return f"file I/O (`{fname}`)"
+    if fname.startswith("subprocess."):
+        return f"subprocess call (`{fname}`)"
+    if _CLIENT_RE.search(recv.lower()):
+        return f"RPC `{fname}(...)` (retry budget can stall for 60s)"
+    if seg == "join" and not node.args and not node.keywords:
+        return f"unbounded `{fname}()`"
+    if seg == "wait" and not _has_timeout(node):
+        # Condition.wait() on the HELD lock releases it — the standard
+        # pattern, not a blocking-under-lock bug. Waiting on anything
+        # else (or with an outer lock still held) blocks for real.
+        held_exprs = {e for _, e in held}
+        recv_expr = recv
+        if recv_expr in held_exprs and len(held) == 1:
+            return None
+        return f"untimed `{fname}()` (outer lock stays held)"
+    # note: queue .put() is NOT flagged — whether it blocks depends on
+    # the queue's boundedness, which is not statically visible here
+    if seg == "get" and _is_queueish(recv) and not _has_timeout(node):
+        return f"untimed queue `{fname}()`"
+    return None
+
+
+def _is_queueish(recv: str) -> bool:
+    low = last_segment(recv).lower()
+    return "queue" in low or low.endswith("_q")

@@ -1,0 +1,229 @@
+"""metric-doc-drift: the `dlrover_*` registry names and the table in
+docs/observability.md must agree, both directions.
+
+Every PR that touched telemetry re-synced the "Prometheus names" table
+by hand, and PR reviews kept catching rows that drifted (a renamed
+gauge, an undocumented counter). This checker makes the table
+structural:
+
+- every metric name constructed in code (first argument of a registry
+  ``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` call that
+  starts with ``dlrover_``) must match a documented row — exactly, or
+  via a documented ``dlrover_<prefix>_<field>`` placeholder row;
+- every documented exact name must be constructed somewhere in code;
+  every documented placeholder prefix must have a matching dynamic
+  construction (f-string / ``PREFIX + name``).
+
+Dynamic names resolve to their static prefix: ``f"dlrover_train_{k}"``
+and ``METRIC_PREFIX + name`` (module-level string constant) both
+register as prefixes.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.core import (
+    Context,
+    Finding,
+    call_name,
+    last_segment,
+)
+
+_REGISTRY_METHODS = {"counter", "gauge", "histogram"}
+_DOC_PATH = os.path.join("docs", "observability.md")
+_DOC_NAME_RE = re.compile(r"`(dlrover_[^`]+)`")
+
+
+class MetricDocDriftChecker:
+    id = "metric-doc-drift"
+    scope = "repo"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        doc_path = os.path.join(ctx.root, _DOC_PATH)
+        if not os.path.exists(doc_path):
+            return []
+        doc_exact, doc_prefix = self._doc_names(doc_path)
+        code_exact, code_prefix, weak_exact, weak_prefix = (
+            self._code_names(ctx)
+        )
+
+        findings: List[Finding] = []
+        rel_doc = os.path.relpath(doc_path, ctx.root)
+
+        for name, (path, line) in sorted(code_exact.items()):
+            if name in doc_exact:
+                continue
+            if any(name.startswith(p) for p in doc_prefix):
+                continue
+            findings.append(
+                Finding(
+                    checker="metric-doc-drift",
+                    path=ctx.rel(path),
+                    line=line,
+                    message=(
+                        f"metric `{name}` has no row in "
+                        "docs/observability.md"
+                    ),
+                    hint="add a row to the Prometheus-names table",
+                )
+            )
+        for prefix, (path, line) in sorted(code_prefix.items()):
+            if prefix in doc_prefix:
+                continue
+            if any(e.startswith(prefix) for e in doc_exact):
+                continue
+            findings.append(
+                Finding(
+                    checker="metric-doc-drift",
+                    path=ctx.rel(path),
+                    line=line,
+                    message=(
+                        f"dynamic metric prefix `{prefix}*` has no "
+                        "matching row in docs/observability.md"
+                    ),
+                    hint=(
+                        "document the family as "
+                        f"`{prefix}<field>` in the table"
+                    ),
+                )
+            )
+        for name, line in sorted(doc_exact.items()):
+            # doc-side direction matches against the WEAK code sets
+            # (any dlrover_* string constant, any dynamic head): some
+            # families are registered through variables the static pass
+            # cannot resolve — a doc row is stale only when the name
+            # appears nowhere at all
+            if name in code_exact or name in weak_exact:
+                continue
+            if any(
+                name.startswith(p) for p in set(code_prefix) | weak_prefix
+            ):
+                continue
+            findings.append(
+                Finding(
+                    checker="metric-doc-drift",
+                    path=rel_doc,
+                    line=line,
+                    message=(
+                        f"documented metric `{name}` is not "
+                        "constructed anywhere in code"
+                    ),
+                    hint="delete the stale row or restore the metric",
+                )
+            )
+        for prefix, line in sorted(doc_prefix.items()):
+            if prefix in code_prefix or prefix in weak_prefix:
+                continue
+            if any(
+                e.startswith(prefix)
+                for e in set(code_exact) | weak_exact
+            ):
+                continue
+            findings.append(
+                Finding(
+                    checker="metric-doc-drift",
+                    path=rel_doc,
+                    line=line,
+                    message=(
+                        f"documented metric family `{prefix}<...>` has "
+                        "no matching construction in code"
+                    ),
+                    hint="delete the stale row or restore the family",
+                )
+            )
+        return findings
+
+    # -- doc side ------------------------------------------------------
+    def _doc_names(
+        self, doc_path: str
+    ) -> Tuple[Dict[str, int], Dict[str, int]]:
+        exact: Dict[str, int] = {}
+        prefix: Dict[str, int] = {}
+        with open(doc_path, "r", encoding="utf-8") as f:
+            for lineno, line in enumerate(f, start=1):
+                if not line.lstrip().startswith("|"):
+                    continue
+                for tok in _DOC_NAME_RE.findall(line):
+                    tok = tok.split("{", 1)[0].strip()
+                    if "<" in tok:
+                        prefix.setdefault(tok.split("<", 1)[0], lineno)
+                    elif re.fullmatch(r"dlrover_\w+", tok):
+                        exact.setdefault(tok, lineno)
+        return exact, prefix
+
+    # -- code side -----------------------------------------------------
+    def _code_names(self, ctx: Context):
+        exact: Dict[str, Tuple[str, int]] = {}
+        prefix: Dict[str, Tuple[str, int]] = {}
+        weak_exact: Set[str] = set()
+        weak_prefix: Set[str] = set()
+        for path in ctx.iter_files(respect_changed=False):
+            try:
+                tree = ctx.tree(path)
+            except (OSError, SyntaxError):
+                continue
+            consts = _module_str_constants(tree)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str
+                ):
+                    if re.fullmatch(r"dlrover_\w+", node.value):
+                        weak_exact.add(node.value)
+                    elif node.value.startswith("dlrover_"):
+                        weak_prefix.add(node.value)
+                if isinstance(node, ast.JoinedStr) and node.values:
+                    head = node.values[0]
+                    if isinstance(head, ast.Constant) and isinstance(
+                        head.value, str
+                    ) and head.value.startswith("dlrover_"):
+                        weak_prefix.add(head.value)
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                if last_segment(call_name(node)) not in _REGISTRY_METHODS:
+                    continue
+                name, is_prefix = _static_name(node.args[0], consts)
+                if name is None or not name.startswith("dlrover_"):
+                    continue
+                bucket = prefix if is_prefix else exact
+                bucket.setdefault(name, (path, node.lineno))
+        return exact, prefix, weak_exact, weak_prefix
+
+
+def _module_str_constants(tree: ast.AST) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in getattr(tree, "body", []):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ):
+            if isinstance(node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = node.value.value
+    return out
+
+
+def _static_name(
+    node: ast.AST, consts: Dict[str, str]
+) -> Tuple[Optional[str], bool]:
+    """(name-or-prefix, is_prefix) for a metric-name expression."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, False
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = node.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            only = len(node.values) == 1
+            return head.value, not only
+        return None, False
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = node.left
+        if isinstance(left, ast.Constant) and isinstance(left.value, str):
+            return left.value, True
+        if isinstance(left, ast.Name) and left.id in consts:
+            return consts[left.id], True
+    if isinstance(node, ast.Name) and node.id in consts:
+        return consts[node.id], False
+    return None, False

@@ -1,0 +1,393 @@
+"""rpc-idempotency: the dispatch matrix and retry semantics, made
+structural.
+
+Mechanizes two review rituals:
+
+- the PR-9/PR-14 dispatch-matrix tests — every message class the
+  clients send must have a servicer dispatch arm, and every dispatch
+  arm must correspond to a message something actually constructs (a
+  dead arm is a removed feature still answering on the wire);
+- the retry-semantics audit — ``MasterClient.report`` retries by
+  default, so a message whose server-side application is NOT
+  idempotent (replaying it on a lost response double-applies) must be
+  sent with ``idempotent=False`` or ``retries=1``. The non-idempotent
+  set is declared here, next to the check, and reviewed when comm.py
+  grows a message.
+
+Sub-ids: ``rpc-idempotency.retry`` (bad retry semantics at a send
+site), ``rpc-idempotency.dispatch`` (matrix holes).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.core import (
+    Context,
+    Finding,
+    call_name,
+    last_segment,
+)
+
+# Message classes whose server-side application double-applies on
+# replay. Reviewed when comm.py changes:
+# - KeyValueAdd: the kv store's counter add — a replayed add is a
+#   double increment (master_client.kv_store_add passes
+#   idempotent=False for exactly this reason).
+# Deliberately NOT here:
+# - EvictionNotice: the job manager upserts by node — the second
+#   report updates the event (comm.py docstring); its retries=1 is a
+#   latency choice, not a correctness one.
+# - BrainMetricsReport: the datastore dedups exact (job, ts, step)
+#   replays (brain/service.py persist_metrics), so the retried series
+#   leg cannot double-insert a sample.
+NON_IDEMPOTENT = {"KeyValueAdd"}
+
+# envelopes and pure-payload carriers that never ride dispatch alone
+_EXEMPT = {
+    "Message", "BaseRequest", "BaseResponse",
+}
+
+_COMM_SUFFIXES = ("common/comm.py",)
+_SERVICER_SUFFIXES = ("master/servicer.py", "brain/service.py")
+_CLIENT_SUFFIXES = ("agent/master_client.py", "brain/service.py")
+
+
+class RpcIdempotencyChecker:
+    id = "rpc-idempotency"
+    scope = "repo"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        comm_path = ctx.find_file(*_COMM_SUFFIXES)
+        if comm_path is None:
+            return []
+        findings: List[Finding] = []
+
+        comm_classes = self._comm_classes(ctx, comm_path)
+        dispatched = self._dispatched(ctx)
+        constructed_all, constructed_clients = self._constructions(ctx)
+
+        # (a) retry semantics at client send sites
+        findings.extend(self._check_retry_sites(ctx))
+
+        # (b) client-sent request classes must have a dispatch arm
+        for cls, sites in sorted(constructed_clients.items()):
+            if cls not in comm_classes or cls in _EXEMPT:
+                continue
+            if cls in dispatched:
+                continue
+            # response types are constructed server-side and returned;
+            # only classes a client passes to get()/report() matter —
+            # sites here are exactly those (see _constructions)
+            path, line = sites[0]
+            findings.append(
+                Finding(
+                    checker="rpc-idempotency.dispatch",
+                    path=ctx.rel(path),
+                    line=line,
+                    message=(
+                        f"comm.{cls} is sent by a client but has no "
+                        "servicer dispatch arm (isinstance check)"
+                    ),
+                    hint=(
+                        "add a dispatch arm in master/servicer.py or "
+                        "brain/service.py (and a test in the dispatch "
+                        "matrix)"
+                    ),
+                )
+            )
+
+        # (c) dispatch arms for classes nothing constructs (dead arms)
+        for cls, (path, line) in sorted(dispatched.items()):
+            if cls not in comm_classes:
+                continue
+            if cls not in constructed_all:
+                findings.append(
+                    Finding(
+                        checker="rpc-idempotency.dispatch",
+                        path=ctx.rel(path),
+                        line=line,
+                        message=(
+                            f"dispatch arm for comm.{cls} but nothing "
+                            "in the tree constructs it (dead arm)"
+                        ),
+                        hint=(
+                            "remove the arm or restore the client "
+                            "method that sends it"
+                        ),
+                    )
+                )
+
+        # (d) comm classes nothing references at all
+        for cls, line in sorted(comm_classes.items()):
+            if cls in _EXEMPT:
+                continue
+            if cls not in dispatched and cls not in constructed_all:
+                findings.append(
+                    Finding(
+                        checker="rpc-idempotency.dispatch",
+                        path=ctx.rel(comm_path),
+                        line=line,
+                        message=(
+                            f"message class {cls} is neither "
+                            "dispatched nor constructed anywhere"
+                        ),
+                        hint="delete it or wire it up",
+                    )
+                )
+        return findings
+
+    # -- collection ----------------------------------------------------
+    def _comm_classes(self, ctx, comm_path: str) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        tree = ctx.tree(comm_path)
+        # transitive subclasses of Message within comm.py
+        bases: Dict[str, List[str]] = {}
+        linenos: Dict[str, int] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                bases[node.name] = [
+                    b.id for b in node.bases if isinstance(b, ast.Name)
+                ]
+                linenos[node.name] = node.lineno
+
+        def is_message(name: str, seen=()) -> bool:
+            if name == "Message":
+                return True
+            if name in seen:
+                return False
+            return any(
+                is_message(b, seen + (name,))
+                for b in bases.get(name, ())
+            )
+
+        for name, line in linenos.items():
+            if name != "Message" and is_message(name):
+                out[name] = line
+        return out
+
+    def _dispatched(self, ctx) -> Dict[str, Tuple[str, int]]:
+        """class name -> first isinstance(message, comm.X) site."""
+        out: Dict[str, Tuple[str, int]] = {}
+        for path in self._files(ctx, _SERVICER_SUFFIXES):
+            try:
+                tree = ctx.tree(path)
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and call_name(node) == "isinstance"
+                    and len(node.args) == 2
+                ):
+                    cls = _comm_attr(node.args[1])
+                    if cls is not None:
+                        out.setdefault(cls, (path, node.lineno))
+        return out
+
+    def _constructions(
+        self, ctx
+    ) -> Tuple[Set[str], Dict[str, List[Tuple[str, int]]]]:
+        """(classes constructed anywhere, classes a CLIENT file passes
+        to a get()/report() send).
+
+        Construction counts three ways: ``comm.X(...)`` anywhere, a
+        direct-import alias call (``from ...comm import Shard`` then
+        ``Shard(...)``), and a ``default_factory=X`` / nested-field
+        reference inside comm.py itself (a message embedded in another
+        message is constructed every time its carrier is)."""
+        all_ctor: Set[str] = set()
+        client_sent: Dict[str, List[Tuple[str, int]]] = {}
+        client_files = set(self._files(ctx, _CLIENT_SUFFIXES))
+        for path in ctx.iter_files(respect_changed=False):
+            try:
+                tree = ctx.tree(path)
+            except (OSError, SyntaxError):
+                continue
+            aliases = _comm_import_aliases(tree)
+            is_comm = ctx.rel(path).replace("\\", "/").endswith(
+                _COMM_SUFFIXES
+            )
+            for node in ast.walk(tree):
+                if (
+                    is_comm
+                    and isinstance(node, ast.keyword)
+                    and node.arg == "default_factory"
+                    and isinstance(node.value, ast.Name)
+                ):
+                    all_ctor.add(node.value.id)
+                if not isinstance(node, ast.Call):
+                    continue
+                cls = _comm_attr(node.func)
+                if cls is None and isinstance(node.func, ast.Name):
+                    cls = aliases.get(node.func.id)
+                    if cls is None and is_comm:
+                        cls = node.func.id  # intra-catalog construction
+                if cls is not None:
+                    all_ctor.add(cls)
+            if path in client_files:
+                self._collect_sends(path, tree, aliases, client_sent)
+        return all_ctor, client_sent
+
+    def _collect_sends(self, path, tree, aliases, client_sent):
+        """Sends are resolved function-scoped so a message passed as a
+        VARIABLE still counts: ``self.report(params)`` resolves through
+        the parameter's ``comm.X`` annotation or a local
+        ``params = comm.X(...)`` assignment (one level — enough for
+        every wrapper shape in the client modules)."""
+        from tools.graftlint.core import walk_functions
+
+        for fn in walk_functions(tree):
+            local_types: Dict[str, str] = {}
+            for a in list(fn.args.posonlyargs) + list(fn.args.args) + list(
+                fn.args.kwonlyargs
+            ):
+                name = _annotation_comm_class(a.annotation, aliases)
+                if name is not None:
+                    local_types[a.arg] = name
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    cls = _ctor_class(node.value, aliases)
+                    if cls is not None:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                local_types[t.id] = cls
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or not _is_send(node):
+                    continue
+                for arg in node.args[:1]:
+                    sent = None
+                    if isinstance(arg, ast.Call):
+                        sent = _ctor_class(arg, aliases)
+                    elif isinstance(arg, ast.Name):
+                        sent = local_types.get(arg.id)
+                    if sent is not None:
+                        client_sent.setdefault(sent, []).append(
+                            (path, arg.lineno)
+                        )
+
+    def _check_retry_sites(self, ctx) -> List[Finding]:
+        findings: List[Finding] = []
+        for path in self._files(ctx, _CLIENT_SUFFIXES):
+            try:
+                tree = ctx.tree(path)
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not _is_send(node):
+                    continue
+                if last_segment(call_name(node)) != "report":
+                    continue  # get() legs are reads: replay-safe
+                if not node.args:
+                    continue
+                arg = node.args[0]
+                cls = (
+                    _comm_attr(arg.func)
+                    if isinstance(arg, ast.Call)
+                    else None
+                )
+                if cls is None or cls not in NON_IDEMPOTENT:
+                    continue
+                if _single_attempt(node):
+                    continue
+                findings.append(
+                    Finding(
+                        checker="rpc-idempotency.retry",
+                        path=ctx.rel(path),
+                        line=node.lineno,
+                        message=(
+                            f"comm.{cls} is non-idempotent but sent "
+                            "with retries (a lost response replays the "
+                            "side effect)"
+                        ),
+                        hint=(
+                            "pass idempotent=False (or retries=1) and "
+                            "let the caller own recovery"
+                        ),
+                    )
+                )
+        return findings
+
+    def _files(self, ctx, suffixes) -> List[str]:
+        out = []
+        for f in ctx.files:
+            rel = ctx.rel(f).replace("\\", "/")
+            if any(rel.endswith(s) for s in suffixes):
+                out.append(f)
+        return out
+
+
+def _ctor_class(node: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    cls = _comm_attr(node.func)
+    if cls is None and isinstance(node.func, ast.Name):
+        cls = aliases.get(node.func.id)
+    return cls
+
+
+def _annotation_comm_class(
+    ann: Optional[ast.AST], aliases: Dict[str, str]
+) -> Optional[str]:
+    """``params: comm.X`` / ``params: X`` (direct import) /
+    ``params: "comm.X"`` -> ``"X"``."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        text = ann.value
+        if text.startswith("comm."):
+            return text[5:]
+        return aliases.get(text)
+    name = _comm_attr(ann)
+    if name is not None:
+        return name
+    if isinstance(ann, ast.Name):
+        return aliases.get(ann.id)
+    return None
+
+
+def _comm_import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """``{local_name: comm_class}`` for ``from ...common.comm import``
+    statements in this module."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+            node.module.endswith("common.comm") or node.module == "comm"
+        ):
+            for alias in node.names:
+                out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def _comm_attr(node: ast.AST) -> Optional[str]:
+    """``comm.X`` -> ``"X"`` (the catalog's import convention)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "comm"
+    ):
+        return node.attr
+    return None
+
+
+def _is_send(node: ast.Call) -> bool:
+    name = call_name(node)
+    seg = last_segment(name)
+    if seg not in ("report", "get"):
+        return False
+    recv = name.rsplit(".", 1)[0] if "." in name else ""
+    # self.report(...) in MasterClient, self._client.report(...) in
+    # BrainClient; plain dict.get(...) has a non-client receiver
+    return recv == "self" or recv.lower().endswith("client")
+
+
+def _single_attempt(node: ast.Call) -> bool:
+    for k in node.keywords:
+        if k.arg == "idempotent" and isinstance(k.value, ast.Constant):
+            if k.value.value is False:
+                return True
+        if k.arg == "retries" and isinstance(k.value, ast.Constant):
+            if k.value.value == 1:
+                return True
+    return False

@@ -1,0 +1,363 @@
+"""graftlint framework: finding model, parse cache, suppressions, runner.
+
+Checkers are small objects with an ``id``, a ``scope`` and a
+``run(ctx) -> list[Finding]``:
+
+- ``scope == "file"`` — independent per-file analyses (lock discipline,
+  span leaks, durable renames). Under ``--changed-only`` they run over
+  the changed files alone.
+- ``scope == "repo"`` — cross-file invariants (RPC dispatch matrix,
+  metric/doc drift, fault-site coverage). They always see the whole
+  tree: a one-file diff can still break a two-sided invariant.
+
+Suppression grammar (one line, the finding's line or the line above)::
+
+    # graftlint: disable=<id>[,<id>...] reason=<free text to end of line>
+
+A suppression with no ``reason=`` is itself a finding
+(``graftlint.suppression``) — the reason IS the review record. An id
+suppresses its sub-ids too (``disable=lock-discipline`` covers
+``lock-discipline.blocking``).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import subprocess
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([\w.,-]+)(?:\s+reason=(.*))?$"
+)
+
+
+@dataclass
+class Finding:
+    """One checker hit: a precise site plus how to act on it."""
+
+    checker: str  # checker id, e.g. "lock-discipline.blocking"
+    path: str  # repo-relative path
+    line: int
+    message: str
+    hint: str = ""
+    suppressed: bool = False
+    reason: str = ""  # the suppression's reason when suppressed
+
+    def render(self) -> str:
+        sup = f"  [suppressed: {self.reason}]" if self.suppressed else ""
+        hint = f" (fix: {self.hint})" if self.hint else ""
+        return (
+            f"{self.path}:{self.line}: [{self.checker}] "
+            f"{self.message}{hint}{sup}"
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "checker": self.checker,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "hint": self.hint,
+            "suppressed": self.suppressed,
+            "reason": self.reason,
+        }
+
+
+@dataclass
+class _Suppression:
+    line: int
+    ids: Tuple[str, ...]
+    reason: str
+    raw_line: int  # where the comment physically sits
+
+
+class Context:
+    """Shared state for one lint run: the file set and a parse cache
+    (every checker walks the same tree objects — one parse per file
+    per run)."""
+
+    def __init__(
+        self,
+        root: str,
+        files: Sequence[str],
+        changed: Optional[Iterable[str]] = None,
+    ):
+        self.root = os.path.abspath(root)
+        self.files = [os.path.abspath(f) for f in files]
+        self.changed = (
+            None
+            if changed is None
+            else {os.path.abspath(c) for c in changed}
+        )
+        self._cache: Dict[str, Tuple[ast.AST, str, List[str]]] = {}
+
+    # -- file access ---------------------------------------------------
+    def rel(self, path: str) -> str:
+        return os.path.relpath(os.path.abspath(path), self.root)
+
+    def _load(self, path: str) -> Tuple[ast.AST, str, List[str]]:
+        path = os.path.abspath(path)
+        hit = self._cache.get(path)
+        if hit is None:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            hit = (ast.parse(src, filename=path), src, src.splitlines())
+            self._cache[path] = hit
+        return hit
+
+    def tree(self, path: str) -> ast.AST:
+        return self._load(path)[0]
+
+    def source(self, path: str) -> str:
+        return self._load(path)[1]
+
+    def lines(self, path: str) -> List[str]:
+        return self._load(path)[2]
+
+    def iter_files(self, respect_changed: bool = True) -> List[str]:
+        """Files a per-file checker should visit (changed-only aware)."""
+        if respect_changed and self.changed is not None:
+            return [f for f in self.files if f in self.changed]
+        return list(self.files)
+
+    def find_file(self, *suffixes: str) -> Optional[str]:
+        """First file whose repo-relative path ends with any suffix —
+        convention-based anchor discovery so fixture trees can stand in
+        for the real layout."""
+        for suf in suffixes:
+            for f in self.files:
+                if self.rel(f).replace(os.sep, "/").endswith(suf):
+                    return f
+        return None
+
+
+def discover_files(root: str, paths: Sequence[str]) -> List[str]:
+    """All ``.py`` files under ``paths`` (files kept as-is), skipping
+    caches and hidden dirs."""
+    out: List[str] = []
+    for p in paths:
+        p = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d
+                for d in dirnames
+                if d != "__pycache__" and not d.startswith(".")
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def changed_files(root: str) -> List[str]:
+    """Working-tree changes vs HEAD plus untracked files (the
+    ``--changed-only`` pre-commit filter)."""
+    out: List[str] = []
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD", "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True, timeout=30
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        for line in res.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                out.append(os.path.join(root, line))
+    return sorted(set(out))
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def parse_suppressions(
+    lines: Sequence[str],
+) -> Tuple[Dict[int, _Suppression], List[_Suppression]]:
+    """``{effective_line: suppression}`` plus the reasonless ones.
+
+    A trailing comment suppresses its own line; a comment alone on a
+    line suppresses the next line (both map through ``effective_line``
+    — findings match against their own line or the line above)."""
+    by_line: Dict[int, _Suppression] = {}
+    bad: List[_Suppression] = []
+    for i, text in enumerate(lines, start=1):
+        m = SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        ids = tuple(x for x in m.group(1).split(",") if x)
+        reason = (m.group(2) or "").strip()
+        own_line = text.strip().startswith("#")
+        eff = i + 1 if own_line else i
+        sup = _Suppression(line=eff, ids=ids, reason=reason, raw_line=i)
+        if not reason:
+            bad.append(sup)
+            continue
+        by_line[eff] = sup
+    return by_line, bad
+
+
+def _matches(sup_ids: Tuple[str, ...], checker_id: str) -> bool:
+    return any(
+        checker_id == sid or checker_id.startswith(sid + ".")
+        for sid in sup_ids
+    )
+
+
+def apply_suppressions(
+    ctx: Context, findings: List[Finding]
+) -> List[Finding]:
+    """Mark suppressed findings in place and append
+    ``graftlint.suppression`` findings for reasonless suppressions."""
+    sups: Dict[str, Tuple[Dict[int, _Suppression], List[_Suppression]]] = {}
+    for f in findings:
+        abspath = os.path.join(ctx.root, f.path)
+        if abspath not in sups:
+            try:
+                sups[abspath] = parse_suppressions(ctx.lines(abspath))
+            except (OSError, SyntaxError):
+                sups[abspath] = ({}, [])
+        by_line, _ = sups[abspath]
+        # a comment-only line suppresses the next line; a trailing
+        # comment suppresses its own — both are keyed by effective
+        # line, so a finding matches ONLY at f.line. Probing the line
+        # above (for multi-line statements) would let a neighboring
+        # statement's trailing suppression silently swallow an
+        # independent finding on the next line — review caught it.
+        sup = by_line.get(f.line)
+        if sup is not None and _matches(sup.ids, f.checker):
+            f.suppressed = True
+            f.reason = sup.reason
+    # reasonless suppressions anywhere in the visited files are
+    # findings themselves — scan every lintable file, not only those
+    # with findings (a stale reasonless disable must not hide)
+    out = list(findings)
+    for path in ctx.iter_files(respect_changed=True):
+        try:
+            _, bad = parse_suppressions(ctx.lines(path))
+        except (OSError, SyntaxError):
+            continue
+        for sup in bad:
+            out.append(
+                Finding(
+                    checker="graftlint.suppression",
+                    path=ctx.rel(path),
+                    line=sup.raw_line,
+                    message=(
+                        "suppression without a reason: "
+                        f"disable={','.join(sup.ids)}"
+                    ),
+                    hint="append reason=<why this is deliberate>",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+def run_checkers(
+    ctx: Context,
+    checkers: Sequence,
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run every (selected) checker over ``ctx`` and resolve
+    suppressions. Returns ALL findings (suppressed ones marked)."""
+    wanted = None if select is None else set(select)
+    findings: List[Finding] = []
+    for checker in checkers:
+        if wanted is not None and checker.id not in wanted:
+            continue
+        findings.extend(checker.run(ctx))
+    findings = apply_suppressions(ctx, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    return findings
+
+
+def unsuppressed(findings: Sequence[Finding]) -> List[Finding]:
+    return [f for f in findings if not f.suppressed]
+
+
+def render_text(findings: Sequence[Finding], verbose: bool = False) -> str:
+    shown = [f for f in findings if verbose or not f.suppressed]
+    lines = [f.render() for f in shown]
+    n_live = len(unsuppressed(findings))
+    n_sup = len(findings) - n_live
+    lines.append(
+        f"graftlint: {n_live} finding(s), {n_sup} suppressed"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    return json.dumps(
+        {
+            "findings": [f.as_dict() for f in findings],
+            "unsuppressed": len(unsuppressed(findings)),
+            "suppressed": len(findings) - len(unsuppressed(findings)),
+        },
+        indent=2,
+    )
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (used by several checkers)
+# ---------------------------------------------------------------------------
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target (``os.replace``, ``self._lock.acquire``,
+    ``span``) — empty string for exotic targets."""
+    try:
+        return ast.unparse(node.func)
+    except Exception:
+        return ""
+
+
+def last_segment(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def keyword_names(node: ast.Call) -> List[str]:
+    return [k.arg for k in node.keywords if k.arg]
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def walk_functions(tree: ast.AST):
+    """Yield every (possibly nested) function/method definition."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def own_nodes(fn: ast.AST):
+    """Walk ``fn``'s body excluding nested function/lambda bodies —
+    the per-function analysis scope several checkers share."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

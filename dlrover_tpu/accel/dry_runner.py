@@ -69,11 +69,19 @@ class DryRunReport:
     comm_exposed_s: float = 0.0
     # exposed seconds of the AGGREGATE host-link traffic registered
     # with the transfer arbiter (checkpoint staging + embedding
-    # fault-in/spill streams, parallel/transfer_sched.py): scheduled
-    # into compute windows it exposes (1 - HOST_HIDDEN_FRACTION) of
-    # the wire time, serialized (arbiter off) all of it. 0.0 when no
-    # stream carries standing demand.
+    # fault-in/spill streams, parallel/transfer_sched.py): D2H and H2D
+    # are priced per direction (independent physical paths — the
+    # exposed term is their max, not their sum), each discounted by
+    # that rail's hidden fraction. The fraction is the MEASURED
+    # scheduled-vs-serialized A/B from the calibration cache when one
+    # exists for this device fingerprint; the documented
+    # HOST_HIDDEN_FRACTION constant only prices the no-cache cold
+    # start. Serialized (arbiter off) exposes the full summed wire
+    # time. 0.0 when no stream carries standing demand.
     host_exposed_s: float = 0.0
+    # True when host_exposed_s was priced from a measured arbiter
+    # calibration rather than the documented constant
+    host_hidden_measured: bool = False
 
 
 def hbm_fits(
@@ -417,9 +425,11 @@ def _finalize_estimate(
     # cost of the host link instead of assuming it free (or exclusive)
     from dlrover_tpu.parallel.transfer_sched import (
         aggregate_host_exposed_s,
+        get_calibration,
     )
 
     report.host_exposed_s = aggregate_host_exposed_s()
+    report.host_hidden_measured = get_calibration() is not None
     report.est_step_s = (
         max(
             report.flops_per_device * _SEC_PER_FLOP,

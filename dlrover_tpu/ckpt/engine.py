@@ -96,6 +96,7 @@ class ChunkedStager:
         sync: bool,
         chunk_bytes: int,
         priority=None,
+        stripe_min_bytes: Optional[int] = None,
     ):
         self._engine = engine
         self.step = step
@@ -124,6 +125,25 @@ class ChunkedStager:
         # standing demand hint while this drain is live (the
         # dry-runner's aggregate host-leg pricing)
         self._stream.demand_bytes_per_step = self._chunk_bytes
+        # multi-rail striping: a write group at least this large is
+        # split across every admitted rail (host_d2h + the DCN peer
+        # path) with per-chunk grants and crc32_combine-folded digests
+        # — byte-identical to the single-rail path. Below the
+        # threshold (and with fewer than two admitted rails) the exact
+        # PR-14 single-grant path runs unchanged.
+        self._stripe_min_bytes = (
+            transfer_sched.DEFAULT_STRIPE_MIN_BYTES
+            if stripe_min_bytes is None
+            else max(int(stripe_min_bytes), 1)
+        )
+        self._striper = transfer_sched.StripedTransfer(
+            self._stream.arbiter,
+            name="ckpt_stage",
+            direction="d2h",
+            priority=self._priority,
+            chunk_bytes=max(self._chunk_bytes // 4, 1 << 16),
+            ignore_window=True,
+        )
         # the plan holds live references to every device shard: the
         # buffers stay alive (and unmutated — jax.Array is immutable)
         # until the drain finishes, whatever the caller does to `state`
@@ -245,6 +265,19 @@ class ChunkedStager:
                 return False
         return False
 
+    def _group_stripes(self, group) -> bool:
+        """True when this write group takes the multi-rail striped
+        path (single big member, above the stripe floor, at least two
+        admitted rails). advance() uses the same predicate to SKIP the
+        outer stream grant for striped groups: the stripe's per-chunk
+        rail grants are the only arbitration, so the striper can never
+        deadlock against its own stream's held grant."""
+        return (
+            len(group) == 1
+            and group[0][2] >= self._stripe_min_bytes
+            and len(self._striper.rails()) >= 2
+        )
+
     def _write_one(self) -> int:
         """Consume the inflight group (start the next one's D2H first so
         the transfer overlaps this memcpy). Returns bytes written."""
@@ -253,8 +286,10 @@ class ChunkedStager:
             if self._inflight is None:
                 return 0
         group = self._inflight
+        stripes = self._group_stripes(group)
         self._inflight = self._start_next()
         written = 0
+        shm = self._engine._shm
         for idx, offset, nbytes, src in group:
             data = (
                 src if isinstance(src, np.ndarray) else np.asarray(src)
@@ -264,8 +299,28 @@ class ChunkedStager:
             # per-record writes are in offset order, so the incremental
             # crc equals the whole-record crc published at commit
             flat = np.ascontiguousarray(data).reshape(-1).view(np.uint8)
-            self._crcs[idx] = zlib.crc32(flat, self._crcs.get(idx, 0))
-            self._engine._shm.write_chunk(offset, data)
+            if stripes:
+                # split the group across rails: disjoint shm offsets,
+                # so concurrent chunk memcpys never overlap; the
+                # striper's combined crc is bitwise the crc of `flat`,
+                # folded into the record's running digest exactly like
+                # the single-rail incremental fold
+                from dlrover_tpu.parallel import transfer_sched
+
+                rep = self._striper.run(
+                    lambda rail, off, ln, _o=offset, _f=flat: (
+                        shm.write_chunk(_o + off, _f[off:off + ln])
+                    ),
+                    payload=flat,
+                )
+                self._crcs[idx] = transfer_sched.crc32_combine(
+                    self._crcs.get(idx, 0), rep.crc32, flat.nbytes
+                )
+            else:
+                self._crcs[idx] = zlib.crc32(
+                    flat, self._crcs.get(idx, 0)
+                )
+                shm.write_chunk(offset, data)
             written += nbytes
         self._staged_bytes += written
         self.chunks_written += 1
@@ -307,13 +362,25 @@ class ChunkedStager:
                     # thread — the window gate must defer background
                     # THREADS to it, never it to itself
                     nbytes = sum(m[2] for m in self._inflight)
-                    with self._stream.transfer(
-                        nbytes,
-                        priority=self._priority,
-                        ignore_window=True,
-                    ) as grant:
+                    if self._group_stripes(self._inflight):
+                        # striped group: the per-chunk rail grants
+                        # inside the striper are the only arbitration
+                        # (holding the stream grant here would deadlock
+                        # the stripe's own host_d2h chunk acquires)
                         copied += self._write_one()
-                    if budget_s is not None and grant.should_yield():
+                        grant = None
+                    else:
+                        with self._stream.transfer(
+                            nbytes,
+                            priority=self._priority,
+                            ignore_window=True,
+                        ) as grant:
+                            copied += self._write_one()
+                    if (
+                        budget_s is not None
+                        and grant is not None
+                        and grant.should_yield()
+                    ):
                         break  # yield the link to the preemptor
                     if (
                         budget_s is not None
@@ -506,6 +573,7 @@ class CheckpointEngine:
         sync: bool = False,
         chunk_bytes: int = 64 << 20,
         priority=None,
+        stripe_min_bytes: Optional[int] = None,
     ):
         """Chunked variant of ``save_to_memory``: returns a stager whose
         ``advance(budget_s)`` the train loop calls between steps and
@@ -515,7 +583,10 @@ class CheckpointEngine:
         stager falls back to a synchronous storage save at commit.
         ``priority`` is the host-link arbitration class
         (``transfer_sched.Priority``; the eviction drain passes
-        EMERGENCY so its chunks preempt background spills)."""
+        EMERGENCY so its chunks preempt background spills).
+        ``stripe_min_bytes`` is the multi-rail stripe floor: write
+        groups at least this large split across every admitted rail
+        (default ``transfer_sched.DEFAULT_STRIPE_MIN_BYTES``)."""
         if self._agent_mode:
             assert self._lock and self._shm and self._queue
             if not self._lock.acquire(blocking=False):
@@ -528,6 +599,7 @@ class CheckpointEngine:
                 stager = ChunkedStager(
                     self, step, state, checkpoint_dir, sync,
                     chunk_bytes, priority=priority,
+                    stripe_min_bytes=stripe_min_bytes,
                 )
             except BaseException:
                 self._lock.force_release()

@@ -14,12 +14,22 @@ host) fall back to the shm/storage restore.
 Bitwise contract: every operation here (slice, ``at[].set``, device
 transfer) is a pure copy — the resharded state is bitwise-identical to
 a shm save/restore round-trip of the same resize (tested in
-``tests/test_resize.py``).
+``tests/test_resize.py``). The one opt-OUT is ``wire_format="int8"``:
+moved float leaves then hop through the per-chunk int8 wire
+(``parallel/wire_format.py``), which is lossy but idempotent, and the
+per-shard crc32 of the DECODED payload is folded into the report so a
+corrupted hop is still detected.
+
+Movement rides the multi-rail transfer scheduler: each target-shard
+assembly holds a ``reshard_move`` (h2d, BACKPRESSURE) grant, and a
+leaf whose moved bytes clear the stripe floor splits its shards across
+every admitted rail by LPT (``StripedTransfer.run_items``).
 """
 
 from __future__ import annotations
 
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -27,6 +37,7 @@ import numpy as np
 
 from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.parallel import wire_format as wire_fmt
 
 # index of a shard in the global array: ((start, stop) per dim)
 Index = Tuple[Tuple[int, int], ...]
@@ -53,6 +64,16 @@ class ReshardReport:
     # source shards (the multi-source stitching path — e.g. a tp-degree
     # shrink concatenating two old shards, or a non-pow2 transition)
     stitched_shards: int = 0
+    # wire format the moved leaves traversed ("none" = bitwise copies);
+    # with "int8", per-shard crc32s of the DECODED payloads folded in
+    # target-shard order — the restore gate compares this digest, so a
+    # corrupted wire chunk fails even though the wire itself is lossy
+    wire_format: str = "none"
+    decoded_crc32: Optional[int] = None
+    # multi-rail striping accounting: leaves whose shards were LPT-split
+    # across rails, and the bytes each rail carried
+    striped_leaves: int = 0
+    stripe_rail_bytes: Dict[str, int] = field(default_factory=dict)
 
     def describe_axis_changes(self) -> str:
         if not self.axis_changes:
@@ -136,26 +157,100 @@ def _overlap(a: Index, b: Index):
     return tuple(out)
 
 
+def _assemble_host_block(
+    want: Index, dtype: np.dtype, sources: List[Tuple[Index, Any]]
+):
+    """Host-side variant of the shard assembly (the int8-wire path: the
+    payload has to visit the host for quantization anyway, so the whole
+    block is stitched in one numpy scratch). Returns
+    ``(np_block, n_sources_used)`` or ``(None, 0)`` on a coverage hole."""
+    shape = tuple(hi - lo for lo, hi in want)
+    for idx, data in sources:
+        if idx == want:
+            return np.ascontiguousarray(np.asarray(data)), 1
+    for idx, data in sources:
+        inter = _overlap(idx, want)
+        if inter == want:
+            sel = tuple(
+                slice(wlo - slo, whi - slo)
+                for (wlo, whi), (slo, _) in zip(want, idx)
+            )
+            arr = np.asarray(data)
+            return np.ascontiguousarray(arr[sel] if sel else arr), 1
+    covered = (
+        np.zeros(shape, dtype=bool) if shape else np.zeros((), bool)
+    )
+    scratch = np.zeros(shape, dtype=dtype)
+    n_used = 0
+    for idx, data in sources:
+        inter = _overlap(idx, want)
+        if inter is None:
+            continue
+        src_sel = tuple(
+            slice(lo - slo, hi - slo)
+            for (lo, hi), (slo, _) in zip(inter, idx)
+        )
+        dst_sel = tuple(
+            slice(lo - wlo, hi - wlo)
+            for (lo, hi), (wlo, _) in zip(inter, want)
+        )
+        arr = np.asarray(data)
+        piece = arr[src_sel] if src_sel else arr
+        if dst_sel:
+            scratch[dst_sel] = piece
+            covered[dst_sel] = True
+        else:
+            scratch[...] = piece
+            covered[...] = True
+        n_used += 1
+    if not bool(covered.all()):
+        return None, 0
+    return scratch, n_used
+
+
 def _assemble_target_shard(
-    want: Index, dtype, sources: List[Tuple[Index, Any]], device
+    want: Index,
+    dtype,
+    sources: List[Tuple[Index, Any]],
+    device,
+    wire: str = "none",
 ):
     """Build the ``want`` block on ``device`` from overlapping on-device
-    sources. Returns ``(block, n_sources_used)``; ``(None, 0)`` when
-    the sources don't cover ``want``.
+    sources. Returns ``(block, n_sources_used, decoded_crc32)``;
+    ``(None, 0, None)`` when the sources don't cover ``want``. The crc
+    is None on the bitwise (``wire="none"``) paths.
 
     Fast paths avoid the scratch-zeros allocation: an exact-index source
     is a straight device transfer; a containing source is one on-device
     slice then the transfer. The general (multi-source) path verifies
     coverage with a host-side bool mask before touching the device —
     the mask costs 1 byte/element of the *target shard* only, and only
-    on the already-rare stitching path."""
+    on the already-rare stitching path.
+
+    ``wire="int8"`` instead stitches the block host-side, hops it
+    through the per-chunk int8 wire (floats only — integer payloads
+    stay bitwise), and records crc32 of the DECODED payload: what the
+    device receives is exactly what the digest covers."""
     import jax
     import jax.numpy as jnp
+
+    if wire == "int8":
+        host, n_used = _assemble_host_block(
+            want, np.dtype(dtype), sources
+        )
+        if host is None:
+            return None, 0, None
+        if wire_fmt.quantizable(host):
+            host = wire_fmt.roundtrip_int8(host)
+        crc = zlib.crc32(
+            np.ascontiguousarray(host).reshape(-1).view(np.uint8)
+        )
+        return jax.device_put(host, device), n_used, crc
 
     shape = tuple(hi - lo for lo, hi in want)
     for idx, data in sources:
         if idx == want:
-            return jax.device_put(data, device), 1
+            return jax.device_put(data, device), 1, None
     for idx, data in sources:
         inter = _overlap(idx, want)
         if inter == want:
@@ -164,7 +259,7 @@ def _assemble_target_shard(
                 for (wlo, whi), (slo, _) in zip(want, idx)
             )
             piece = data[sel] if sel else data
-            return jax.device_put(piece, device), 1
+            return jax.device_put(piece, device), 1, None
     covered = (
         np.zeros(shape, dtype=bool) if shape else np.zeros((), bool)
     )
@@ -187,7 +282,7 @@ def _assemble_target_shard(
         else:
             covered[...] = True
     if not bool(covered.all()):
-        return None, 0
+        return None, 0, None
     base = jax.device_put(jnp.zeros(shape, dtype), device)
     for src_sel, dst_sel, data in pieces:
         piece = jax.device_put(
@@ -197,11 +292,56 @@ def _assemble_target_shard(
             base = base.at[dst_sel].set(piece)
         else:
             base = piece
-    return base, len(pieces)
+    return base, len(pieces), None
+
+
+class _ReshardMover:
+    """Host-link arbitration + multi-rail striping for one reshard.
+
+    Registers the ``reshard_move`` stream (h2d — the dominant direction
+    of a rebuild — at BACKPRESSURE: a resize stalls training until the
+    state lands, same class as embedding fault-ins). Serial shard
+    assemblies each hold one grant; a leaf whose moved bytes clear
+    ``stripe_min_bytes`` with ≥2 admitted rails skips the outer grant
+    and lets ``run_items``'s per-item rail grants be the only
+    arbitration (the ChunkedStager nested-grant rule)."""
+
+    def __init__(self, stripe_min_bytes: Optional[int] = None):
+        from dlrover_tpu.parallel import transfer_sched
+
+        arb = transfer_sched.get_arbiter()
+        self.stream = arb.register(
+            "reshard_move",
+            transfer_sched.Priority.BACKPRESSURE,
+            direction="h2d",
+        )
+        self.stripe_min_bytes = (
+            transfer_sched.DEFAULT_STRIPE_MIN_BYTES
+            if stripe_min_bytes is None
+            else max(int(stripe_min_bytes), 1)
+        )
+        self.striper = transfer_sched.StripedTransfer(
+            arb,
+            name="reshard_move",
+            direction="h2d",
+            priority=transfer_sched.Priority.BACKPRESSURE,
+            ignore_window=True,
+        )
+
+    def stripes(self, total_nbytes: int, n_items: int) -> bool:
+        return (
+            n_items > 1
+            and total_nbytes >= self.stripe_min_bytes
+            and len(self.striper.rails()) >= 2
+        )
 
 
 def reshard_state(
-    state: Any, target_spec: Any, stats=None
+    state: Any,
+    target_spec: Any,
+    stats=None,
+    wire_format: str = "none",
+    stripe_min_bytes: Optional[int] = None,
 ) -> Tuple[Any, ReshardReport]:
     """Remap a live pytree onto ``target_spec``'s shardings on device.
 
@@ -212,16 +352,28 @@ def reshard_state(
     not — those paths are listed in ``report.fallback_paths`` and must
     be filled through the shm/storage restore (``merge_fallback``).
 
+    ``wire_format="int8"`` opts moved float leaves into the lossy (but
+    idempotent, crc-over-decoded-gated) int8 wire; the default keeps
+    the bitwise contract. ``stripe_min_bytes`` is the multi-rail
+    stripe floor for a leaf's moved bytes (default
+    ``transfer_sched.DEFAULT_STRIPE_MIN_BYTES``).
+
     Tree structures must match; a structure change is a model change,
     not a resize."""
     import jax
 
+    if wire_format not in wire_fmt.WIRE_FORMATS:
+        raise ValueError(
+            f"unknown wire_format {wire_format!r}; "
+            f"one of {wire_fmt.WIRE_FORMATS}"
+        )
     t0 = time.perf_counter()
     # fault point reshard.gather: an injected failure here exercises the
     # resize path's recovery contract (trainer falls back to the shm/
     # storage restore instead of resizing with half-moved state)
     faults.fire("reshard.gather")
-    report = ReshardReport()
+    report = ReshardReport(wire_format=wire_format)
+    mover = _ReshardMover(stripe_min_bytes=stripe_min_bytes)
     s_leaves, s_def = jax.tree_util.tree_flatten_with_path(state)
     t_leaves, t_def = jax.tree_util.tree_flatten_with_path(target_spec)
     if s_def != t_def:
@@ -264,7 +416,12 @@ def reshard_state(
         new_leaf = None
         if sources:
             new_leaf = _reshard_leaf(
-                spec, sharding, sources, report=report
+                spec,
+                sharding,
+                sources,
+                report=report,
+                mover=mover,
+                wire=wire_format,
             )
         if new_leaf is None:
             report.fallback_paths.append(path)
@@ -295,10 +452,17 @@ def reshard_state(
     return jax.tree_util.tree_unflatten(s_def, out), report
 
 
-def _reshard_leaf(spec, sharding, sources, report=None):
+def _reshard_leaf(
+    spec, sharding, sources, report=None, mover=None, wire="none"
+):
     """One leaf: build every addressable target shard from local
     sources; None as soon as any shard cannot be covered. Counts
-    multi-source assemblies into ``report.stitched_shards``."""
+    multi-source assemblies into ``report.stitched_shards``.
+
+    With a ``mover``, each serial assembly rides one ``reshard_move``
+    grant; a leaf whose moved bytes clear the stripe floor is instead
+    LPT-split across rails (shards are indivisible items) with the
+    striper's per-item grants as the only arbitration."""
     import jax
 
     gshape = tuple(spec.shape)
@@ -306,17 +470,66 @@ def _reshard_leaf(spec, sharding, sources, report=None):
         index_map = sharding.addressable_devices_indices_map(gshape)
     except Exception:
         return None
+    dtype = np.dtype(spec.dtype)
+    targets = [
+        (device, _slices_to_index(slices, gshape))
+        for device, slices in index_map.items()
+    ]
+    sizes = [
+        int(
+            np.prod(
+                [hi - lo for lo, hi in want] or [1], dtype=np.int64
+            )
+        ) * dtype.itemsize
+        for _, want in targets
+    ]
+    # distinct integer keys -> distinct dict slots: concurrent rail
+    # workers never write the same entry
+    results: Dict[int, Tuple[Any, int, Optional[int]]] = {}
+
+    def build(i: int) -> None:
+        device, want = targets[i]
+        results[i] = _assemble_target_shard(
+            want, dtype, sources, device, wire=wire
+        )
+
+    if mover is not None and mover.stripes(sum(sizes), len(targets)):
+        rep = mover.striper.run_items(
+            [(i, sizes[i]) for i in range(len(targets))],
+            lambda rail, i: build(i),
+        )
+        if report is not None:
+            report.striped_leaves += 1
+            for r, b in rep.rail_bytes.items():
+                report.stripe_rail_bytes[r] = (
+                    report.stripe_rail_bytes.get(r, 0) + b
+                )
+    else:
+        for i in range(len(targets)):
+            if mover is not None:
+                with mover.stream.transfer(
+                    sizes[i], ignore_window=True
+                ):
+                    build(i)
+            else:
+                build(i)
+            if results[i][0] is None:
+                return None
     pieces = []
     stitched = 0
-    for device, slices in index_map.items():
-        want = _slices_to_index(slices, gshape)
-        block, n_used = _assemble_target_shard(
-            want, np.dtype(spec.dtype), sources, device
-        )
+    for i in range(len(targets)):
+        block, n_used, crc = results.get(i, (None, 0, None))
         if block is None:
             return None
         if n_used > 1:
             stitched += 1
+        if crc is not None and report is not None:
+            # fold per-shard decoded digests in target-shard order —
+            # deterministic however the rails interleaved the moves
+            report.decoded_crc32 = zlib.crc32(
+                int(crc).to_bytes(4, "little"),
+                report.decoded_crc32 or 0,
+            )
         pieces.append(block)
     if report is not None:
         report.stitched_shards += stitched

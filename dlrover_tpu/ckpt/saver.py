@@ -652,10 +652,18 @@ class AsyncCheckpointSaver:
                     f"commit of step {step} still pending at shutdown"
                 )
         self._stop.set()
-        for h in self._shm_handlers:
-            h.close(unlink=True)
-        for lk in self._shard_locks:
-            lk.close()
+        # the event loop checks _stop only at its poll top: it may have
+        # dequeued one last event just before and still be inside
+        # _persist_step reading the segments. Closing the handlers
+        # unmaps those pages under its shm views (a segfault, not an
+        # exception) — hold _persist_mutex so teardown waits the
+        # in-flight persist out; a persist starting after this block
+        # finds the handlers empty and degrades to a logged skip.
+        with self._persist_mutex:
+            for h in self._shm_handlers:
+                h.close(unlink=True)
+            for lk in self._shard_locks:
+                lk.close()
         self._event_queue.close()
 
     def register_signal_handlers(self):

@@ -95,10 +95,23 @@ class ShmHandler:
         """Copy one chunk of raw bytes into the open segment. ``data``
         is any array; its buffer lands byte-for-byte at ``offset``.
 
+        Concurrency: DISJOINT ranges may be written from multiple
+        threads at once — each call memcpys into its own byte window of
+        the shared buffer (the multi-rail striper's rail workers rely
+        on this; overlapping ranges are the caller's bug). A chunk past
+        the segment end is rejected before any byte moves, so a stale
+        layout can never silently scribble a neighbor's mapping.
+
         Fault point ``ckpt.shm_stage``: corruption is applied AFTER the
         writer computed its record checksum, so an armed bit-flip is
         detectable downstream — exactly like real in-flight rot."""
         src = np.ascontiguousarray(data)
+        if offset < 0 or offset + src.nbytes > self._shm.buf.nbytes:
+            raise ValueError(
+                f"write_chunk out of bounds: [{offset}, "
+                f"{offset + src.nbytes}) in a "
+                f"{self._shm.buf.nbytes}-byte segment"
+            )
         src = faults.corrupt_array("ckpt.shm_stage", src)
         view = np.ndarray(
             (src.nbytes,),

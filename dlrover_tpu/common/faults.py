@@ -96,6 +96,8 @@ FAULT_SITES = frozenset(
         "embedding.export",  # embedding ckpt bytes → storage (data
         # kinds corrupt the serialized npz/delta payload)
         "embedding.import",  # embedding ckpt read leg (restore)
+        "transfer.stripe",  # one striped chunk move on a rail (the
+        # multi-rail scheduler's per-chunk grant + mover)
     }
 )
 

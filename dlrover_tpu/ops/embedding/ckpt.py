@@ -47,14 +47,71 @@ from dlrover_tpu.common import faults
 from dlrover_tpu.common.storage import durable_replace, fsync_dir
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.ops.embedding.store import ShardedKvEmbedding
+from dlrover_tpu.parallel import wire_format as wire_fmt
 
 _DEF_CHUNK_BYTES = 4 << 20
+
+# npz key prefixes carrying the int8 wire's sidecar data: per-chunk
+# scales and the original dtype of each quantized array
+_WIRE_SCALES = "__wire_scales__"
+_WIRE_DTYPE = "__wire_dtype__"
 
 
 def _serialize_state(step: int, state: Dict[str, np.ndarray]) -> bytes:
     buf = io.BytesIO()
     np.savez(buf, step=np.int64(step), **state)
     return buf.getvalue()
+
+
+def _encode_wire(state: Dict[str, np.ndarray], wire: str):
+    """Apply the opt-in wire format to an export. Returns
+    ``(wire_state, decoded_crc32)`` — the crc is the digest of what a
+    reader will hold AFTER decoding (``wire_format.decoded_crc32``), so
+    restore gates bitwise on the decoded payload even though the int8
+    wire itself is lossy. ``("none")`` passes through with no crc (the
+    whole-blob crc already covers a bitwise file)."""
+    if wire != "int8":
+        return state, None
+    out: Dict[str, np.ndarray] = {}
+    decoded: Dict[str, np.ndarray] = {}
+    for k, v in state.items():
+        arr = np.asarray(v)
+        if wire_fmt.quantizable(arr):
+            q, scales = wire_fmt.encode_int8(arr)
+            out[k] = q
+            out[_WIRE_SCALES + k] = scales
+            out[_WIRE_DTYPE + k] = np.array(arr.dtype.str)
+            decoded[k] = wire_fmt.decode_int8(q, scales, arr.dtype)
+        else:
+            # ints/bools (keys, versions) stay bitwise on the wire
+            out[k] = arr
+            decoded[k] = arr
+    return out, wire_fmt.decoded_crc32(decoded)
+
+
+def _decode_wire(data: Dict[str, np.ndarray]):
+    """Inverse of :func:`_encode_wire` on a loaded npz dict. Returns
+    ``(state, decoded_crc32)``; the crc is None when the file carries
+    no wire sidecar keys (a bitwise checkpoint). ``step`` is excluded
+    from the digest — the writer computed it over the export alone."""
+    if not any(k.startswith(_WIRE_SCALES) for k in data):
+        return data, None
+    out: Dict[str, np.ndarray] = {}
+    for k, v in data.items():
+        if k.startswith(_WIRE_SCALES) or k.startswith(_WIRE_DTYPE):
+            continue
+        if _WIRE_SCALES + k in data:
+            out[k] = wire_fmt.decode_int8(
+                v,
+                data[_WIRE_SCALES + k],
+                np.dtype(str(data[_WIRE_DTYPE + k])),
+            )
+        else:
+            out[k] = v
+    crc = wire_fmt.decoded_crc32(
+        {k: v for k, v in out.items() if k != "step"}
+    )
+    return out, crc
 
 
 class EmbeddingDeltaStager:
@@ -157,6 +214,8 @@ class EmbeddingDeltaStager:
         self._manager._publish(
             self.step, self.kind, self.name, self._crc,
             self.total_bytes, getattr(self, "rows", None),
+            wire=getattr(self, "wire", "none"),
+            decoded_crc32=getattr(self, "decoded_crc32", None),
         )
         self._blob = b""
         return path
@@ -185,9 +244,20 @@ class IncrementalCheckpointManager:
         directory: str,
         full_every: int = 10,
         keep_history: int = 2,
+        wire_format: str = "none",
     ):
+        if wire_format not in wire_fmt.WIRE_FORMATS:
+            raise ValueError(
+                f"unknown wire_format {wire_format!r}; "
+                f"one of {wire_fmt.WIRE_FORMATS}"
+            )
         self._store = store
         self._dir = directory
+        # opt-in int8 wire for the slow-rail bulk leg: float arrays are
+        # quantized per chunk in the npz; the manifest then carries the
+        # decoded-payload crc32 and restore gates on it (the whole-blob
+        # crc keeps covering the wire bytes themselves)
+        self._wire_format = wire_format
         self._full_every = max(1, full_every)
         self._keep_history = max(1, keep_history)
         # per-shard version at the last save; len mismatch (resharded
@@ -277,12 +347,17 @@ class IncrementalCheckpointManager:
         state = self._export(kind)
         rows = len(state["keys"])
         name = f"{kind}_{self._save_count:06d}.npz"
-        blob = _serialize_state(step, state)
+        wire_state, decoded_crc = _encode_wire(
+            state, self._wire_format
+        )
+        blob = _serialize_state(step, wire_state)
         self._pending_versions = self._store.shard_versions()
         stager = EmbeddingDeltaStager(
             self, step, kind, name, blob, chunk_bytes=chunk_bytes
         )
         stager.rows = rows
+        stager.wire = self._wire_format
+        stager.decoded_crc32 = decoded_crc
         self._active_stager = stager
         return stager
 
@@ -294,18 +369,22 @@ class IncrementalCheckpointManager:
         crc: int,
         nbytes: int,
         rows: Optional[int] = None,
+        wire: str = "none",
+        decoded_crc32: Optional[int] = None,
     ):
         entries = self._read_manifest()
-        entries.append(
-            {
-                "file": name,
-                "kind": kind,
-                "step": step,
-                "rows": rows,
-                "crc32": crc,
-                "nbytes": nbytes,
-            }
-        )
+        entry = {
+            "file": name,
+            "kind": kind,
+            "step": step,
+            "rows": rows,
+            "crc32": crc,
+            "nbytes": nbytes,
+        }
+        if wire != "none":
+            entry["wire"] = wire
+            entry["decoded_crc32"] = decoded_crc32
+        entries.append(entry)
         self._write_manifest(entries)
         self._last_versions = (
             self._pending_versions
@@ -368,11 +447,21 @@ class IncrementalCheckpointManager:
                     f"verification"
                 )
         try:
-            return dict(np.load(io.BytesIO(blob)))
+            data = dict(np.load(io.BytesIO(blob)))
         except Exception as err:
             raise ValueError(
                 f"embedding ckpt {e['file']} unreadable: {err!r}"
             )
+        state, dec_crc = _decode_wire(data)
+        if e.get("wire") == "int8":
+            # the decoded payload is what the store will import: gate
+            # on ITS digest, not just the wire bytes'
+            if dec_crc is None or dec_crc != e.get("decoded_crc32"):
+                raise ValueError(
+                    f"embedding ckpt {e['file']} fails decoded-payload "
+                    f"crc verification"
+                )
+        return state
 
     def _quarantine(self, e: dict):
         path = os.path.join(self._dir, e["file"])

@@ -522,6 +522,7 @@ class DeviceSparseEmbedding:
         table_name: str = "t0",
         kernel_mode: Optional[str] = None,
         async_spill: bool = True,
+        spill_stripe_min_bytes: Optional[int] = None,
     ):
         if sparse_optimizer not in self.SUPPORTED_OPTS:
             raise ValueError(
@@ -569,6 +570,24 @@ class DeviceSparseEmbedding:
             f"emb_spill:{table_name}",
             transfer_sched.Priority.BACKGROUND,
             direction="d2h",
+        )
+        # multi-rail spill striping: a spill whose staging D2H is at
+        # least this large splits its row ranges across every admitted
+        # rail (the striper's per-range grants replace the single
+        # stream grant). Only the device→host copy stripes — the host
+        # import stays single-threaded (ShardedKvEmbedding.import_rows
+        # is not thread-safe).
+        self._spill_stripe_min_bytes = (
+            transfer_sched.DEFAULT_STRIPE_MIN_BYTES
+            if spill_stripe_min_bytes is None
+            else max(int(spill_stripe_min_bytes), 1)
+        )
+        self._spill_striper = transfer_sched.StripedTransfer(
+            self._spill_stream.arbiter,
+            name=f"emb_spill:{table_name}",
+            direction="d2h",
+            priority=transfer_sched.Priority.BACKGROUND,
+            ignore_window=True,
         )
         # one lock serializes every table mutation: the pipeline
         # thread's fault-in scatter vs the train thread's grad scatter
@@ -633,25 +652,60 @@ class DeviceSparseEmbedding:
         # grant-holding fault-in briefly takes self._lock inside
         # _host_rows, and emb→link here would be the ABBA half of a
         # deadlock
+        prio = transfer_sched.Priority.BACKGROUND
         if arbitrate:
             # backlog escalates priority: a deep spill queue is about
             # to stall _allocate (the step path), so it outranks
             # background checkpoint staging
-            prio = (
-                transfer_sched.Priority.BACKPRESSURE
-                if self._spill_q.qsize() >= 2
-                else transfer_sched.Priority.BACKGROUND
+            if self._spill_q.qsize() >= 2:
+                prio = transfer_sched.Priority.BACKPRESSURE
+        nbytes = n * self.host.dim * 4
+        stripes = (
+            arbitrate
+            and nbytes >= self._spill_stripe_min_bytes
+            and len(self._spill_striper.rails()) >= 2
+        )
+        if stripes:
+            # stripe ONLY the D2H staging: per-rail workers land row
+            # ranges into a preallocated host buffer (disjoint slices,
+            # so concurrent writes never overlap) under the striper's
+            # per-range grants — no outer stream grant, or the striper
+            # would deadlock against its own stream's held rail. The
+            # host import below runs single-threaded after the join.
+            # row width comes from the device gather (dim plus the
+            # optimizer slot columns), not host.dim
+            rows = np.empty(
+                (n,) + tuple(dev_rows.shape[1:]),
+                np.dtype(dev_rows.dtype),
             )
-            grant = self._spill_stream.transfer(
-                n * self.host.dim * 4, priority=prio
+            rowb = max(1, rows.nbytes // max(n, 1))
+            step = max(1, self._spill_striper.chunk_bytes // rowb)
+            ranges = []
+            lo = 0
+            while lo < n:
+                hi = min(lo + step, n)
+                ranges.append(((lo, hi), (hi - lo) * rowb))
+                lo = hi
+
+            def _stage(rail, rng):
+                rlo, rhi = rng
+                rows[rlo:rhi] = np.asarray(dev_rows[rlo:rhi])
+
+            self._spill_striper.run_items(
+                ranges, _stage, priority=prio
             )
-        else:
-            grant = nullcontext()
-        # lands the (already async) D2H; the device array is
-        # bucket-padded, the tail rows are scratch filler
-        with grant:
-            rows = np.asarray(dev_rows)[:n]
             self.host.import_rows(ids, rows)
+        else:
+            grant = (
+                self._spill_stream.transfer(nbytes, priority=prio)
+                if arbitrate
+                else nullcontext()
+            )
+            # lands the (already async) D2H; the device array is
+            # bucket-padded, the tail rows are scratch filler
+            with grant:
+                rows = np.asarray(dev_rows)[:n]
+                self.host.import_rows(ids, rows)
         self.stats.spill_rows += len(ids)
         self.stats.spill_bytes += rows.nbytes
         self.stats.scatter_lag_s += time.perf_counter() - t_enq

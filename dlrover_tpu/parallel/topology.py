@@ -475,6 +475,19 @@ def note_fallback_use(model: LinkModel) -> None:
     )
 
 
+def rail_link_gbps(model: LinkModel, direction: str) -> float:
+    """Bandwidth of a transfer-arbiter rail under this model, by the
+    rail's direction: ``d2h``/``h2d`` price the host legs, ``peer``
+    prices the DCN path the batched RPC legs traverse. The multi-rail
+    striper plans completion-time-balanced chunk shares from these
+    numbers, so a measured model directly shapes the stripe."""
+    if direction == "h2d":
+        return model.host_h2d_gbps
+    if direction == "peer":
+        return model.dcn_gbps
+    return model.host_d2h_gbps
+
+
 def price_host_transfer(
     nbytes: int, h2d: bool = False, model: Optional[LinkModel] = None
 ) -> float:

@@ -514,6 +514,13 @@ class ElasticTrainer:
             )
             self._link_fp = fp
             topology.export_link_metrics(model, self._registry)
+            # same fingerprint discipline for the arbiter calibration:
+            # measure (or reuse) the per-rail hidden fraction so the
+            # dry-runner prices host traffic from observation instead
+            # of the documented constant
+            from dlrover_tpu.parallel import transfer_sched
+
+            transfer_sched.ensure_calibrated()
         except Exception as e:  # the probe must never kill training
             logger.warning(f"link-model probe failed: {e!r}")
 

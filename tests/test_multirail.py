@@ -1,0 +1,620 @@
+"""Multi-rail transfer striping (ISSUE 16 tentpole): rail registry and
+admission, crc32_combine algebra, completion-time-balanced stripe
+plans, rail-failure requeue (``transfer.stripe`` fault site), shutdown
+mid-stripe, measured arbiter calibration (cache hit / fingerprint
+reject / read-only degradation), the striped chunked-stager path, and
+the int8 wire format the reshard/embedding movers share."""
+
+import logging
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.common import faults
+from dlrover_tpu.parallel import transfer_sched, wire_format
+from dlrover_tpu.parallel.transfer_sched import (
+    HOST_HIDDEN_FRACTION,
+    ArbiterCalibration,
+    Priority,
+    StripedTransfer,
+    TransferArbiter,
+    aggregate_host_exposed_s,
+    calibrate_hidden_fraction,
+    calibration_path,
+    crc32_combine,
+    hidden_fraction_for,
+    load_calibration,
+    save_calibration,
+    set_arbiter,
+    set_calibration,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch, tmp_path):
+    """Fresh topology cache + no inherited calibration or faults: each
+    test prices and measures against its own world."""
+    monkeypatch.setenv(
+        "DLROVER_TPU_TOPOLOGY_CACHE", str(tmp_path / "topo-cache")
+    )
+    transfer_sched.reset_calibration()
+    faults.reset()
+    yield
+    transfer_sched.reset_calibration()
+    faults.reset()
+    set_arbiter(None)
+
+
+def _arb(**kw):
+    kw.setdefault("aging_s", 0.2)
+    kw.setdefault("enabled", True)
+    return TransferArbiter(**kw)
+
+
+# -- rails -------------------------------------------------------------------
+
+
+class TestRails:
+    def test_default_rails_exist(self):
+        a = _arb()
+        names = {r.name: r.direction for r in a.rails()}
+        assert names == {
+            "host_d2h": "d2h", "host_h2d": "h2d", "dcn": "peer"
+        }
+
+    def test_register_rail_get_or_create(self):
+        a = _arb()
+        r1 = a.register_rail("ici0", direction="peer", gbps=40.0)
+        r2 = a.register_rail("ici0")  # second call: same object
+        assert r1 is r2
+        assert a.rail_gbps("ici0") == 40.0
+
+    def test_rails_for_direction_and_peer(self):
+        a = _arb()
+        d2h = [r.name for r in a.rails_for("d2h")]
+        # native rail first, the peer (DCN) path after it
+        assert d2h == ["host_d2h", "dcn"]
+        h2d = [r.name for r in a.rails_for("h2d")]
+        assert h2d == ["host_h2d", "dcn"]
+
+    def test_admission_filters_priority(self):
+        a = _arb()
+        a.register_rail(
+            "dcn", admit=[Priority.EMERGENCY, Priority.BACKPRESSURE]
+        )
+        bg = [r.name for r in a.rails_for("d2h", Priority.BACKGROUND)]
+        assert bg == ["host_d2h"]
+        urgent = [
+            r.name for r in a.rails_for("d2h", Priority.EMERGENCY)
+        ]
+        assert "dcn" in urgent
+
+    def test_concurrent_grants_on_different_rails(self):
+        """The point of rails: D2H and H2D are separate wires, so both
+        directions hold grants at the same time."""
+        a = _arb()
+        down = a.register("down", direction="d2h")
+        up = a.register("up", direction="h2d")
+        order = []
+        with down.transfer(1 << 20, ignore_window=True):
+            t = threading.Thread(
+                target=lambda: (
+                    up.transfer(1 << 20, ignore_window=True).__enter__(),
+                    order.append("h2d-granted"),
+                )
+            )
+            t.start()
+            t.join(timeout=5.0)
+            assert not t.is_alive()
+        assert order == ["h2d-granted"]
+        a.shutdown()
+
+
+# -- crc algebra -------------------------------------------------------------
+
+
+class TestCrcCombine:
+    def test_matches_whole_payload_crc(self):
+        rng = np.random.default_rng(3)
+        data = rng.integers(0, 256, size=100_003, dtype=np.uint8)
+        payload = data.tobytes()
+        for cut in (0, 1, 1000, 50_000, len(payload)):
+            a, b = payload[:cut], payload[cut:]
+            assert crc32_combine(
+                zlib.crc32(a), zlib.crc32(b), len(b)
+            ) == zlib.crc32(payload)
+
+    def test_associative_fold(self):
+        parts = [b"abc", b"", b"defgh", b"\x00" * 17, b"z"]
+        total = 0
+        for p in parts:
+            total = crc32_combine(total, zlib.crc32(p), len(p))
+        assert total == zlib.crc32(b"".join(parts))
+
+
+# -- stripe plans ------------------------------------------------------------
+
+
+class TestStripePlan:
+    def test_shares_proportional_to_gbps(self):
+        a = _arb()
+        a.register_rail("railA", direction="d2h", gbps=3.0)
+        a.register_rail("railB", direction="d2h", gbps=1.0)
+        st = StripedTransfer(
+            a, direction="d2h", chunk_bytes=1 << 20,
+            rails=["railA", "railB"],
+        )
+        nbytes = 64 << 20
+        plan = st.plan(nbytes)
+        per = {}
+        covered = 0
+        for rail, off, ln in plan:
+            per[rail] = per.get(rail, 0) + ln
+            assert ln <= 1 << 20
+            covered += ln
+        assert covered == nbytes
+        # completion-time balance: bytes_i ∝ gbps_i (3:1 within a chunk)
+        assert per["railA"] == pytest.approx(
+            3 * per["railB"], abs=2 << 20
+        )
+        # contiguous, gapless coverage
+        offs = sorted((off, ln) for _, off, ln in plan)
+        cursor = 0
+        for off, ln in offs:
+            assert off == cursor
+            cursor += ln
+        assert cursor == nbytes
+
+    def test_no_rails_raises(self):
+        a = _arb()
+        st = StripedTransfer(a, direction="d2h", rails=[])
+        with pytest.raises(RuntimeError, match="no admitted rails"):
+            st.plan(1 << 20)
+
+
+# -- striped execution -------------------------------------------------------
+
+
+class TestStripedRun:
+    def test_bitwise_and_crc(self):
+        a = _arb()
+        a.register_rail("railA", direction="d2h", gbps=2.0)
+        a.register_rail("railB", direction="d2h", gbps=1.0)
+        st = StripedTransfer(
+            a, name="t", direction="d2h", chunk_bytes=64 << 10,
+            rails=["railA", "railB"], ignore_window=True,
+        )
+        rng = np.random.default_rng(0)
+        payload = rng.integers(0, 256, size=1 << 20, dtype=np.uint8)
+        dest = np.zeros_like(payload)
+
+        def mover(rail, off, ln):
+            dest[off:off + ln] = payload[off:off + ln]
+
+        rep = st.run(mover, payload=payload)
+        assert dest.tobytes() == payload.tobytes()
+        assert rep.crc32 == zlib.crc32(payload.tobytes())
+        assert rep.nbytes == payload.nbytes
+        assert len(rep.rail_bytes) == 2  # both rails carried traffic
+        assert sum(rep.rail_bytes.values()) == payload.nbytes
+        assert rep.failed_rails == []
+        a.shutdown()
+
+    def test_single_rail_degenerate(self):
+        a = _arb()
+        st = StripedTransfer(
+            a, direction="d2h", chunk_bytes=32 << 10,
+            rails=["host_d2h"], ignore_window=True,
+        )
+        payload = bytes(range(256)) * 1024
+        dest = bytearray(len(payload))
+
+        def mover(rail, off, ln):
+            dest[off:off + ln] = payload[off:off + ln]
+
+        rep = st.run(mover, payload=payload)
+        assert bytes(dest) == payload
+        assert rep.crc32 == zlib.crc32(payload)
+        assert rep.balance == 1.0
+        a.shutdown()
+
+    def test_run_items_lpt_spread(self):
+        a = _arb()
+        a.register_rail("railA", direction="d2h", gbps=1.0)
+        a.register_rail("railB", direction="d2h", gbps=1.0)
+        st = StripedTransfer(
+            a, direction="d2h", rails=["railA", "railB"],
+            ignore_window=True,
+        )
+        moved = {}
+        lock = threading.Lock()
+
+        def mover(rail, key):
+            with lock:
+                moved[key] = rail
+
+        items = [(f"k{i}", 1 << 20) for i in range(8)]
+        rep = st.run_items(items, mover)
+        assert set(moved) == {f"k{i}" for i in range(8)}
+        # equal-speed rails, equal-size items: an even 4/4 LPT split
+        assert rep.rail_chunks == {"railA": 4, "railB": 4}
+        assert rep.balance == pytest.approx(1.0)
+        a.shutdown()
+
+    def test_rail_failure_requeues_on_survivor(self):
+        """A rail dying mid-stripe moves its chunks to the survivors;
+        the re-sent chunks are position-addressed so the payload (and
+        its crc) stays bitwise."""
+        a = _arb()
+        a.register_rail("railA", direction="d2h", gbps=1.0)
+        a.register_rail("dcn", gbps=1.0)
+        st = StripedTransfer(
+            a, direction="d2h", chunk_bytes=64 << 10,
+            rails=["railA", "dcn"], ignore_window=True,
+        )
+        payload = np.random.default_rng(1).integers(
+            0, 256, size=1 << 20, dtype=np.uint8
+        )
+        dest = np.zeros_like(payload)
+
+        def mover(rail, off, ln):
+            if rail == "dcn":
+                raise OSError("dcn path down")
+            time.sleep(0.001)  # let the dcn worker hit its failure
+            dest[off:off + ln] = payload[off:off + ln]
+
+        rep = st.run(mover, payload=payload)
+        assert dest.tobytes() == payload.tobytes()
+        assert rep.crc32 == zlib.crc32(payload.tobytes())
+        assert rep.failed_rails == ["dcn"]
+        assert rep.requeued_chunks > 0
+        assert rep.rail_bytes.get("dcn", 0) == 0
+        assert rep.rail_bytes["railA"] == payload.nbytes
+        a.shutdown()
+
+    def test_all_rails_failed_raises_first_error(self):
+        a = _arb()
+        a.register_rail("railA", direction="d2h", gbps=1.0)
+        a.register_rail("railB", direction="d2h", gbps=1.0)
+        st = StripedTransfer(
+            a, direction="d2h", chunk_bytes=64 << 10,
+            rails=["railA", "railB"], ignore_window=True,
+        )
+
+        def mover(rail, off, ln):
+            raise OSError(f"{rail} down")
+
+        with pytest.raises(OSError, match="down"):
+            st.run(mover, nbytes=1 << 20)
+        a.shutdown()
+
+    def test_stripe_fault_site_injection(self):
+        """The chaos harness can kill one chunk move: the scripted
+        ``transfer.stripe:io_error:@2`` spec fires on exactly the
+        second chunk evaluation, that rail's leftovers requeue on the
+        survivor, and the folded crc still matches the payload."""
+        faults.configure("transfer.stripe:io_error:@2")
+        a = _arb()
+        a.register_rail("railA", direction="d2h", gbps=1.0)
+        a.register_rail("dcn", gbps=1.0)
+        st = StripedTransfer(
+            a, direction="d2h", chunk_bytes=64 << 10,
+            rails=["railA", "dcn"], ignore_window=True,
+        )
+        payload = np.random.default_rng(2).integers(
+            0, 256, size=1 << 20, dtype=np.uint8
+        )
+        dest = np.zeros_like(payload)
+
+        def mover(rail, off, ln):
+            dest[off:off + ln] = payload[off:off + ln]
+
+        rep = st.run(mover, payload=payload)
+        assert dest.tobytes() == payload.tobytes()
+        assert rep.crc32 == zlib.crc32(payload.tobytes())
+        assert len(rep.failed_rails) == 1
+        assert rep.requeued_chunks >= 1
+        counts = faults.triggered()
+        assert sum(
+            n for (site, _k), n in counts.items()
+            if site == "transfer.stripe"
+        ) == 1
+        a.shutdown()
+
+    def test_shutdown_mid_stripe_no_deadlock(self):
+        """arbiter.shutdown() while chunks are in flight: every later
+        grant degrades to pass-through and the stripe completes — no
+        worker is left waiting on a dead condition variable."""
+        a = _arb()
+        a.register_rail("railA", direction="d2h", gbps=1.0)
+        a.register_rail("dcn", gbps=1.0)
+        st = StripedTransfer(
+            a, direction="d2h", chunk_bytes=16 << 10,
+            rails=["railA", "dcn"], ignore_window=True,
+        )
+        payload = bytes(1 << 20)
+        started = threading.Event()
+
+        def mover(rail, off, ln):
+            started.set()
+            time.sleep(0.002)
+
+        killer = threading.Thread(
+            target=lambda: (started.wait(5.0), a.shutdown())
+        )
+        killer.start()
+        done = {}
+
+        def run():
+            done["rep"] = st.run(mover, nbytes=len(payload))
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join(timeout=20.0)
+        killer.join(timeout=5.0)
+        assert not t.is_alive(), "stripe deadlocked across shutdown"
+        assert done["rep"].chunks == 64
+        assert not a.scheduling_active
+
+
+# -- rail gauges -------------------------------------------------------------
+
+
+class TestRailMetrics:
+    def test_rail_gauge_family_exports(self):
+        from dlrover_tpu.obs.metrics import default_registry
+
+        a = _arb()
+        st = StripedTransfer(
+            a, direction="d2h", chunk_bytes=64 << 10,
+            rails=["host_d2h", "dcn"], ignore_window=True,
+        )
+        st.run(lambda rail, off, ln: None, nbytes=1 << 20)
+        text = default_registry().prometheus_text()
+        for name in (
+            "dlrover_transfer_rail_bytes_total",
+            "dlrover_transfer_rail_util_pct",
+            "dlrover_transfer_rail_stripe_chunks_total",
+            "dlrover_transfer_rail_stripe_balance_pct",
+        ):
+            assert name in text, name
+        a.shutdown()
+
+
+# -- calibration -------------------------------------------------------------
+
+
+def _fast_cal(**kw):
+    kw.setdefault("steps", 1)
+    kw.setdefault("compute_s", 0.004)
+    kw.setdefault("chunks", 2)
+    kw.setdefault("chunk_s", 0.002)
+    return calibrate_hidden_fraction(**kw)
+
+
+class TestCalibration:
+    def test_cold_measures_and_warm_hits_cache(self, tmp_path):
+        cache = str(tmp_path / "cal-cache")
+        cold = _fast_cal(cache_dir=cache, force=True)
+        assert cold.source == "measured"
+        assert set(cold.hidden_fraction) == {"host_d2h", "host_h2d"}
+        for hf in cold.hidden_fraction.values():
+            assert 0.0 <= hf <= 0.95
+        transfer_sched.reset_calibration()
+        warm = _fast_cal(cache_dir=cache)
+        # warm run returned the persisted measurement, not a re-measure
+        assert warm.measured_at == cold.measured_at
+        assert warm.hidden_fraction == cold.hidden_fraction
+
+    def test_fingerprint_mismatch_rejects_stale_entry(self, tmp_path):
+        """A cache file copied from a different world (its fingerprint
+        field does not match) must be rejected, not silently priced."""
+        cache = str(tmp_path / "cal-cache")
+        fp = transfer_sched._current_fingerprint()
+        stale = ArbiterCalibration(
+            fingerprint="some-other-world",
+            hidden_fraction={"host_d2h": 0.1},
+            measured_at=1.0,
+        )
+        import os
+
+        path = calibration_path(fp, cache)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(stale.to_json())
+        assert load_calibration(fp, cache) is None
+
+    def test_corrupt_cache_file_rejected(self, tmp_path):
+        cache = str(tmp_path / "cal-cache")
+        fp = transfer_sched._current_fingerprint()
+        import os
+
+        path = calibration_path(fp, cache)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write("{not json")
+        assert load_calibration(fp, cache) is None
+
+    def test_readonly_cache_degrades_to_constant(self, tmp_path):
+        """An unwritable cache dir: calibration still measures (and
+        prices) in-process, the save is a logged no-op, and a process
+        WITHOUT any calibration prices the documented constant with a
+        single fallback log line."""
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        # the repo logger sets propagate=False, so caplog never sees
+        # its records — attach a capture handler directly
+        h = _Capture()
+        transfer_sched.logger.addHandler(h)
+        try:
+            # a file where the cache dir should be: makedirs must fail
+            broken = tmp_path / "not-a-dir"
+            broken.write_text("occupied")
+            cal = _fast_cal(cache_dir=str(broken), force=True)
+            assert cal.hidden_fraction  # measurement itself succeeded
+            assert save_calibration(cal, str(broken)) is None
+            assert any(
+                "calibration cache write failed" in m for m in records
+            )
+            # no persisted file + no in-process calibration → the
+            # constant, logged exactly once however often pricing asks
+            transfer_sched.reset_calibration()
+            records.clear()
+            a = hidden_fraction_for("host_d2h")
+            b = hidden_fraction_for("host_h2d")
+            assert a == b == HOST_HIDDEN_FRACTION
+            fallback_logs = [
+                m for m in records if "HOST_HIDDEN_FRACTION" in m
+            ]
+            assert len(fallback_logs) == 1
+        finally:
+            transfer_sched.logger.removeHandler(h)
+
+    def test_measured_value_prices_est_step(self):
+        """aggregate_host_exposed_s must use the measured per-rail
+        hidden fraction whenever a calibration exists — per direction,
+        max across the two independent wires."""
+        a = _arb()
+        a.set_demand("ckpt", 100 << 20, direction="d2h")
+        a.set_demand("fault_in", 50 << 20, direction="h2d")
+        from dlrover_tpu.parallel.topology import price_host_transfer
+
+        d2h = price_host_transfer(100 << 20, h2d=False)
+        h2d = price_host_transfer(50 << 20, h2d=True)
+        cal = ArbiterCalibration(
+            fingerprint=transfer_sched._current_fingerprint(),
+            hidden_fraction={"host_d2h": 0.9, "host_h2d": 0.2},
+            measured_at=42.0,
+        )
+        got = aggregate_host_exposed_s(arbiter=a, calibration=cal)
+        assert got == pytest.approx(max(d2h * 0.1, h2d * 0.8))
+        a.shutdown()
+
+    def test_env_kill_switch_disables(self, monkeypatch):
+        monkeypatch.setenv(transfer_sched.ENV_CALIBRATE, "0")
+        assert transfer_sched.ensure_calibrated() is None
+        assert transfer_sched.get_calibration() is None
+
+    def test_dry_runner_reports_measured_flag(self):
+        cal = ArbiterCalibration(
+            fingerprint=transfer_sched._current_fingerprint(),
+            hidden_fraction={"host_d2h": 0.8},
+            measured_at=7.0,
+        )
+        set_calibration(cal)
+        assert transfer_sched.get_calibration() is cal
+        import dataclasses
+
+        from dlrover_tpu.accel.dry_runner import DryRunReport
+
+        assert "host_hidden_measured" in {
+            f.name for f in dataclasses.fields(DryRunReport)
+        }
+
+
+# -- striped chunked staging (ckpt/engine.py) --------------------------------
+
+
+@pytest.mark.slow
+class TestStripedStager:
+    def test_chunked_save_stripes_and_verifies(self, tmp_path):
+        import jax.numpy as jnp
+
+        from dlrover_tpu.ckpt.engine import CheckpointEngine
+        from dlrover_tpu.ckpt.saver import AsyncCheckpointSaver
+
+        AsyncCheckpointSaver.reset()
+        AsyncCheckpointSaver.start_async_saving_ckpt(local_shard_num=1)
+        try:
+            engine = CheckpointEngine()
+            try:
+                rng = np.random.default_rng(7)
+                state = {
+                    "big": jnp.asarray(
+                        rng.standard_normal(1 << 18), jnp.float32
+                    ),
+                    "small": jnp.asarray(
+                        rng.standard_normal(64), jnp.float32
+                    ),
+                }
+                stager = engine.begin_chunked_save(
+                    1, state, str(tmp_path),
+                    chunk_bytes=256 << 10,
+                    stripe_min_bytes=128 << 10,
+                )
+                assert stager is not None
+                while not stager.done:
+                    stager.advance(budget_s=0.005)
+                assert stager.commit()
+                striped = {
+                    r.name: r.stripe_chunks
+                    for r in stager._stream.arbiter.rails()
+                }
+                assert sum(striped.values()) > 0, "striping never ran"
+                assert sum(1 for v in striped.values() if v > 0) >= 2
+                # commit-time verification stays bitwise: verify=True
+                # recomputes against the per-chunk folded digests
+                step, recs, _ = engine._shm.load_records(
+                    copy=True, verify=True
+                )
+                assert step == 1
+                got = {r.path: r.data for r in recs}
+                np.testing.assert_array_equal(
+                    got["big"], np.asarray(state["big"])
+                )
+                np.testing.assert_array_equal(
+                    got["small"], np.asarray(state["small"])
+                )
+            finally:
+                engine.close()
+        finally:
+            AsyncCheckpointSaver.reset()
+
+
+# -- int8 wire format --------------------------------------------------------
+
+
+class TestWireFormat:
+    def test_roundtrip_bounded_error(self):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((257, 33)).astype(np.float32)
+        got = wire_format.roundtrip_int8(x, chunk_bytes=1 << 10)
+        assert got.shape == x.shape and got.dtype == x.dtype
+        assert np.max(np.abs(got - x)) <= np.max(np.abs(x)) / 127 * 1.01
+
+    def test_roundtrip_idempotent(self):
+        """crc over the DECODED payload only works if decode∘encode is
+        a fixed point: a second hop must reproduce the first bitwise."""
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal(10_001).astype(np.float32)
+        once = wire_format.roundtrip_int8(x, chunk_bytes=1 << 10)
+        twice = wire_format.roundtrip_int8(once, chunk_bytes=1 << 10)
+        np.testing.assert_array_equal(once, twice)
+
+    def test_all_zero_chunk_exact(self):
+        x = np.zeros(1000, dtype=np.float32)
+        q, scales = wire_format.encode_int8(x)
+        assert np.all(q == 0) and np.all(scales == 1.0)
+        np.testing.assert_array_equal(
+            wire_format.decode_int8(q, scales, x.dtype), x
+        )
+
+    def test_non_float_rejected(self):
+        with pytest.raises(TypeError, match="float"):
+            wire_format.encode_int8(np.arange(10, dtype=np.int32))
+
+    def test_decoded_crc32_detects_any_difference(self):
+        rng = np.random.default_rng(8)
+        a = {"w": rng.standard_normal(100).astype(np.float32)}
+        c1 = wire_format.decoded_crc32(a)
+        b = {"w": a["w"].copy()}
+        assert wire_format.decoded_crc32(b) == c1
+        b["w"][3] += 1e-3
+        assert wire_format.decoded_crc32(b) != c1

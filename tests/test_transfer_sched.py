@@ -18,6 +18,22 @@ from dlrover_tpu.parallel.transfer_sched import (
 )
 
 
+@pytest.fixture(autouse=True)
+def _isolated_calibration(monkeypatch, tmp_path):
+    """Pricing must not depend on whatever arbiter calibration an
+    earlier test (or a bench run on this machine) left in the real
+    topology cache: point the cache at a fresh dir and drop any
+    in-process calibration for every test in this file."""
+    from dlrover_tpu.parallel import transfer_sched
+
+    monkeypatch.setenv(
+        "DLROVER_TPU_TOPOLOGY_CACHE", str(tmp_path / "topo-cache")
+    )
+    transfer_sched.reset_calibration()
+    yield
+    transfer_sched.reset_calibration()
+
+
 @pytest.fixture
 def arb():
     a = TransferArbiter(aging_s=0.2, enabled=True)
@@ -254,21 +270,51 @@ class TestPricing:
         a.shutdown()
 
     def test_scheduled_vs_serialized(self):
+        """D2H and H2D are independent physical paths: the scheduled
+        estimate exposes the max of the per-direction terms (they
+        overlap each other as well as compute), not their sum — the
+        sum is the serialized (arbiter-off) world."""
         from dlrover_tpu.parallel.topology import price_host_transfer
 
         a = TransferArbiter(enabled=True)
         a.set_demand("ckpt_stage", 64 << 20, direction="d2h")
         a.set_demand("emb_fault", 8 << 20, direction="h2d")
         sched = aggregate_host_exposed_s(arbiter=a)
-        base = price_host_transfer(64 << 20, h2d=False) + (
-            price_host_transfer(8 << 20, h2d=True)
-        )
+        d2h = price_host_transfer(64 << 20, h2d=False)
+        h2d = price_host_transfer(8 << 20, h2d=True)
+        # no calibration cache in this test -> documented constant
         assert sched == pytest.approx(
-            base * (1.0 - HOST_HIDDEN_FRACTION)
+            max(d2h, h2d) * (1.0 - HOST_HIDDEN_FRACTION)
         )
-        a.shutdown()  # serialized world: everything exposed
-        assert aggregate_host_exposed_s(arbiter=a) == pytest.approx(base)
-        assert sched < base
+        a.shutdown()  # serialized world: everything exposed, summed
+        assert aggregate_host_exposed_s(arbiter=a) == pytest.approx(
+            d2h + h2d
+        )
+        assert sched < d2h + h2d
+
+    def test_measured_calibration_prices_per_rail(self):
+        """A calibration cache replaces the constant: pricing uses the
+        measured hidden fraction for each direction's rail."""
+        from dlrover_tpu.parallel import transfer_sched
+        from dlrover_tpu.parallel.topology import price_host_transfer
+
+        cal = transfer_sched.ArbiterCalibration(
+            fingerprint=transfer_sched._current_fingerprint(),
+            hidden_fraction={"host_d2h": 0.9, "host_h2d": 0.4},
+            measured_at=123.0,
+            source="test",
+        )
+        transfer_sched.set_calibration(cal)
+        a = TransferArbiter(enabled=True)
+        a.set_demand("ckpt_stage", 64 << 20, direction="d2h")
+        a.set_demand("emb_fault", 48 << 20, direction="h2d")
+        sched = aggregate_host_exposed_s(arbiter=a)
+        d2h = price_host_transfer(64 << 20, h2d=False)
+        h2d = price_host_transfer(48 << 20, h2d=True)
+        assert sched == pytest.approx(
+            max(d2h * (1.0 - 0.9), h2d * (1.0 - 0.4))
+        )
+        a.shutdown()
 
     def test_dry_runner_est_step_s_sensitivity(self):
         """The acceptance leg: est_step_s must move with the aggregate

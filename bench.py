@@ -3553,6 +3553,217 @@ def run_multirail_bench(jax, results: dict, smoke: bool = False):
     transfer_sched.reset_calibration()
 
 
+# serving co-location gates (ISSUE 17): training goodput may lose at
+# most this much (relative %) to a co-located serving plane, and when
+# serving is confined to idle gaps the fleet goodput number must stay
+# within this many percentage points of the serving-free baseline
+SERVING_GOODPUT_LOSS_GATE_PCT = 10.0
+SERVING_GAP_DELTA_GATE_PCT = 1.0
+
+
+def run_serving_bench(jax, results: dict, smoke: bool = False):
+    """The ISSUE 17 acceptance legs (serve-while-training):
+
+    - **zero-copy subscribe**: the subscriber's mapped records must
+      alias its own shm mapping — no host memcpy on the subscribe path
+      (``np.shares_memory`` against the segment buffer);
+    - **bitwise decode**: tokens served by the engine over the
+      subscribed (crc-gated) frame must be bitwise-identical to a
+      greedy decode under a direct step-N restore
+      (``load_records(copy=True, verify=True)`` → ``restore_state``);
+    - **torn frame**: a commit provoked mid-read (the
+      ``serve.stale_read`` delay widens the map→recheck window while a
+      thread commits into it) must be caught by the generation
+      re-check — never handed out — and the next poll must adopt the
+      racing commit cleanly;
+    - **co-located goodput**: a simulated train loop (compute spans +
+      arbiter marks) with the serving thread soaking its idle gaps
+      must lose ≤ ``SERVING_GOODPUT_LOSS_GATE_PCT`` goodput relative
+      to the serving-free baseline while tokens/s > 0 and the
+      ``serving_soak`` seconds are visible in the ledger; gap-confined
+      serving must leave the goodput number within
+      ``SERVING_GAP_DELTA_GATE_PCT`` points of the baseline.
+    """
+    import threading
+
+    from dlrover_tpu.common import faults
+    from dlrover_tpu.ckpt.sharding import host_shard_records, restore_state
+    from dlrover_tpu.ckpt.shm_handler import ShmHandler, ShmSubscriber
+    from dlrover_tpu.models import tiny
+    from dlrover_tpu.models.transformer import init_params
+    from dlrover_tpu.obs import goodput as obs_goodput
+    from dlrover_tpu.obs.goodput import GoodputLedger
+    from dlrover_tpu.obs.trace import SpanTracer
+    from dlrover_tpu.parallel import transfer_sched
+    from dlrover_tpu.rl.continuous_batching import continuous_generate
+    from dlrover_tpu.serve import ServingConfig, ServingEngine
+
+    rank = 91  # own shm segment + meta socket; no collision with chaos
+    cfg = tiny(vocab_size=31, num_layers=1, max_seq_len=32)
+    params = jax.jit(lambda k: init_params(k, cfg))(
+        jax.random.PRNGKey(17)
+    )
+    zeros = jax.tree_util.tree_map(
+        lambda a: jax.numpy.zeros_like(a), params
+    )
+    rng = np.random.default_rng(17)
+    n, p_max = 3, 6
+    lens = rng.integers(2, p_max + 1, size=n).astype(np.int32)
+    toks = np.zeros((n, p_max), np.int32)
+    for i, ln in enumerate(lens):
+        toks[i, :ln] = rng.integers(1, cfg.vocab_size, size=ln)
+    prompts = jax.numpy.asarray(toks)
+    plens = jax.numpy.asarray(lens)
+
+    writer = ShmHandler(rank, create=True)
+    sub = ShmSubscriber(rank)  # verify=True: every map is crc-gated
+    scfg = ServingConfig(max_new_tokens=4, slots=2, soak="idle_gaps")
+    eng = ServingEngine(cfg, ShmSubscriber(rank), zeros, scfg)
+    try:
+        # a stale in-compute mark from an earlier leg would make the
+        # first gap-gated batches wait out their timeout
+        transfer_sched.note_compute(False)
+
+        # -- zero-copy subscribe ------------------------------------
+        writer.save_records(1, host_shard_records(params), {})
+        frame = sub.poll()
+        seg = np.frombuffer(sub.handler._shm.buf, dtype=np.uint8)
+        results["serving_zero_copy"] = bool(
+            frame is not None
+            and all(np.shares_memory(r.data, seg) for r in frame.records)
+        )
+        del frame, seg
+
+        # -- bitwise decode vs a direct step-N restore --------------
+        assert eng.try_swap()
+        key = jax.random.PRNGKey(0)
+        got = eng.serve_batch(prompts, plens, key)
+        _, recs, _ = writer.load_records(copy=True, verify=True)
+        by_path = {r.path: [r] for r in recs}
+        direct = restore_state(zeros, lambda p: by_path.get(p, []))
+        want = continuous_generate(
+            direct, prompts, plens, key, cfg,
+            max_new_tokens=scfg.max_new_tokens, eos_id=scfg.eos_id,
+            slots=scfg.slots, greedy=True,
+        )
+        results["serving_bitwise_vs_restore"] = bool(
+            all(
+                np.array_equal(np.asarray(g), np.asarray(w))
+                for g, w in zip(got, want)
+            )
+        )
+
+        # -- torn frame: commit provoked mid-read -------------------
+        writer.save_records(2, host_shard_records(params), {})
+        faults.configure("serve.stale_read:delay:1.0")
+        committed = threading.Event()
+
+        def racing_commit():
+            time.sleep(0.02)  # inside the widened map→recheck window
+            writer.save_records(3, host_shard_records(params), {})
+            committed.set()
+
+        t = threading.Thread(target=racing_commit)
+        t.start()
+        torn_frame = sub.poll()
+        t.join()
+        faults.reset()
+        results["serving_torn_provoked"] = bool(committed.is_set())
+        recovered = sub.poll()
+        results["serving_torn_caught"] = bool(
+            torn_frame is None
+            and sub.torn_retries >= 1
+            and recovered is not None
+            and recovered.step == 3
+        )
+        del torn_frame, recovered
+
+        # -- co-located goodput -------------------------------------
+        # warm the decode compile outside the measured windows (marks
+        # are idle here, so the gap gate opens immediately)
+        eng.try_swap()
+        eng.serve_batch(prompts, plens, key)
+
+        steps = 12 if smoke else 40
+        compute_s, gap_s = 0.03, 0.02
+
+        def train_loop(tracer):
+            for _ in range(steps):
+                transfer_sched.note_compute(True)
+                with tracer.span("compute"):
+                    time.sleep(compute_s)
+                transfer_sched.note_compute(False)
+                time.sleep(gap_s)
+
+        tr_base = SpanTracer(enabled=True)
+        led_base = GoodputLedger(tracer=tr_base)
+        train_loop(tr_base)
+        base = led_base.snapshot()
+
+        tr_colo = SpanTracer(enabled=True)
+        led_colo = GoodputLedger(tracer=tr_colo)
+        prev_ledger = obs_goodput.default_ledger()
+        obs_goodput.install_default_ledger(led_colo)
+        stop = threading.Event()
+        served = {"batches": 0, "tokens": 0}
+
+        def serve_loop():
+            k = 1
+            while not stop.is_set():
+                eng.try_swap()
+                _, _, out_lens = eng.serve_batch(
+                    prompts, plens, jax.random.PRNGKey(k)
+                )
+                k += 1
+                served["batches"] += 1
+                served["tokens"] += int(
+                    np.sum(np.asarray(out_lens) - lens)
+                )
+
+        worker = threading.Thread(target=serve_loop)
+        t0 = time.perf_counter()
+        worker.start()
+        try:
+            train_loop(tr_colo)
+        finally:
+            stop.set()
+            worker.join()
+            obs_goodput._default = prev_ledger
+            transfer_sched.note_compute(False)
+        dt = time.perf_counter() - t0
+        colo = led_colo.snapshot()
+
+        results["serving_batches"] = served["batches"]
+        results["serving_tokens_per_s"] = round(
+            served["tokens"] / max(dt, 1e-9), 1
+        )
+        results["serving_soak_s"] = round(
+            colo.seconds.get("serving_soak", 0.0), 6
+        )
+        results["serving_goodput_base_pct"] = round(base.goodput_pct, 3)
+        results["serving_goodput_colocated_pct"] = round(
+            colo.goodput_pct, 3
+        )
+        results["serving_goodput_loss_pct"] = round(
+            100.0
+            * max(0.0, base.goodput_pct - colo.goodput_pct)
+            / max(base.goodput_pct, 1e-9),
+            3,
+        )
+        # gap-confined serving must not move the fleet number: the
+        # soak only claims seconds every training row left unclaimed
+        results["serving_gap_confined_goodput_delta_pct"] = round(
+            colo.goodput_pct - base.goodput_pct, 3
+        )
+        results["serving_swap_ms"] = eng.stats()["last_swap_ms"]
+        results["serving_weight_staleness_steps"] = eng.staleness_steps()
+    finally:
+        faults.reset()
+        sub.close()
+        eng.subscriber.close()
+        writer.close(unlink=True)
+
+
 def run_graftlint_gate(results: dict):
     """Static-analysis gate (ISSUE 15): the tree must be graftlint-clean
     — zero unsuppressed findings over ``dlrover_tpu/`` + ``tools/``
@@ -3657,6 +3868,10 @@ def run_smoke() -> int:
         run_multirail_bench(jax, results, smoke=True)
     except Exception as e:
         results["multirail_error"] = repr(e)
+    try:
+        run_serving_bench(jax, results, smoke=True)
+    except Exception as e:
+        results["serving_error"] = repr(e)
     try:
         run_graftlint_gate(results)
     except Exception as e:
@@ -3892,6 +4107,33 @@ def run_smoke() -> int:
         and results.get("stripe_crc_parity") == "bitwise"
         and results.get("arbiter_calibration_cache_hit") is True
         and results.get("multirail_priced_from_measured") is True
+        # the serve-while-training gates (ISSUE 17): the subscriber
+        # must map frames zero-copy and serve tokens bitwise-identical
+        # to a direct crc-gated restore, the provoked commit-mid-read
+        # race must be caught by the seqlock generation re-check, and
+        # co-located serving must pay ≤10% training goodput while
+        # earning tokens — with gap-confined serving moving the fleet
+        # goodput number by at most ±1 point and its soak seconds
+        # visible in the ledger
+        and "serving_error" not in results
+        and results.get("serving_zero_copy") is True
+        and results.get("serving_bitwise_vs_restore") is True
+        and results.get("serving_torn_provoked") is True
+        and results.get("serving_torn_caught") is True
+        and results.get("serving_tokens_per_s") is not None
+        and results["serving_tokens_per_s"] > 0
+        and (results.get("serving_soak_s") or 0) > 0
+        and results.get("serving_goodput_loss_pct") is not None
+        and (
+            results["serving_goodput_loss_pct"]
+            <= SERVING_GOODPUT_LOSS_GATE_PCT
+        )
+        and results.get("serving_gap_confined_goodput_delta_pct")
+        is not None
+        and (
+            abs(results["serving_gap_confined_goodput_delta_pct"])
+            <= SERVING_GAP_DELTA_GATE_PCT
+        )
         # the static-analysis gate (ISSUE 15): the tree must be
         # graftlint-clean — an unsuppressed invariant violation
         # (lock discipline, span leak, RPC matrix hole, metric/doc
@@ -4090,6 +4332,11 @@ def main() -> int:
     except Exception as e:
         results["multirail_effective_GBps_vs_single"] = None
         results["multirail_error"] = repr(e)
+    try:
+        run_serving_bench(jax, results)
+    except Exception as e:
+        results["serving_tokens_per_s"] = None
+        results["serving_error"] = repr(e)
     try:
         run_mfu(jax, results)
     except Exception as e:

@@ -20,6 +20,11 @@ from dlrover_tpu.ckpt.checkpointer import (  # noqa: F401
     StorageType,
 )
 from dlrover_tpu.ckpt.engine import CheckpointEngine  # noqa: F401
+from dlrover_tpu.ckpt.shm_handler import (  # noqa: F401
+    PublishedFrame,
+    ShmCrcError,
+    ShmSubscriber,
+)
 from dlrover_tpu.ckpt.saver import (  # noqa: F401
     AsyncCheckpointSaver,
     gc_checkpoints,

@@ -30,6 +30,12 @@ subtracted from every lower row, so the partition is disjoint):
 | productive_compute  | ``compute`` spans                              |
 | degraded            | ``degraded_enter()``..``exit()`` episode time  |
 |                     | not already claimed above (PR-5 shm-only mode) |
+| serving_soak        | ``serving_begin()``..``end()`` episodes: the   |
+|                     | co-located inference plane decoding in idle    |
+|                     | step gaps / resize drains (PR-17); ranked      |
+|                     | BELOW every training row so serving can only   |
+|                     | claim time training left on the table — any    |
+|                     | overlap with ``compute`` is priced as training |
 | other               | the remainder (bring-up, eval, logging, ...)   |
 
 Only spans on the train thread count (``tid_fn``, same convention as
@@ -67,6 +73,7 @@ CATEGORIES = (
     "comm_exposed",
     "productive_compute",
     "degraded",
+    "serving_soak",
     "other",
 )
 
@@ -208,6 +215,8 @@ class GoodputLedger:
         self._replay_closed: List[Tuple[int, int]] = []
         self._eviction_since: Optional[int] = None
         self._eviction_closed: List[Tuple[int, int]] = []
+        self._serving_since: Optional[int] = None
+        self._serving_closed: List[Tuple[int, int]] = []
 
     # -- event-derived categories (PR-5 node events) -------------------
     def degraded_enter(self):
@@ -258,14 +267,35 @@ class GoodputLedger:
                 )
                 self._eviction_since = None
 
+    def serving_begin(self):
+        """The co-located serving plane started decoding a batch.
+        Ranked below every training category, so serving only claims
+        wall time training left unclaimed — the idle gaps it is meant
+        to soak; a batch that overlaps a ``compute`` span costs the
+        serving row nothing (training already owns that second)."""
+        with self._lock:
+            if self._serving_since is None:
+                self._serving_since = time.monotonic_ns()
+
+    def serving_end(self):
+        with self._lock:
+            if self._serving_since is not None:
+                self._serving_closed.append(
+                    (self._serving_since, time.monotonic_ns())
+                )
+                self._serving_since = None
+
     def mark_interval(self, category: str, start_ns: int, end_ns: int):
         """Attribute an explicit monotonic-ns interval (bench probes
         that measure a restore with ``time.perf_counter`` bracket it
-        here instead of re-inventing the categories)."""
+        here instead of re-inventing the categories; a serving plane
+        running in another process reports its busy windows the same
+        way)."""
         buckets = {
             "restart_replay": self._replay_closed,
             "degraded": self._degraded_closed,
             "eviction": self._eviction_closed,
+            "serving_soak": self._serving_closed,
         }
         if category not in buckets:
             raise ValueError(
@@ -357,6 +387,11 @@ class GoodputLedger:
                     self._eviction_closed, self._eviction_since, a, b
                 )
             )
+            per_cat["serving_soak"].extend(
+                self._episode_intervals(
+                    self._serving_closed, self._serving_since, a, b
+                )
+            )
 
             covered: List[Tuple[int, int]] = []
             for cat in CATEGORIES:
@@ -438,3 +473,17 @@ def note_degraded(entered: bool):
         ledger.degraded_enter()
     else:
         ledger.degraded_exit()
+
+
+def note_serving(active: bool):
+    """Serving-plane seam: the co-located inference engine flips this
+    around each decode batch so the trainer's ledger prices exactly
+    what co-location costs; a no-op until a trainer installs a
+    ledger."""
+    ledger = _default
+    if ledger is None:
+        return
+    if active:
+        ledger.serving_begin()
+    else:
+        ledger.serving_end()

@@ -362,6 +362,20 @@ class DeviceHotTier:
     def unpin(self, slots: np.ndarray):
         self._pins[slots] = np.maximum(self._pins[slots] - 1, 0)
 
+    def recency_snapshot(self) -> Dict[str, Any]:
+        """Copy of the residency/LRU/pin bookkeeping. The serving-path
+        guarantee is stated against this: a read-only probe
+        (``gather(insert_missing=False)``) must leave two snapshots
+        bit-identical — no admissions, no recency touches, no pin
+        drift — so serving traffic can never evict or age what
+        training needs resident."""
+        return {
+            "tick": self._tick,
+            "resident": dict(self._slot_of),
+            "last_used": self._last_used.copy(),
+            "pins": self._pins.copy(),
+        }
+
     def _allocate(
         self, n: int
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

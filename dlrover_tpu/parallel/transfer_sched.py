@@ -416,6 +416,18 @@ class TransferArbiter:
             and now - self._last_mark < WINDOW_TTL_S
         )
 
+    def in_compute_window(self) -> bool:
+        """True while a FRESH mark says the trainer is inside a compute
+        span. The co-located serving plane uses this as its idle-gap
+        gate: stale or absent marks (no trainer, or a trainer wedged
+        past WINDOW_TTL_S in host work — e.g. a resize drain) read as
+        idle, so serving soaks exactly the windows BACKGROUND grants
+        already treat as free."""
+        with self._cond:
+            return self._window_gating(time.perf_counter()) and (
+                self._in_compute
+            )
+
     # -- scheduling ----------------------------------------------------
     def _route(self, direction_or_rail: str) -> str:
         # lock held by callers

@@ -936,6 +936,11 @@ class ElasticTrainer:
         step = self.global_step
         self._goodput.eviction_begin()
         try:
+            # the drain runs no train compute: mark the arbiter idle so
+            # a co-located serving plane may soak the grace window (its
+            # transfers stay BACKGROUND — the emergency stage's
+            # EMERGENCY chunks still preempt them on the rails)
+            transfer_sched.note_compute(False)
             self._flight.suppress_watchdog(grace + 60.0)
             self._flight.note_event(
                 "eviction",
@@ -1798,6 +1803,10 @@ class ElasticTrainer:
         # owns the compile budget
         if self._spec_compiler is not None:
             self._spec_compiler.submit(())
+        # the whole resize window is device-idle: refresh the arbiter's
+        # out-of-compute mark so the co-located serving plane's idle-gap
+        # gate opens NOW instead of waiting out the mark TTL
+        transfer_sched.note_compute(False)
         # (1) prefetcher down BEFORE any reshard: see docstring
         with span("resize_drain"):
             buffered = (

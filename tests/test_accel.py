@@ -69,6 +69,7 @@ def _param_dominant_cfg():
     )
 
 
+@pytest.mark.slow  # ~10s: AOT compile for cost analysis; budget-gated out
 def test_compiled_cost_reports_memory():
     cfg = _param_dominant_cfg()
     tx = optax.adamw(1e-3)
@@ -427,6 +428,7 @@ def test_pinned_1f1b_strategy_through_driver():
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow  # ~24s: repeated recompiles; budget-gated out of tier-1
 def test_optimizations_applied_exactly_once():
     """Non-idempotent registered opts must not compound across the
     candidate/search/build stages (names are recorded; _build applies)."""

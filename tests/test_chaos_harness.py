@@ -62,6 +62,21 @@ class TestControlPlaneScenarios:
             chaos.run_scenario("no_such_scenario")
 
 
+class TestServingScenarios:
+    def test_serving_crc_retry(self, tmp_path):
+        """ISSUE 17 satellite: a seeded bit flip rots one published
+        record; the subscriber must skip that generation naming the
+        rotten record (no crash, exactly one crc retry) and recover on
+        the next clean commit."""
+        res = chaos.run_scenario(
+            "serving_crc_retry", seed=3, workdir=str(tmp_path)
+        )
+        assert res["ok"], res
+        assert res["crc_retries"] == 1
+        assert res["rotten_record"] is not None
+        assert res["recovered_step"] == 3
+
+
 class TestCli:
     def test_list(self):
         out = subprocess.run(

@@ -11,7 +11,7 @@ import pytest
 from dlrover_tpu.models import tiny
 from dlrover_tpu.models.transformer import init_params
 from dlrover_tpu.rl.continuous_batching import continuous_generate
-from dlrover_tpu.rl.generation import generate
+from dlrover_tpu.rl.generation import _mask_logits, generate
 
 
 @pytest.fixture(scope="module")
@@ -32,6 +32,7 @@ def _prompt_queue(n, p_max, vocab, seed=0):
 
 
 class TestGreedyEquivalence:
+    @pytest.mark.slow  # ~9s; bench --smoke gates the same bitwise claim
     def test_matches_single_prompt_generate(self, model):
         cfg, params = model
         N, P_max, new = 5, 10, 6
@@ -57,6 +58,7 @@ class TestGreedyEquivalence:
                 rtol=2e-4, atol=2e-5,
             )
 
+    @pytest.mark.slow  # ~10s; refill path also covered by determinism tests
     def test_more_prompts_than_slots_refills(self, model):
         # N >> slots forces multiple refill waves through one slot
         cfg, params = model
@@ -145,3 +147,105 @@ class TestSampled:
                 params, prompts, lens, jax.random.PRNGKey(0), cfg,
                 top_p=0.0,
             )
+
+
+class TestMaskLogits:
+    """Edge cases of the vLLM-style support restriction: top_k=0 and
+    top_p=1.0 are keep-all, the nucleus boundary token stays in, and
+    composed knobs renormalize over the top-k restriction first."""
+
+    def _logits(self, probs):
+        # softmax(log p) == p, so tests can reason in probabilities
+        return jnp.log(jnp.asarray([probs], jnp.float32))
+
+    def test_topk_zero_topp_one_is_identity(self):
+        logits = jnp.asarray([[0.5, -1.0, 2.0, 0.0]], jnp.float32)
+        out = _mask_logits(logits, 0, 1.0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(logits))
+
+    def test_topk_larger_than_vocab_clamps_to_keep_all(self):
+        logits = jnp.asarray([[0.5, -1.0, 2.0, 0.0]], jnp.float32)
+        out = _mask_logits(logits, 99, 1.0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(logits))
+
+    def test_topk_only_keeps_exactly_k(self):
+        logits = jnp.asarray([[0.1, 3.0, 2.0, -1.0, 0.5]], jnp.float32)
+        out = np.asarray(_mask_logits(logits, 2, 1.0))
+        finite = np.isfinite(out[0])
+        assert set(np.nonzero(finite)[0]) == {1, 2}
+        np.testing.assert_array_equal(out[0][finite], [3.0, 2.0])
+
+    def test_nucleus_boundary_token_stays(self):
+        # probs .5/.3/.15/.05, p=0.6: keep while PRECEDING mass < p —
+        # token 1 crosses 0.6 and stays (the nucleus definition);
+        # token 2's preceding mass is 0.8, out
+        out = np.asarray(_mask_logits(self._logits([0.5, 0.3, 0.15, 0.05]), 0, 0.6))
+        np.testing.assert_array_equal(
+            np.isfinite(out[0]), [True, True, False, False]
+        )
+
+    def test_nucleus_tiny_p_keeps_argmax(self):
+        out = np.asarray(_mask_logits(self._logits([0.2, 0.5, 0.3]), 0, 1e-6))
+        np.testing.assert_array_equal(
+            np.isfinite(out[0]), [False, True, False]
+        )
+
+    def test_topk_then_nucleus_composes_renormalized(self):
+        # probs .4/.3/.2/.1 with top_k=2, top_p=0.5: the nucleus runs
+        # over the RESTRICTED renormalized distribution [.571, .429] —
+        # token 1's preceding mass is .571 >= .5, so only token 0
+        # survives. Nucleus alone at p=0.5 would keep two tokens.
+        logits = self._logits([0.4, 0.3, 0.2, 0.1])
+        combined = np.asarray(_mask_logits(logits, 2, 0.5))
+        np.testing.assert_array_equal(
+            np.isfinite(combined[0]), [True, False, False, False]
+        )
+        nucleus_only = np.asarray(_mask_logits(logits, 0, 0.5))
+        np.testing.assert_array_equal(
+            np.isfinite(nucleus_only[0]), [True, True, False, False]
+        )
+
+    def test_rows_masked_independently(self):
+        logits = jnp.log(jnp.asarray(
+            [[0.5, 0.3, 0.15, 0.05], [0.05, 0.15, 0.3, 0.5]], jnp.float32
+        ))
+        out = np.asarray(_mask_logits(logits, 0, 0.6))
+        np.testing.assert_array_equal(
+            np.isfinite(out[0]), [True, True, False, False]
+        )
+        np.testing.assert_array_equal(
+            np.isfinite(out[1]), [False, False, True, True]
+        )
+
+
+class TestDeterministicSeeds:
+    """Sampling inside ``continuous_generate`` folds the key per decode
+    step: the whole rollout is a pure function of (params, prompts,
+    key) — the serving plane relies on this for replayable decodes."""
+
+    def test_same_key_bitwise_identical(self, model):
+        cfg, params = model
+        prompts, lens = _prompt_queue(4, 6, cfg.vocab_size, seed=9)
+        runs = [
+            continuous_generate(
+                params, prompts, lens, jax.random.PRNGKey(42), cfg,
+                max_new_tokens=4, slots=2, temperature=0.8,
+                top_k=5, top_p=0.9,
+            )
+            for _ in range(2)
+        ]
+        for a, b in zip(runs[0], runs[1]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_different_key_differs(self, model):
+        cfg, params = model
+        prompts, lens = _prompt_queue(4, 6, cfg.vocab_size, seed=9)
+        out = [
+            continuous_generate(
+                params, prompts, lens, jax.random.PRNGKey(k), cfg,
+                max_new_tokens=4, slots=2, temperature=0.8,
+                top_k=5, top_p=0.9,
+            )[0]
+            for k in (42, 43)
+        ]
+        assert not np.array_equal(np.asarray(out[0]), np.asarray(out[1]))

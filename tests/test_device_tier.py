@@ -594,3 +594,30 @@ class TestReadOnlyGather:
         )
         assert 10 not in emb.hot._slot_of  # no device promotion
         emb.close()
+
+    def test_probe_leaves_lru_and_pins_untouched(self):
+        """The serving-path guarantee (ISSUE 17): a read-only probe
+        admits ZERO rows to the hot tier and leaves the LRU recency /
+        pin bookkeeping bit-identical — serving traffic must not be
+        able to evict or age what training needs resident."""
+        host = _host()
+        emb = _emb(host=host, lr=1.0)
+        ids = np.arange(8, dtype=np.int64)
+        prep = emb.prepare(ids)
+        emb.apply_grads(prep, np.ones((8, DIM), np.float32), step=1)
+        # a pinned in-flight batch: pins must survive the probe too
+        live = emb.prepare(np.array([2, 5], np.int64))
+        before = emb.hot.recency_snapshot()
+        probe = np.array([0, 2, 5, 7, 4242, 9999], np.int64)
+        for _ in range(3):  # repeated probes must not age anything
+            emb.gather(probe, insert_missing=False)
+        after = emb.hot.recency_snapshot()
+        assert after["tick"] == before["tick"]
+        assert after["resident"] == before["resident"]
+        np.testing.assert_array_equal(
+            after["last_used"], before["last_used"]
+        )
+        np.testing.assert_array_equal(after["pins"], before["pins"])
+        assert 4242 not in emb.hot._slot_of
+        emb.release(live)
+        emb.close()

@@ -112,6 +112,7 @@ class TestSupportGate:
 
 
 class TestParity:
+    @pytest.mark.slow  # ~15s: offload parity double-compile; budget-gated out
     def test_step_matches_on_device_path(self, cfg, big_mesh):
         tx = optax.adamw(1e-3)
         mesh = big_mesh

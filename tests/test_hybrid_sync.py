@@ -272,6 +272,7 @@ class TestHybridTrainStep:
             state, m = step(state, b["x"], b["y"])
         return float(m["loss"]), float(m["grad_norm"]), state
 
+    @pytest.mark.slow  # ~12s: two full compiles for bitwise parity
     def test_fsdp_explicit_is_bitwise_gspmd(self):
         """The acceptance gate in test form: the ZeRO schedule is the
         same math in the same grouping GSPMD uses (RS over fsdp, then

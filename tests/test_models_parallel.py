@@ -342,6 +342,7 @@ class TestOptStateShardings:
         assert checked == 2 * len(flat_p)
 
 
+@pytest.mark.slow  # ~13s: double compile for parity; budget-gated out
 def test_grad_accum_matches_full_batch():
     """K-microbatch accumulation == one full-batch step (same data,
     same update) to float tolerance."""
@@ -576,6 +577,7 @@ class TestHybridDcnMesh:
         yield
         jax.config.update("jax_threefry_partitionable", old)
 
+    @pytest.mark.slow  # ~21s: 2-slice hybrid-mesh compile; budget-gated out
     def test_train_step_on_hybrid_mesh(self, _sharding_invariant_rng):
         """A real train step compiles and runs on the 2-slice hybrid
         mesh and matches the single-device result (layout, not math)."""

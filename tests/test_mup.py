@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from dlrover_tpu.models import init_params, tiny
 from dlrover_tpu.models.mup import (
@@ -84,6 +85,7 @@ def test_weight_decay_width_independent():
     )
 
 
+@pytest.mark.slow  # ~19s: 4x width sweep with training; budget-gated out
 def test_coordinate_check():
     """Trained-logit magnitude ratio across a 4x width sweep stays near 1
     under muP but grows with width under SP (same base LR)."""

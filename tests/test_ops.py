@@ -169,6 +169,7 @@ class TestFusedShortSeq:
         np.testing.assert_allclose(of, os_, atol=2e-5)
         np.testing.assert_allclose(lf, ls, atol=2e-5)
 
+    @pytest.mark.slow  # ~27s: heaviest tier-1 test; budget-gated out
     def test_chunked_fwd_matches_full(self):
         """flash_attention_fwd_chunked (fused tiles + online merges)
         must equal the one-call forward — same o AND lse, causal and

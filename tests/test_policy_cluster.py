@@ -29,6 +29,7 @@ class TestPolicy:
         with pytest.raises(ValueError):
             MixedPrecisionPolicy.parse("banana=f32")
 
+    @pytest.mark.slow  # ~12s: real train step; budget-gated out of tier-1
     def test_policy_trains(self):
         """A policy-stamped config runs a real step (bf16 compute, fp32
         params) with finite loss."""

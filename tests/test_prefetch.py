@@ -177,6 +177,7 @@ class TestDevicePrefetcher:
 
 
 class TestTrainerPipeline:
+    @pytest.mark.slow  # ~16s: full-pipeline trainer run; budget-gated out
     def test_trainer_prefetch_rewind_and_donation(self, tmp_path):
         """ElasticTrainer with the full pipeline on: prefetched input,
         donation-aware stepping, chunked staging. The run must complete,

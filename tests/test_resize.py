@@ -847,6 +847,7 @@ class TestScaleCandidatePublication:
 
 
 class TestResizeBenchSmoke:
+    @pytest.mark.slow  # ~18s: duplicates bench --smoke; budget-gated out
     def test_bench_resize_keys_and_warm_bar(self):
         """CI wiring (satellite + acceptance): the smoke resize must
         emit the new keys, hit the compile cache on the second resize,

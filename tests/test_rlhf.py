@@ -293,6 +293,7 @@ class TestRewardModel:
 
 
 class TestHybridPlacement:
+    @pytest.mark.slow  # ~19s: dual-mesh compile; budget-gated out of tier-1
     def test_train_and_rollout_use_different_shardings(self, cfg):
         """The weight-flow analog of the DS hybrid engine: actor weights
         train ZeRO-3-sharded (fsdp) and are explicitly resharded to the

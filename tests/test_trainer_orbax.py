@@ -349,6 +349,7 @@ def test_trainer_grad_accum(tmp_path):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # ~13s: multi-eval trainer run; budget-gated out of tier-1
 def test_save_best_and_early_stopping(tmp_path):
     """save_best persists a DISK checkpoint on eval improvement; early
     stopping halts after `patience` evals without improvement (an

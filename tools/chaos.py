@@ -32,6 +32,10 @@ Scenarios (each takes a seed; the same seed replays the same run):
 | brain_outage_mid_plan    | the Brain goes dark mid-plan; the executor  |
 |                          | degrades to warnings and the redelivered    |
 |                          | slice executes when the Brain returns       |
+| serving_crc_retry        | a weight commit rots in shm (seeded bit     |
+|                          | flip after the writer's checksum); the      |
+|                          | serving subscriber names the record, skips  |
+|                          | the generation, adopts the next clean commit|
 
 Usage:
 
@@ -521,6 +525,71 @@ def brain_outage_mid_plan(seed: int, workdir: str) -> Dict:
     return out
 
 
+def serving_crc_retry(seed: int, workdir: str) -> Dict:
+    """A weight commit rots in flight (`ckpt.shm_stage` bit flip,
+    applied AFTER the writer's checksum): the serving subscriber must
+    name the rotten record, skip that generation WITHOUT crashing,
+    keep serving its previous weights, and adopt the next clean
+    commit — the retry-next-commit contract of ISSUE 17."""
+    import numpy as np
+
+    from dlrover_tpu.common import faults
+    from dlrover_tpu.ckpt.shm_handler import ShmHandler, ShmSubscriber
+    from dlrover_tpu.ckpt.sharding import host_shard_records
+
+    out: Dict = {"scenario": "serving_crc_retry", "seed": seed}
+    faults.reset()
+    old_job = os.environ.get("DLROVER_TPU_JOB_NAME")
+    os.environ["DLROVER_TPU_JOB_NAME"] = f"chaos-scr-{seed}"
+    writer = sub = None
+    try:
+        writer = ShmHandler(0, create=True)
+        rng = np.random.default_rng(seed)
+        state = {
+            "w": rng.normal(size=(32, 16)).astype(np.float32),
+            "b": rng.normal(size=(16,)).astype(np.float32),
+        }
+        writer.save_records(1, host_shard_records(state), {})
+        sub = ShmSubscriber(0)
+        f1 = sub.poll()
+        out["adopted_step"] = f1.step if f1 is not None else -1
+        # commit 2 rots in flight: one seeded bit flips in the first
+        # chunk, after the record checksum was computed
+        faults.configure(f"ckpt.shm_stage:bit_flip:@1:{seed}")
+        writer.save_records(2, host_shard_records(state), {})
+        faults.reset()
+        f2 = sub.poll()  # must skip the rotten generation, not raise
+        out["poll_after_rot_none"] = f2 is None
+        # repolling the SAME rotten generation must not spin the
+        # counter — the subscriber waits for the next commit
+        sub.poll()
+        out["crc_retries"] = sub.crc_retries
+        out["rotten_record"] = sub.last_crc_record
+        writer.save_records(3, host_shard_records(state), {})
+        f3 = sub.poll()
+        out["recovered_step"] = f3.step if f3 is not None else -1
+        out["torn_retries"] = sub.torn_retries
+        del f1, f2, f3  # drop shm views before the mappings close
+        out["ok"] = bool(
+            out["adopted_step"] == 1
+            and out["poll_after_rot_none"]
+            and out["crc_retries"] == 1
+            and out["rotten_record"] is not None
+            and out["recovered_step"] == 3
+        )
+    finally:
+        faults.reset()
+        if sub is not None:
+            sub.close()
+        if writer is not None:
+            writer.close(unlink=True)
+        if old_job is None:
+            os.environ.pop("DLROVER_TPU_JOB_NAME", None)
+        else:
+            os.environ["DLROVER_TPU_JOB_NAME"] = old_job
+    return out
+
+
 # ---------------------------------------------------------------------------
 # registry / CLI
 # ---------------------------------------------------------------------------
@@ -529,6 +598,7 @@ SCENARIOS = {
     "sigkill_mid_step": sigkill_mid_step,
     "master_restart_mid_plan": master_restart_mid_plan,
     "brain_outage_mid_plan": brain_outage_mid_plan,
+    "serving_crc_retry": serving_crc_retry,
 }
 
 

@@ -47,6 +47,7 @@ EPISODE_PAIRS = {
     "eviction_begin": "eviction_end",
     "replay_begin": "replay_end",
     "degraded_enter": "degraded_exit",
+    "serving_begin": "serving_end",
 }
 
 _CLOSERS = ("end", "cancel")

@@ -1847,6 +1847,216 @@ def run_topology_bench(jax, results: dict, smoke: bool = False):
         topology.reset_link_model()
 
 
+# the sparse DCN shard (k int8 blocks + 4B indices at density 0.25)
+# must undercut the dense int8 shard by at least half, or the top-k
+# leg is not paying for its EF noise
+SPARSE_SYNC_DCN_WIRE_GATE = 0.5
+
+
+def run_sparse_sync_bench(jax, results: dict, smoke: bool = False):
+    """Sparse DCN gradient sync (ISSUE 18): EF-composed block top-k on
+    the two-level sync's cross-slice leg, plus the observed rail-rate
+    loop that folds realized striped-transfer throughput back into the
+    link-cost model.
+
+    Legs (emulated 2-slice mesh on the CPU backend):
+
+    - **wire math + convergence A/B**: the same run trained dense
+      two-level fp32, int8, and int8+topk(0.25). Gates: sparse DCN
+      bytes <= ``SPARSE_SYNC_DCN_WIRE_GATE`` x the int8 shard, final
+      loss within ``GRAD_SYNC_LOSS_GATE`` of the fp32 baseline (EF
+      drains the unshipped blocks — measured gap ~0.02 at 56 steps);
+    - **density-1.0 bitwise**: ``int8_topk`` at density 1.0 must
+      reproduce the dense int8 sync bit for bit (mask all-ones,
+      ``xx * 1.0`` IEEE-exact) — the sparse branch cannot drift from
+      the path it generalizes;
+    - **observed rail rates**: one striped transfer over
+      LinkModel-priced rails must fold realized GB/s into
+      ``topology.observe_rail_rate``, persist per fingerprint
+      (``topology_observed_rates_persisted``), and survive a full
+      model reset — ``get_link_model()`` after the reset reprices the
+      DCN leg from the disk snapshot (the cache round trip).
+    """
+    import jax.numpy as jnp
+    import optax
+
+    from dlrover_tpu.accel.strategy import Strategy
+    from dlrover_tpu.models import tiny
+    from dlrover_tpu.models.train import (
+        build_train_step,
+        init_sharded_state,
+        shard_batch,
+    )
+    from dlrover_tpu.parallel import topology
+    from dlrover_tpu.parallel.grad_sync import (
+        ensure_residual,
+        plan_buckets,
+        resolve_auto_compress,
+        resolve_plan,
+        sync_grads,
+        zero_residual,
+    )
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dlrover_tpu.parallel.transfer_sched import (
+        StripedTransfer,
+        TransferArbiter,
+    )
+
+    devs = list(jax.devices())
+    if len(devs) < 4:
+        results["sparse_sync_error"] = "needs >= 4 devices"
+        return
+    devs = devs[:4]
+    cache = tempfile.mkdtemp(prefix="bench_sparse_sync_")
+    env_prev = os.environ.get("DLROVER_TPU_TOPOLOGY_CACHE")
+    os.environ["DLROVER_TPU_TOPOLOGY_CACHE"] = cache
+    topology.reset_link_model()
+    try:
+        # -- leg 1: wire math + convergence A/B ----------------------
+        cfg = replace(
+            tiny(num_layers=1), dtype="float32", param_dtype="float32"
+        )
+        mc = MeshConfig(dp=4, dcn_axes=("dp",), slices=2)
+        mesh = build_mesh(mc, devices=devs)
+
+        def plan_for(compress):
+            return resolve_plan(
+                cfg,
+                Strategy(
+                    mesh=mc, dtype="float32", comm_overlap=True,
+                    grad_compress=compress, grad_bucket_mb=1,
+                    grad_topk_density=0.25,
+                ),
+            )
+
+        p_fp32, p_int8, p_topk = (
+            plan_for("none"), plan_for("int8"), plan_for("int8_topk")
+        )
+        results["grad_sync_dcn_bytes_fp32_int8_topk"] = [
+            p_fp32.dcn_bytes_twolevel(),
+            p_int8.dcn_bytes_twolevel(),
+            p_topk.dcn_bytes_twolevel(),
+        ]
+        results["grad_sync_dcn_wire_vs_int8"] = round(
+            p_topk.dcn_bytes_twolevel() / p_int8.dcn_bytes_twolevel(),
+            4,
+        )
+        results["grad_sync_dcn_density"] = round(p_topk.dcn_density, 4)
+        # the auto policy on this (fallback-priced) topology: the
+        # 90:12.5 ICI:DCN ratio crosses AUTO_TOPK_RATIO -> sparse
+        results["grad_compress_auto_mode"] = resolve_auto_compress(
+            slices=2
+        )
+
+        tx = optax.adamw(1e-2)
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        b = shard_batch({"x": x, "y": x}, mesh)
+
+        def run(compress: str) -> float:
+            state, _ = init_sharded_state(
+                jax.random.PRNGKey(0), cfg, mesh, tx
+            )
+            step = build_train_step(
+                cfg, mesh, tx, donate=False, comm_overlap=True,
+                grad_compress=compress, grad_bucket_mb=1,
+                grad_slices=2, grad_topk_density=0.25,
+            )
+            state = ensure_residual(state, plan_for(compress), mesh)
+            # 56 steps: past the EF catch-up knee (see
+            # tests/test_sparse_sync.py's measured gap-vs-steps curve)
+            for _ in range(56):
+                state, metrics = step(state, b["x"], b["y"])
+            return float(metrics["loss"])
+
+        loss_fp32 = run("none")
+        loss_topk = run("int8_topk")
+        results["sparse_sync_loss_fp32"] = round(loss_fp32, 6)
+        results["sparse_sync_loss_topk"] = round(loss_topk, 6)
+        results["sparse_sync_loss_gap"] = round(
+            abs(loss_topk - loss_fp32), 6
+        )
+
+        # -- leg 2: density-1.0 bitwise == int8 ----------------------
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        g = np.asarray(
+            rng.standard_normal((4, 4000)), dtype=np.float32
+        )
+        shapes = {"w": jax.ShapeDtypeStruct((4000,), jnp.float32)}
+        kw = dict(dp=4, slices=2, bucket_bytes=1 << 20)
+        bitwise = []
+        for compress, density in (("int8", 1.0), ("int8_topk", 1.0)):
+            plan = plan_buckets(
+                shapes, compress=compress, topk_density=density, **kw
+            )
+            sh = NamedSharding(mesh, P(plan.stack_axes))
+            stacked = {"w": jax.device_put(g, sh)}
+            synced, res, _ = jax.jit(
+                lambda t, r, p=plan: sync_grads(t, mesh, p, residual=r)
+            )(stacked, zero_residual(plan, mesh))
+            bitwise.append(
+                (
+                    np.asarray(synced["w"]).tobytes(),
+                    np.asarray(res[0]).tobytes(),
+                )
+            )
+        results["sparse_sync_density1_bitwise"] = bool(
+            bitwise[0] == bitwise[1]
+        )
+
+        # -- leg 3: observed rail rates close the pricing loop -------
+        base_dcn = topology.get_link_model(devices=devs).dcn_gbps
+        arb = TransferArbiter()
+        arb.register_rail("host_d2h", direction="d2h")
+        arb.register_rail("dcn", direction="peer")
+        src = bytearray(32 << 20)
+        dst = bytearray(32 << 20)
+
+        def mover(rail, off, ln):
+            dst[off:off + ln] = src[off:off + ln]
+
+        StripedTransfer(
+            arb, direction="d2h", chunk_bytes=4 << 20,
+            ignore_window=True,
+        ).run(mover, payload=src)
+        rates = topology.get_rail_rates()
+        fp = topology.device_fingerprint()
+        persisted = os.path.exists(topology.rail_rates_path(fp))
+        results["topology_observed_rates_persisted"] = int(
+            bool(rates and rates.gbps and persisted)
+        )
+        results["link_observed_gbps"] = {
+            k: round(v, 4) for k, v in (rates.gbps if rates else {}).items()
+        }
+        # cache round trip: drop every in-process model/rate, then
+        # get_link_model() must come back repriced from the disk
+        # snapshot rather than the fallback constant
+        topology.reset_link_model()
+        m = topology.get_link_model()
+        observed_dcn = (rates.gbps if rates else {}).get("peer")
+        results["topology_observed_pricing"] = bool(
+            observed_dcn is not None
+            and abs(m.dcn_gbps - observed_dcn) < 1e-9
+            and m.dcn_gbps != base_dcn
+        )
+        results["sparse_sync_note"] = (
+            "4-dev 2-slice emulated mesh: top-k DCN shard at density "
+            f"{results['grad_sync_dcn_density']} ships "
+            f"{results['grad_sync_dcn_wire_vs_int8']:.0%} of the int8 "
+            "shard's bytes; EF closes the loss gap to "
+            f"{results['sparse_sync_loss_gap']} by step 56; one "
+            "striped transfer reprices the DCN leg through the "
+            "persisted observed-rate EWMA"
+        )
+    finally:
+        topology.reset_link_model()
+        if env_prev is None:
+            os.environ.pop("DLROVER_TPU_TOPOLOGY_CACHE", None)
+        else:
+            os.environ["DLROVER_TPU_TOPOLOGY_CACHE"] = env_prev
+
+
 # the dp x tp explicit sync runs the same psum in the same order as
 # GSPMD's, but the partitioner makes different matmul splits inside vs
 # outside the partial-manual region — parity is float-noise-tight
@@ -3829,6 +4039,10 @@ def run_smoke() -> int:
     except Exception as e:
         results["topology_error"] = repr(e)
     try:
+        run_sparse_sync_bench(jax, results, smoke=True)
+    except Exception as e:
+        results["sparse_sync_error"] = repr(e)
+    try:
         run_hybrid_sync_bench(jax, results, smoke=True)
     except Exception as e:
         results["hybrid_sync_error"] = repr(e)
@@ -3914,6 +4128,25 @@ def run_smoke() -> int:
         and results["grad_sync_2level_wire_vs_flat"] < 1.0
         and results.get("grad_sync_2level_parity") is True
         and results.get("dry_run_priced_from_link_model") is True
+        # the sparse-sync gates (ISSUE 18): the EF-composed top-k DCN
+        # shard must halve the int8 shard's cross-slice bytes while
+        # error feedback keeps the loss inside the int8 gate, density
+        # 1.0 must be BITWISE with plain int8 (the sparse branch
+        # cannot drift from the path it generalizes), and one striped
+        # transfer must fold realized rail GB/s into the persisted
+        # observed-rate snapshot that reprices get_link_model() after
+        # a full in-process reset
+        and "sparse_sync_error" not in results
+        and results.get("grad_sync_dcn_wire_vs_int8") is not None
+        and (
+            results["grad_sync_dcn_wire_vs_int8"]
+            <= SPARSE_SYNC_DCN_WIRE_GATE
+        )
+        and results.get("sparse_sync_loss_gap") is not None
+        and results["sparse_sync_loss_gap"] <= GRAD_SYNC_LOSS_GATE
+        and results.get("sparse_sync_density1_bitwise") is True
+        and results.get("topology_observed_rates_persisted") == 1
+        and results.get("topology_observed_pricing") is True
         # the hybrid-mesh gates (ISSUE 8): the explicit path must
         # engage on dp x fsdp and dp x tp meshes (no silent GSPMD
         # fallback), fsdp fp32 must be BITWISE with GSPMD and its
@@ -4287,6 +4520,11 @@ def main() -> int:
     except Exception as e:
         results["grad_sync_2level_wire_vs_flat"] = None
         results["topology_error"] = repr(e)
+    try:
+        run_sparse_sync_bench(jax, results)
+    except Exception as e:
+        results["grad_sync_dcn_wire_vs_int8"] = None
+        results["sparse_sync_error"] = repr(e)
     try:
         run_hybrid_sync_bench(jax, results)
     except Exception as e:

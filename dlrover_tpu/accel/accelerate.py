@@ -215,6 +215,7 @@ def auto_accelerate(
             donate_inputs=True,
             comm_overlap=strategy.resolved_comm_overlap(),
             grad_compress=strategy.resolved_grad_compress(),
+            grad_topk_density=strategy.grad_topk_density,
             grad_bucket_mb=strategy.grad_bucket_mb,
             grad_slices=strategy.mesh.dp_slices(),
             batch_pad=strategy.batch_pad,

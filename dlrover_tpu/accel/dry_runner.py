@@ -187,6 +187,7 @@ def _build(
             donate_inputs=donate_inputs,
             comm_overlap=strategy.comm_overlap,
             grad_compress=strategy.grad_compress,
+            grad_topk_density=strategy.grad_topk_density,
             grad_bucket_mb=strategy.grad_bucket_mb,
             grad_slices=strategy.mesh.dp_slices(),
             batch_pad=strategy.batch_pad,

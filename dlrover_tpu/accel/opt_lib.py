@@ -137,6 +137,17 @@ register_optimization(
     ),
     tunable=True,
 )
+# measured-ratio compression policy: resolve none/int8/int8+topk per
+# mesh from the LinkModel's ICI:DCN ratio at plan time
+# (grad_sync.resolve_auto_compress); implies the explicit sync path
+register_optimization(
+    "grad_compress_auto",
+    lambda cfg, s: (
+        cfg,
+        dc_replace(s, comm_overlap=True, grad_compress="auto"),
+    ),
+    tunable=True,
+)
 # link-aware bucket sizing: grad_bucket_mb=0 means each bucket targets
 # ~topology.BUCKET_TARGET_COMM_MS of wire time on the link it actually
 # crosses (measured LinkModel; the DCN leg for multi-slice meshes)

@@ -44,10 +44,17 @@ class Strategy:
     # fall back to the GSPMD default schedule with a once-per-mesh
     # log naming the axes.
     comm_overlap: bool = False
-    # "none" | "int8": int8-quantized collective payloads with
-    # per-bucket shared scales, int32 accumulation and error feedback
-    # (implies the explicit sync path)
+    # "none" | "int8" | "int8_topk" | "auto": int8-quantized
+    # collective payloads with per-bucket shared scales, int32
+    # accumulation and error feedback (implies the explicit sync
+    # path). "int8_topk" additionally ships only the top-k
+    # highest-magnitude blocks of the cross-slice DCN shard (EF
+    # absorbs the rest); "auto" resolves per mesh from the measured
+    # ICI:DCN ratio (grad_sync.resolve_auto_compress).
     grad_compress: str = "none"
+    # requested DCN block density under int8_topk/auto (fraction of
+    # shard blocks shipped per sync; block granularity rounds up)
+    grad_topk_density: float = 0.25
     # target bucket size for the sync scheduler, MiB; 0 = auto-size
     # per link from the measured topology.LinkModel (the DCN leg on
     # multi-slice meshes, the ICI ring otherwise)
@@ -89,12 +96,17 @@ class Strategy:
             self.comm_overlap
             or "comm_overlap" in self.opts
             or "grad_compress" in self.opts
+            or "grad_compress_auto" in self.opts
         )
 
     def resolved_grad_compress(self) -> str:
-        """Effective gradient-compression mode (field or opt name)."""
+        """Effective gradient-compression mode (field or opt name).
+        May return "auto" — plan construction and the cost model
+        resolve it per mesh (grad_sync.resolve_auto_compress)."""
         if self.grad_compress != "none":
             return self.grad_compress
+        if "grad_compress_auto" in self.opts:
+            return "auto"
         return "int8" if "grad_compress" in self.opts else "none"
 
     def resolved_virtual(self) -> int:

@@ -38,19 +38,29 @@ def _metrics_path() -> str:
     )
 
 
-def atomic_write_json(path: str, payload) -> None:
+def atomic_write_json(path: str, payload, durable: bool = False) -> None:
     """Write-tmp-then-rename publish of a JSON payload, creating parent
     directories when the path has any (a bare filename has no directory
     component and ``makedirs("")`` raises). One definition for every
     metrics/config file writer — the monitors, the paral-config tuner
-    and the span heartbeat all publish through this."""
+    and the span heartbeat all publish through this.
+
+    ``durable=True`` fsyncs the tmp file before the rename so the
+    published file can never be an empty inode after a crash — use it
+    for state that must survive a restart (the observed rail-rate
+    cache). The default stays rename-only: runtime-metrics telemetry is
+    republished every few seconds, readers need atomicity only, and an
+    fsync per heartbeat would put a disk barrier on the monitor
+    cadence."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(payload, f)
-    # graftlint: disable=durable-rename reason=runtime-metrics telemetry republished every few seconds; readers need atomicity only, and an fsync per heartbeat would put a disk barrier on the monitor cadence
+        if durable:
+            f.flush()
+            os.fsync(f.fileno())
     os.replace(tmp, path)
 
 

@@ -58,6 +58,43 @@ def shard_map(
     )
 
 
+# first jax release expected to stabilize residual shardings across
+# steps on partial-manual (auto-axis) shard_map regions — the blocker
+# that forces grad compression off on dp x tp/sp/ep plans (ROADMAP
+# item 4's "once a newer jaxlib" clause, as code). Bump when an actual
+# release lands it; until then the probe answers False everywhere and
+# the gate in grad_sync._plan_for_mode stays closed.
+_AUTO_AXIS_RESIDUAL_MIN_VERSION = (0, 9)
+
+
+def supports_auto_axis_residual_shardings() -> bool:
+    """Capability probe: can the error-feedback residual live across
+    steps on a plan whose sync region leaves model axes to GSPMD
+    ("auto" axes)? On every jaxlib shipped so far the answer is no —
+    the residual's sharding is re-derived per step and AOT executables
+    are invalidated — so int8 is forced off on tp/ep meshes. The probe
+    turns that comment into code: when a jaxlib at or past
+    ``_AUTO_AXIS_RESIDUAL_MIN_VERSION`` lands, int8-on-tp auto-enables
+    without a code change here beyond the version bump.
+
+    ``DLROVER_TPU_AUTO_AXIS_RESIDUAL=1`` (or ``0``) overrides for
+    testing the enabled path on any version."""
+    forced = os.getenv("DLROVER_TPU_AUTO_AXIS_RESIDUAL", "")
+    if forced in ("1", "true"):
+        return True
+    if forced in ("0", "false"):
+        return False
+    import jax
+
+    try:
+        ver = tuple(
+            int(p) for p in jax.__version__.split(".")[:2]
+        )
+    except ValueError:
+        return False
+    return ver >= _AUTO_AXIS_RESIDUAL_MIN_VERSION
+
+
 def pcast(x, axis_names, to="varying"):
     """``lax.pcast`` (the VMA replicated→varying marker, jax >= 0.7).
 

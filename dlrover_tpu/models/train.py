@@ -227,7 +227,7 @@ def init_sharded_state(
 
 def _grad_sync_plan(
     cfg, mesh, grad_compress: str, grad_bucket_mb: int,
-    grad_slices: int = 1,
+    grad_slices: int = 1, grad_topk_density: float = 0.25,
 ):
     """BucketPlan for the explicit sync path, or None when this mesh
     keeps GSPMD's native schedule — the gate lives in ONE place
@@ -250,6 +250,7 @@ def _grad_sync_plan(
         grad_compress=grad_compress,
         grad_bucket_mb=grad_bucket_mb,
         slices=grad_slices,
+        grad_topk_density=grad_topk_density,
     )
     if plan is None:
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -272,6 +273,7 @@ def build_train_step(
     grad_bucket_mb: int = 4,
     grad_slices: int = 1,
     batch_pad: int = 0,
+    grad_topk_density: float = 0.25,
 ) -> Callable:
     """jitted (state, tokens, targets) → (state, metrics).
 
@@ -295,7 +297,10 @@ def build_train_step(
     after, a cost ``grad_accum`` amortizes like the reference amortizes
     PCIe.
 
-    ``comm_overlap`` / ``grad_compress="int8"``: route gradient sync
+    ``comm_overlap`` / ``grad_compress`` ("int8", "int8_topk" —
+    block top-k on the cross-slice DCN shard leg at
+    ``grad_topk_density`` — or "auto", resolved per mesh from the
+    measured ICI:DCN ratio): route gradient sync
     through the explicit bucketed scheduler (parallel/grad_sync.py) —
     per-bucket reduce-scatter + all-gather under ``shard_map`` on
     dp meshes (independent collectives XLA's latency-hiding scheduler
@@ -335,8 +340,9 @@ def build_train_step(
         _grad_sync_plan(
             cfg, mesh, grad_compress, grad_bucket_mb,
             grad_slices=grad_slices,
+            grad_topk_density=grad_topk_density,
         )
-        if (comm_overlap or grad_compress == "int8")
+        if (comm_overlap or grad_compress != "none")
         else None
     )
     if (
@@ -642,13 +648,16 @@ def build_train_step(
             )
             loss = jnp.mean(loss_s)
             aux = jax.tree_util.tree_map(jnp.mean, aux_s)
-        # residual present => error feedback; absent => EF-less int8
-        # (structure-preserving: the step never conjures state leaves,
-        # so AOT executables and donation stay valid — the trainer
-        # opts into EF via grad_sync.ensure_residual)
+        # residual present => error feedback; absent => EF-less
+        # compression (structure-preserving: the step never conjures
+        # state leaves, so AOT executables and donation stay valid —
+        # the trainer opts into EF via grad_sync.ensure_residual).
+        # Gate on the PLAN's resolved mode, not the request string:
+        # "auto" and downgrades (topk on a single-slice mesh) resolve
+        # at plan time.
         residual = (
             state.grad_residual
-            if grad_compress == "int8"
+            if getattr(plan, "compressed", False)
             else None
         )
         grads, new_residual, gnorm = sync_grads(

@@ -39,7 +39,12 @@ module replaces it with an explicit, schedulable sync layer:
   boundary edges, so the hottest DCN path carries ``1/per_slice_
   degree`` of the bytes. The int8 path quantizes exactly that leg
   (the link where bytes are scarcest), carrying error
-  feedback on the shard. Bucket sizes come per link from the measured
+  feedback on the shard; ``int8_topk`` goes further and ships only
+  the top-k highest-magnitude fixed-size BLOCKS of the quantized
+  shard (static k — AOT/donation-safe), with unshipped blocks riding
+  the same residual, and ``grad_compress="auto"`` picks
+  none/int8/int8+topk per leg from the measured ICI:DCN ratio
+  (``resolve_auto_compress``). Bucket sizes come per link from the measured
   ``parallel/topology.LinkModel`` when ``grad_bucket_mb`` is 0
   ("auto") instead of one global target.
 
@@ -139,6 +144,29 @@ OVERLAP_HIDDEN_FRACTION = 0.7
 # int8 payload: 1 byte/element + one fp32 scale per bucket
 _INT8_BYTES = 1
 _SCALE_BYTES = 4
+
+# block top-k sparsification of the DCN shard leg (``int8_topk``):
+# the slice-local shard is scored in fixed-size blocks and only the
+# top-k highest-|sum| blocks ship across slices (int8 values + one
+# int32 block index per block + the shared scale). A FIXED per-bucket
+# k — derived from the static shard length, never the values — keeps
+# every shape static, so AOT executables, donation and the resize
+# compile cache stay valid. Unshipped blocks ride the same
+# error-feedback residual as quantization error.
+TOPK_BLOCK = 256
+_INDEX_BYTES = 4
+
+# modes whose sync carries the error-feedback residual
+_EF_MODES = ("int8", "int8_topk")
+_COMPRESS_MODES = ("none",) + _EF_MODES
+
+# ``grad_compress="auto"`` policy: measured ICI:DCN bandwidth ratio at
+# which each mode starts paying for itself on the leg it compresses.
+# At parity (ratio ~1) compression buys nothing but EF noise; the
+# fallback LinkModel's 90:12.5 already clears both bars.
+AUTO_INT8_RATIO = 2.0
+AUTO_TOPK_RATIO = 4.0
+AUTO_TOPK_DENSITY = 0.25
 
 
 @dataclass(frozen=True)
@@ -301,7 +329,7 @@ class BucketPlan:
     leaf_shapes: Tuple[Tuple[int, ...], ...]
     leaf_dtypes: Tuple[str, ...]
     dp: int
-    compress: str  # "none" | "int8"
+    compress: str  # "none" | "int8" | "int8_topk"
     # DCN slices the dp axis spans (MeshConfig.dp_slices()); > 1
     # switches sync_grads to the two-level schedule: slice-local
     # reduce-scatter over ICI, cross-slice all-reduce of the
@@ -328,10 +356,28 @@ class BucketPlan:
     # over tp) — the reconstruction outside the manual region slices
     # each leaf's tp pieces back along this dim
     leaf_tp_dims: Tuple[Optional[int], ...] = ()
+    # -- int8_topk fields ----------------------------------------------
+    # requested fraction of DCN shard blocks shipped per sync (the k
+    # of each bucket rounds nblk * density to at least one block;
+    # ``dcn_density`` is the realized fraction)
+    topk_density: float = 1.0
+    # elements per scoring block (static — k derives from the shard
+    # LENGTH, never the values, so shapes stay AOT-stable)
+    topk_block: int = TOPK_BLOCK
 
     @property
     def num_buckets(self) -> int:
         return len(self.buckets)
+
+    @property
+    def compressed(self) -> bool:
+        """True when the sync quantizes a leg and carries the
+        error-feedback residual (int8 and int8_topk)."""
+        return self.compress in _EF_MODES
+
+    @property
+    def sparse(self) -> bool:
+        return self.compress == "int8_topk"
 
     @property
     def three_d(self) -> bool:
@@ -378,6 +424,30 @@ class BucketPlan:
         base = bucket.padded // self.fsdp
         return base // self.dp_ici if self.two_level else base
 
+    def topk_blocks(self, bucket: Bucket) -> Tuple[int, int]:
+        """(block count, shipped k) of this bucket's DCN shard under
+        int8_topk — both STATIC (derived from the shard length and the
+        plan's density, never the gradient values)."""
+        shard = self.shard_elems(bucket)
+        nblk = -(-shard // self.topk_block)
+        k = max(1, min(nblk, int(round(nblk * self.topk_density))))
+        return nblk, k
+
+    @property
+    def dcn_density(self) -> float:
+        """Realized fraction of DCN shard blocks shipped per sync
+        (1.0 on dense plans; block granularity and the >= 1-block
+        floor round the requested ``topk_density`` up)."""
+        if not self.sparse or not self.buckets:
+            return 1.0
+        shipped = 0
+        total = 0
+        for b in self.buckets:
+            nblk, k = self.topk_blocks(b)
+            shipped += k
+            total += nblk
+        return shipped / total if total else 1.0
+
     @property
     def raw_bytes(self) -> int:
         """Wire bytes of one uncompressed sync (what the monolithic
@@ -390,6 +460,16 @@ class BucketPlan:
         accounting — the ratio against ``raw_bytes`` is the
         compression win; ``explicit_wire_bytes`` is the ring-adjusted
         per-device twin)."""
+        if self.sparse:
+            # only the k shipped blocks cross DCN (int8 values + one
+            # int32 index each); the outer fp32 legs bill at padded x 4
+            return sum(
+                b.padded * 4
+                + self.topk_blocks(b)[1]
+                * (self.topk_block * _INT8_BYTES + _INDEX_BYTES)
+                + _SCALE_BYTES
+                for b in self.buckets
+            )
         if self.compress == "int8":
             if self.two_level or self.zero:
                 # only the innermost quantized leg ships int8 (the
@@ -438,14 +518,10 @@ class BucketPlan:
                 payload /= F
             if self.dp <= 1:
                 continue
-            c = (
-                _INT8_BYTES / 4.0
-                if self.compress == "int8" and not self.auto_axes
-                else 1.0
-            )
+            c = self._dcn_wire_factor(b)
             if self.auto_psum:
                 # bucketed per-bucket all-reduce (psum) over dp
-                total += 2.0 * (self.dp - 1) / self.dp * payload
+                total += 2.0 * (self.dp - 1) / self.dp * payload * c
             elif self.two_level:
                 per = self.dp_ici
                 total += 2.0 * (per - 1) / per * payload
@@ -456,6 +532,19 @@ class BucketPlan:
             else:
                 total += 2.0 * (self.dp - 1) / self.dp * payload * c
         return int(total)
+
+    def _dcn_wire_factor(self, b: Bucket) -> float:
+        """Bytes shipped per fp32 byte on this bucket's compressed
+        leg (the ``c`` of the ring accounting): 1/4 under int8, the
+        realized block density (int8 values + one int32 index per
+        block) under int8_topk, 1.0 dense."""
+        if self.compress == "int8":
+            return _INT8_BYTES / 4.0
+        if self.sparse:
+            nblk, k = self.topk_blocks(b)
+            per_block = self.topk_block * _INT8_BYTES + _INDEX_BYTES
+            return (k * per_block) / (nblk * self.topk_block * 4.0)
+        return 1.0
 
     # -- cross-slice (DCN) accounting: totals over all devices/sync ----
     def dcn_bytes_flat(self) -> int:
@@ -479,9 +568,13 @@ class BucketPlan:
         """Cross-slice bytes the two-level schedule moves per sync:
         every device all-reduces only its slice-local shard (of the
         fsdp chunk, on ZeRO plans) across slices (ring factor
-        2(S-1)/S), int8-compressed when the plan compresses."""
+        2(S-1)/S), int8-compressed when the plan compresses and
+        block-sparse on top under int8_topk
+        (``dcn_bytes_sparse``)."""
         if not self.two_level:
             return 0
+        if self.sparse:
+            return self.dcn_bytes_sparse()
         S = self.slices
         per_elem = (
             _INT8_BYTES if self.compress == "int8" else 4
@@ -495,11 +588,35 @@ class BucketPlan:
             total += int(per_dev * self.total)
         return total
 
+    def dcn_bytes_sparse(self) -> int:
+        """Cross-slice bytes of the int8_topk schedule per sync: each
+        device ships its k top blocks (int8 values + one int32 block
+        index each) plus the shared fp32 scale at the same 2(S-1)/S
+        ring factor. The return path may carry up to the UNION of the
+        participants' block sets; the ring accounting here prices the
+        per-device contribution, the same convention every other
+        accounting method uses."""
+        if not self.two_level or not self.sparse:
+            return 0
+        S = self.slices
+        total = 0
+        for b in self.buckets:
+            nblk, k = self.topk_blocks(b)
+            payload = k * (
+                self.topk_block * _INT8_BYTES + _INDEX_BYTES
+            )
+            per_dev = 2.0 * (S - 1) / S * payload + _SCALE_BYTES
+            total += int(per_dev * self.total)
+        return total
+
     def describe(self) -> str:
+        dens = (
+            f" at density {self.dcn_density:.2f}" if self.sparse else ""
+        )
         lvl = (
             f", two-level over {self.slices} slices "
             f"(dcn {self.dcn_bytes_twolevel() >> 20} MiB vs flat "
-            f"{self.dcn_bytes_flat() >> 20} MiB/sync)"
+            f"{self.dcn_bytes_flat() >> 20} MiB/sync{dens})"
             if self.two_level
             else ""
         )
@@ -540,6 +657,8 @@ def plan_buckets(
     kind: str = "",
     tp: int = 1,
     leaf_tp_dims: Tuple[Optional[int], ...] = (),
+    topk_density: float = 1.0,
+    topk_block: int = TOPK_BLOCK,
 ) -> BucketPlan:
     """Greedy size-targeted partition of the grad tree (leaf order =
     tree flatten order, which matches the order backward produces
@@ -556,10 +675,11 @@ def plan_buckets(
     """
     import jax
 
-    if compress not in ("none", "int8"):
+    if compress not in _COMPRESS_MODES:
         raise ValueError(
             f"unknown grad compression {compress!r} "
-            "(expected 'none' or 'int8')"
+            "(expected 'none', 'int8' or 'int8_topk'; 'auto' must be "
+            "resolved upstream — resolve_auto_compress)"
         )
     if dp < 1 or fsdp < 1:
         raise ValueError(f"dp/fsdp must be >= 1, got {dp}/{fsdp}")
@@ -567,11 +687,32 @@ def plan_buckets(
         raise ValueError(
             f"slices={slices} must divide dp={dp} (and be >= 1)"
         )
+    if compress == "int8_topk":
+        if slices <= 1:
+            raise ValueError(
+                "int8_topk sparsifies the cross-slice DCN leg; a "
+                "single-slice plan has no such leg (use 'int8')"
+            )
+        if not (0.0 < topk_density <= 1.0):
+            raise ValueError(
+                f"topk_density must be in (0, 1], got {topk_density}"
+            )
+        if topk_block < 1:
+            raise ValueError(
+                f"topk_block must be >= 1, got {topk_block}"
+            )
     if auto_axes and compress != "none":
-        raise ValueError(
-            "model-sharded plans (dp x tp/sp/ep, 3d) do not support "
-            "int8 compression (the residual would cross GSPMD axes)"
+        from dlrover_tpu.common.jax_compat import (
+            supports_auto_axis_residual_shardings,
         )
+
+        if not supports_auto_axis_residual_shardings():
+            raise ValueError(
+                "model-sharded plans (dp x tp/sp/ep, 3d) do not "
+                "support int8 compression on this jaxlib (the "
+                "residual would cross GSPMD axes with unstable "
+                "auto-axis shardings)"
+            )
     if auto_axes and fsdp > 1 and kind != "3d":
         raise ValueError(
             "a dp x tp/sp plan supports no fsdp leg (only the fully-"
@@ -634,6 +775,8 @@ def plan_buckets(
         kind=kind,
         tp=tp,
         leaf_tp_dims=tuple(leaf_tp_dims),
+        topk_density=float(topk_density),
+        topk_block=int(topk_block),
     )
 
 
@@ -665,6 +808,45 @@ def note_gspmd_fallback(axis_sizes: dict, reason: str = "") -> None:
     )
 
 
+def resolve_auto_compress(
+    slices: int = 1,
+    whole_dcn: bool = False,
+    auto_axes: Tuple[str, ...] = (),
+    link_model=None,
+) -> str:
+    """Concrete compression mode for ``grad_compress="auto"``: pick
+    none / int8 / int8+topk for the dp sync from the measured ICI:DCN
+    bandwidth ratio (observed rail rates fold into the model, so the
+    policy tracks what the links actually deliver):
+
+    - model-sharded plans (``auto_axes``): "none" — the residual gate
+      (``supports_auto_axis_residual_shardings``) owns that decision;
+    - hybrid dp axis (``slices > 1``): the DCN shard leg exists —
+      sparsify it (int8+topk) when DCN is severely outmatched
+      (ratio >= ``AUTO_TOPK_RATIO``), quantize it at
+      ``AUTO_INT8_RATIO``, ship fp32 near parity;
+    - a dp axis WHOLE on DCN (``whole_dcn``): the flat ring rides DCN
+      end to end — int8 compresses the whole ring (there is no
+      two-level shard to sparsify);
+    - pure-ICI meshes: "none" (wire is cheap; EF noise is not free).
+    """
+    from dlrover_tpu.parallel import topology
+
+    if auto_axes:
+        return "none"
+    model = link_model or topology.get_link_model()
+    ratio = model.ici_gbps / max(model.dcn_gbps, 1e-9)
+    if slices > 1:
+        if ratio >= AUTO_TOPK_RATIO:
+            return "int8_topk"
+        if ratio >= AUTO_INT8_RATIO:
+            return "int8"
+        return "none"
+    if whole_dcn and ratio >= AUTO_INT8_RATIO:
+        return "int8"
+    return "none"
+
+
 def resolve_bucket_bytes(
     grad_bucket_mb: int,
     dp: int = 1,
@@ -672,6 +854,7 @@ def resolve_bucket_bytes(
     compress: str = "none",
     link_model=None,
     fsdp: int = 1,
+    topk_density: float = 1.0,
 ) -> int:
     """Bucket-size target in bytes. ``grad_bucket_mb > 0`` is the
     explicit global knob (historical behavior). ``0`` means **auto**:
@@ -688,9 +871,16 @@ def resolve_bucket_bytes(
     topology.note_fallback_use(model)
     if slices > 1:
         dcn_payload = topology.bucket_bytes_for(model, "dcn")
-        scale = (dp // slices) * fsdp
+        scale = float((dp // slices) * fsdp)
         if compress == "int8":
             scale *= 4  # the DCN shard ships int8, the target is fp32
+        elif compress == "int8_topk":
+            # the DCN shard ships k/nblk blocks of int8 (+indices) —
+            # the full-bucket target scales back up by the inverse
+            density = max(float(topk_density), 1e-3)
+            scale *= 4.0 / (
+                density * (1.0 + _INDEX_BYTES / float(TOPK_BLOCK))
+            )
         b = dcn_payload * scale
     else:
         b = topology.bucket_bytes_for(model, "ici")
@@ -768,10 +958,18 @@ def _localize_tp(params_shape, tp: int, cfg):
     return _localize_axis(params_shape, tp, cfg, "tp")
 
 
+# once-per-process visibility for the model-sharded compression gate
+# (the capability probe keeps it closed on today's jaxlib; a noisy
+# per-plan log would drown candidate search)
+_MODEL_SHARD_COMPRESS_LOGGED = False
+
+
 def _plan_for_mode(
     cfg, mode: SyncMode, grad_compress: str, grad_bucket_mb: int,
     params_shape=None, slices: int = 1,
+    topk_density: float = AUTO_TOPK_DENSITY, whole_dcn: bool = False,
 ) -> BucketPlan:
+    global _MODEL_SHARD_COMPRESS_LOGGED
     if params_shape is None:
         import jax
 
@@ -780,18 +978,39 @@ def _plan_for_mode(
         params_shape = jax.eval_shape(
             lambda: init_params(jax.random.PRNGKey(0), cfg)
         )
+    if grad_compress == "auto":
+        grad_compress = resolve_auto_compress(
+            slices=slices if mode.kind != "tp" else 1,
+            whole_dcn=whole_dcn,
+            auto_axes=mode.auto_axes,
+        )
     if mode.kind in ("tp", "ep", "3d") and grad_compress != "none":
-        # the residual would inherit unstable auto-axis shardings
-        # across steps (invalidating AOT executables); run the
-        # explicit path uncompressed instead of falling back entirely
+        from dlrover_tpu.common.jax_compat import (
+            supports_auto_axis_residual_shardings,
+        )
         from dlrover_tpu.common.log import default_logger as logger
 
-        logger.info(
-            f"grad_sync: int8 compression is not supported on "
-            f"model-sharded ({mode.kind}) meshes; running the "
-            f"explicit bucketed sync at fp32"
-        )
-        grad_compress = "none"
+        if mode.kind != "3d" and supports_auto_axis_residual_shardings():
+            # a jaxlib with stable auto-axis residual shardings can
+            # carry EF state across steps on the partial-manual psum
+            # paths; only flat int8 applies there (tp/ep plans force
+            # slices=1, so there is no DCN shard leg to sparsify)
+            grad_compress = "int8"
+        else:
+            # the residual would inherit unstable auto-axis shardings
+            # across steps (invalidating AOT executables); run the
+            # explicit path uncompressed instead of falling back
+            # entirely
+            if not _MODEL_SHARD_COMPRESS_LOGGED:
+                _MODEL_SHARD_COMPRESS_LOGGED = True
+                logger.info(
+                    f"grad_sync: int8 compression is not supported "
+                    f"on model-sharded ({mode.kind}) meshes on this "
+                    f"jaxlib (supports_auto_axis_residual_shardings "
+                    f"= False); running the explicit bucketed sync "
+                    f"at fp32"
+                )
+            grad_compress = "none"
     if mode.kind == "ep":
         # the fully-manual (dp, ep) path has its own split plan
         # (ep-local expert leaves + dense leaves)
@@ -805,6 +1024,9 @@ def _plan_for_mode(
         # describe()/dcn accounting, and break the legs probe
         slices = 1
     slices = slices if 1 < slices < mode.dp else 1
+    if grad_compress == "int8_topk" and slices <= 1:
+        # no cross-slice DCN leg to sparsify — quantization still pays
+        grad_compress = "int8"
     kind = mode.kind
     leaf_tp_dims: Tuple[Optional[int], ...] = ()
     tp = 1
@@ -824,6 +1046,7 @@ def _plan_for_mode(
         bucket_bytes=resolve_bucket_bytes(
             grad_bucket_mb, dp=mode.dp, slices=slices,
             compress=grad_compress, fsdp=mode.fsdp,
+            topk_density=topk_density,
         ),
         compress=grad_compress,
         slices=slices,
@@ -833,6 +1056,8 @@ def _plan_for_mode(
         kind=kind,
         tp=tp,
         leaf_tp_dims=leaf_tp_dims,
+        topk_density=topk_density,
+        topk_block=TOPK_BLOCK,
     )
 
 
@@ -843,6 +1068,7 @@ def plan_for_mesh(
     grad_bucket_mb: int = 4,
     params_shape: Optional[Any] = None,
     slices: int = 1,
+    grad_topk_density: float = AUTO_TOPK_DENSITY,
 ) -> Optional[BucketPlan]:
     """Gate + plan from a concrete ``jax.sharding.Mesh`` (the step
     builder's view — same gate and bucket construction as
@@ -865,7 +1091,7 @@ def plan_for_mesh(
         )
     return _plan_for_mode(
         cfg, mode, grad_compress, grad_bucket_mb, params_shape,
-        slices=slices,
+        slices=slices, topk_density=grad_topk_density,
     )
 
 
@@ -916,13 +1142,18 @@ def resolve_plan(
             schedule=strategy.resolved_pp_schedule(),
             virtual=strategy.resolved_virtual(),
         )
+    slices = strategy.mesh.dp_slices()
     return _plan_for_mode(
         cfg,
         mode,
         strategy.resolved_grad_compress(),
         strategy.grad_bucket_mb,
         params_shape,
-        slices=strategy.mesh.dp_slices(),
+        slices=slices,
+        topk_density=getattr(
+            strategy, "grad_topk_density", AUTO_TOPK_DENSITY
+        ),
+        whole_dcn=("dp" in strategy.mesh.dcn_axes and slices <= 1),
     )
 
 
@@ -1265,6 +1496,31 @@ def _slice_groups(dp: int, slices: int) -> Tuple[list, list]:
     return ici, dcn
 
 
+def _topk_block_mask(xx, density: float, block: int):
+    """0/1 mask over ``xx`` keeping the k highest-|sum| fixed-size
+    blocks. k derives from the STATIC length and density (the same
+    formula as ``BucketPlan.topk_blocks``), never the values, so
+    shapes stay AOT/donation-stable; density 1.0 returns all-ones and
+    the caller's math reduces bitwise to the dense int8 path."""
+    import jax
+    import jax.numpy as jnp
+
+    n = int(xx.shape[0])
+    nblk = -(-n // block)
+    k = max(1, min(nblk, int(round(nblk * density))))
+    if k >= nblk:
+        return jnp.ones_like(xx)
+    pad = nblk * block - n
+    xp = jnp.pad(xx, (0, pad)) if pad else xx
+    score = jnp.sum(jnp.abs(xp.reshape(nblk, block)), axis=1)
+    _, idx = jax.lax.top_k(score, k)
+    blk = jnp.zeros((nblk,), jnp.float32).at[idx].set(1.0)
+    mask = jnp.repeat(
+        blk, block, total_repeat_length=nblk * block
+    )
+    return mask[:n] if pad else mask
+
+
 def _dp_leg_2level(x, residual, plan: "BucketPlan", legs: str = "all"):
     """Two-level dp-axis sync of one per-device vector (a full bucket
     on pure-dp plans, the fsdp chunk on ZeRO plans) for a hybrid dp
@@ -1297,6 +1553,36 @@ def _dp_leg_2level(x, residual, plan: "BucketPlan", legs: str = "all"):
     new_residual = residual
     if legs == "ici":
         total = shard
+    elif plan.compress == "int8_topk":
+        # block top-k on the DCN leg: score the EF-corrected shard in
+        # fixed blocks, keep the k largest, quantize the kept values
+        # to int8 at one shared scale and ship ONLY those across
+        # slices. Each DCN participant selects its own blocks (the
+        # slice-local sums differ), so the int32 sum realizes the
+        # union of the selections; everything a device did NOT ship —
+        # masked blocks and quantization error alike — lands in the
+        # residual via the single ``xx - decoded`` subtraction and
+        # re-enters next step. The mask cost never touches the wire:
+        # only the masked-quantized shard crosses DCN, billed by
+        # ``dcn_bytes_sparse``.
+        xx = shard + residual if residual is not None else shard
+        mask = _topk_block_mask(
+            xx, plan.topk_density, plan.topk_block
+        )
+        xm = xx * mask
+        # shared scale over the KEPT values (pmax, one fp32 on the
+        # wire); at density 1.0 xm == xx bitwise and this whole
+        # branch reproduces the dense int8 leg exactly
+        scale = jax.lax.pmax(
+            jnp.max(jnp.abs(xm)), plan.stack_axes
+        ) / 127.0
+        scale = jnp.maximum(scale, jnp.float32(1e-20))
+        q = jnp.clip(jnp.round(xm / scale), -127, 127).astype(jnp.int8)
+        new_residual = xx - q.astype(jnp.float32) * scale
+        summed = jax.lax.psum(
+            q.astype(jnp.int32), "dp", axis_index_groups=dcn_groups
+        )
+        total = summed.astype(jnp.float32) * scale
     elif plan.compress == "int8":
         xx = shard + residual if residual is not None else shard
         # ONE shared scale across all participants (pmax): every DCN
@@ -1395,7 +1681,26 @@ def _sync_one_bucket(
             x, "fsdp", scatter_dimension=0, tiled=True
         )
     if plan.auto_psum:
-        full, new_residual = jax.lax.psum(x, "dp"), residual
+        if plan.compressed:
+            # only reachable when supports_auto_axis_residual_
+            # shardings() passes (plan construction forces "none"
+            # otherwise): the bucketed psum ships int8 at a shared
+            # scale with the same EF construction as the flat path
+            xx = x + residual if residual is not None else x
+            scale = jax.lax.pmax(
+                jnp.max(jnp.abs(xx)), plan.stack_axes
+            ) / 127.0
+            scale = jnp.maximum(scale, jnp.float32(1e-20))
+            q = jnp.clip(
+                jnp.round(xx / scale), -127, 127
+            ).astype(jnp.int8)
+            new_residual = xx - q.astype(jnp.float32) * scale
+            full = (
+                jax.lax.psum(q.astype(jnp.int32), "dp")
+                .astype(jnp.float32) * scale
+            )
+        else:
+            full, new_residual = jax.lax.psum(x, "dp"), residual
     elif plan.two_level:
         full, new_residual = _dp_leg_2level(x, residual, plan, legs)
     else:
@@ -1443,7 +1748,7 @@ def sync_grads(
     if plan.three_d:
         return _sync_grads_3d(stacked_grads, mesh, plan)
     leaves, treedef = jax.tree_util.tree_flatten(stacked_grads)
-    ef = plan.compress == "int8" and residual is not None
+    ef = plan.compressed and residual is not None
     res_in = tuple(residual) if ef else ()
 
     def body(leaves_in, res_in):
@@ -1649,7 +1954,7 @@ def ensure_residual(state, plan: Optional[BucketPlan], mesh):
     shapes, and dropping it costs one EF-less step, not correctness."""
     from dataclasses import replace as dc_replace
 
-    if plan is None or plan.compress != "int8":
+    if plan is None or getattr(plan, "compress", "none") not in _EF_MODES:
         return state
     if getattr(state, "grad_residual", None) is not None:
         return state
@@ -1664,6 +1969,38 @@ def strip_residual(state):
     if getattr(state, "grad_residual", None) is None:
         return state
     return dc_replace(state, grad_residual=None)
+
+
+# -- observability ----------------------------------------------------------
+
+_COMPRESS_MODE_CODES = {"none": 0.0, "int8": 1.0, "int8_topk": 2.0}
+
+
+def export_compress_metrics(plan, registry=None) -> None:
+    """Gauges for the resolved compression mode and the realized DCN
+    block density (docs/observability.md). ``plan`` may be None (the
+    GSPMD fallback) or any plan flavor — PP/EP plans never compress
+    and report density 1."""
+    if registry is None:
+        from dlrover_tpu.obs.metrics import default_registry
+
+        registry = default_registry()
+    mode = (
+        getattr(plan, "compress", "none") if plan is not None else "none"
+    )
+    density = (
+        getattr(plan, "dcn_density", 1.0) if plan is not None else 1.0
+    )
+    registry.gauge(
+        "dlrover_grad_compress_mode",
+        "resolved gradient compression mode "
+        "(0=none, 1=int8, 2=int8_topk; parallel/grad_sync.py)",
+    ).set(_COMPRESS_MODE_CODES.get(mode, 0.0))
+    registry.gauge(
+        "dlrover_grad_sync_dcn_density",
+        "realized fraction of DCN shard blocks shipped per sync "
+        "(1.0 = dense; parallel/grad_sync.py)",
+    ).set(float(density))
 
 
 # -- cost model / measurement ----------------------------------------------
@@ -1702,10 +2039,17 @@ def comm_bytes_per_device(
         # per stage too; the explicit path's win is the bubble
         # overlap, priced by the dry-runner, not fewer bytes)
         payload /= m.pp
-    if compress is None:
-        compress = strategy.resolved_grad_compress()
     mode = resolve_sync_mode(m.axis_sizes())
     explicit = mode is not None and strategy.resolved_comm_overlap()
+    if compress is None:
+        compress = strategy.resolved_grad_compress()
+    if compress == "auto":
+        slices = m.dp_slices()
+        compress = resolve_auto_compress(
+            slices=slices,
+            whole_dcn=("dp" in m.dcn_axes and slices <= 1),
+            auto_axes=mode.auto_axes if mode else (),
+        )
     if explicit and mode.kind in ("tp", "ep"):
         ring = 2.0 * (mode.dp - 1) / mode.dp
         # tp shards every param ~1/model_shard; ep shards only the
@@ -1713,7 +2057,11 @@ def comm_bytes_per_device(
         # whole (ep modes carry model_shard=1)
         return ring * payload / mode.model_shard  # never compressed
     c = 1.0
-    if compress == "int8":
+    if compress in _EF_MODES:
+        # per-device wire factor of the compressed leg: 1 byte per
+        # fp32 element; top-k only further shrinks the DCN leg, which
+        # this total-bytes view does not itemize (the per-link twin,
+        # comm_time_per_device_s, prices the density)
         c = _INT8_BYTES / float(grad_itemsize)
     if explicit and mode.kind in ("zero", "3d"):
         F = mode.fsdp
@@ -1775,16 +2123,43 @@ def comm_time_per_device_s(
         return 0.0
     model = link_model or topology.get_link_model()
     topology.note_fallback_use(model)
-    if compress is None:
-        compress = strategy.resolved_grad_compress()
     payload = float(n_param_bytes)
     if m.pp > 1:
         payload /= m.pp  # stage-sharded grads under either schedule
+    slices = m.dp_slices()
+    if compress is None:
+        compress = strategy.resolved_grad_compress()
+    if compress == "auto":
+        sizes0 = m.axis_sizes()
+        mode0 = resolve_sync_mode(sizes0)
+        compress = resolve_auto_compress(
+            slices=slices,
+            whole_dcn=("dp" in m.dcn_axes and slices <= 1),
+            auto_axes=mode0.auto_axes if mode0 else (),
+            link_model=model,
+        )
+    if compress == "int8_topk" and slices <= 1:
+        compress = "int8"  # plan construction downgrades the same way
     if compress == "int8":
         c = _INT8_BYTES / float(grad_itemsize)
+    elif compress == "int8_topk":
+        # the DCN shard ships k/nblk int8 blocks plus indices — the
+        # compressed-leg byte factor scales by the requested density
+        density = max(
+            float(
+                getattr(
+                    strategy, "grad_topk_density", AUTO_TOPK_DENSITY
+                )
+            ),
+            1e-3,
+        )
+        c = (
+            density
+            * (_INT8_BYTES + _INDEX_BYTES / float(TOPK_BLOCK))
+            / float(grad_itemsize)
+        )
     else:
         c = 1.0
-    slices = m.dp_slices()
     # same gate as the step builder: the explicit schedule only runs
     # when comm_overlap resolved on AND the mesh qualifies
     # (resolve_sync_mode) — a comm_overlap=False hybrid mesh runs
@@ -1937,7 +2312,7 @@ def _measure_sync(
         for i, dt in enumerate(plan.leaf_dtypes)
     ]
     res = (
-        zero_residual(plan, mesh) if plan.compress == "int8" else None
+        zero_residual(plan, mesh) if plan.compressed else None
     )
 
     def run(tree, r):

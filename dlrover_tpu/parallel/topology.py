@@ -231,6 +231,234 @@ def save_cache(
         return None
 
 
+# -- observed rail rates ------------------------------------------------------
+
+# EWMA weight of one NEW realized-rate sample: a changed link settles
+# in ~3 stripes without letting a single anomalous transfer (page-cache
+# hit, one congested instant) own the price
+RAIL_RATE_EWMA_WEIGHT = 0.3
+# a transfer smaller than this prices latency, not bandwidth — the
+# striper's fold skips rails that moved less
+RAIL_RATE_MIN_BYTES = 1 << 20
+
+# observed-rate key ("rail direction") -> the LinkModel field it
+# overrides; the same vocabulary rail_link_gbps prices by
+_RAIL_RATE_FIELDS = {
+    "d2h": "host_d2h_gbps",
+    "h2d": "host_h2d_gbps",
+    "peer": "dcn_gbps",
+}
+
+
+@dataclass
+class ObservedRailRates:
+    """Realized per-rail throughput (GB/s), EWMA-folded from finished
+    striped transfers and persisted next to the probed ``LinkModel``
+    cache under the same device fingerprint. The startup probe measures
+    each link once with a synthetic payload; these numbers come from
+    the job's actual traffic — ``get_link_model`` overlays them onto
+    whatever model it returns, so bucket auto-sizing, stripe shares,
+    arbiter pricing and the dry-runner's est_step_s track the link the
+    job really has, not the link it had at startup. Keys are rail
+    directions (``"d2h"`` | ``"h2d"`` | ``"peer"``)."""
+
+    fingerprint: str = ""
+    gbps: Dict[str, float] = field(default_factory=dict)
+    samples: Dict[str, int] = field(default_factory=dict)
+    updated_at: float = 0.0
+
+    def to_payload(self) -> dict:
+        return {
+            "fingerprint": self.fingerprint,
+            "gbps": {k: float(v) for k, v in self.gbps.items()},
+            "samples": {k: int(v) for k, v in self.samples.items()},
+            "updated_at": float(self.updated_at),
+        }
+
+    @staticmethod
+    def from_payload(d: dict) -> "ObservedRailRates":
+        return ObservedRailRates(
+            fingerprint=str(d["fingerprint"]),
+            gbps={
+                str(k): float(v) for k, v in dict(d["gbps"]).items()
+            },
+            samples={
+                str(k): int(v)
+                for k, v in dict(d.get("samples", {})).items()
+            },
+            updated_at=float(d.get("updated_at", 0.0)),
+        )
+
+
+_OBSERVED: Optional[ObservedRailRates] = None
+# fingerprints whose disk file this process already looked for — the
+# overlay rides every get_link_model() call, which must stay a dict
+# lookup, not a stat() per pricing query
+_OBS_DISK_CHECKED: set = set()
+
+
+def rail_rates_path(
+    fingerprint: str, dir_override: Optional[str] = None
+) -> str:
+    return os.path.join(
+        cache_dir(dir_override), f"railrates-{fingerprint}.json"
+    )
+
+
+def load_rail_rates(
+    fingerprint: Optional[str] = None,
+    dir_override: Optional[str] = None,
+) -> Optional[ObservedRailRates]:
+    if fingerprint is None:
+        try:
+            fingerprint = device_fingerprint()
+        except Exception:  # no backend yet (early import paths)
+            return None
+    try:
+        with open(rail_rates_path(fingerprint, dir_override)) as f:
+            rates = ObservedRailRates.from_payload(json.load(f))
+    except (OSError, ValueError, TypeError, KeyError):
+        return None
+    if rates.fingerprint != fingerprint:
+        return None  # stale file copied across worlds
+    return rates
+
+
+def save_rail_rates(
+    rates: ObservedRailRates, dir_override: Optional[str] = None
+) -> Optional[str]:
+    """Durable persist (fsync-before-rename: the EWMA is long-lived
+    state a crash should not tear). Best-effort all the same — a
+    read-only cache dir must never take down the transfer that fed the
+    sample; the EWMA just stays process-local."""
+    path = rail_rates_path(rates.fingerprint, dir_override)
+    try:
+        from dlrover_tpu.agent.monitor import atomic_write_json
+
+        atomic_write_json(path, rates.to_payload(), durable=True)
+        return path
+    except OSError as e:
+        logger.warning(f"observed rail-rate cache write failed: {e!r}")
+        return None
+
+
+def set_rail_rates(rates: Optional[ObservedRailRates]) -> None:
+    """Install an observed-rates snapshot as the process-current one
+    (tests/bench; ``observe_rail_rate`` maintains it in production)."""
+    global _OBSERVED
+    _OBSERVED = rates
+
+
+def reset_rail_rates() -> None:
+    global _OBSERVED
+    _OBSERVED = None
+    _OBS_DISK_CHECKED.clear()
+
+
+def _observed_for(
+    fp: str, dir_override: Optional[str] = None
+) -> Optional[ObservedRailRates]:
+    """The observed-rates snapshot applicable to ``fp``: the in-process
+    one when its fingerprint matches (or either side has none), else a
+    one-time disk probe per fingerprint."""
+    global _OBSERVED
+    obs = _OBSERVED
+    if obs is not None and (
+        not fp or not obs.fingerprint or obs.fingerprint == fp
+    ):
+        return obs
+    if fp and fp not in _OBS_DISK_CHECKED:
+        _OBS_DISK_CHECKED.add(fp)
+        disk = load_rail_rates(fp, dir_override)
+        if disk is not None:
+            if _OBSERVED is None:
+                _OBSERVED = disk
+            return disk
+    return None
+
+
+def get_rail_rates(
+    devices=None, dir_override: Optional[str] = None
+) -> Optional[ObservedRailRates]:
+    """Process-current observed rates for this device world, else the
+    disk cache, else None. Never measures — samples arrive only from
+    real transfers through ``observe_rail_rate``."""
+    try:
+        fp = device_fingerprint(devices)
+    except Exception:
+        fp = ""
+    return _observed_for(fp, dir_override)
+
+
+def observe_rail_rate(
+    rail: str,
+    gbps: float,
+    devices=None,
+    dir_override: Optional[str] = None,
+) -> Optional[ObservedRailRates]:
+    """Fold one realized-throughput sample (GB/s over a finished
+    transfer of at least ``RAIL_RATE_MIN_BYTES``) into the per-rail
+    EWMA, persist the snapshot, and export the gauge. ``rail`` is a
+    direction key from ``_RAIL_RATE_FIELDS``; anything else (a custom
+    bench rail with no LinkModel leg) is ignored."""
+    global _OBSERVED
+    if rail not in _RAIL_RATE_FIELDS or not gbps > 0.0:
+        return _OBSERVED
+    try:
+        fp = device_fingerprint(devices)
+    except Exception:
+        fp = ""
+    obs = _observed_for(fp, dir_override)
+    if obs is None:
+        obs = ObservedRailRates(fingerprint=fp)
+    prev = obs.gbps.get(rail)
+    if prev is None:
+        new = float(gbps)
+    else:
+        w = RAIL_RATE_EWMA_WEIGHT
+        new = (1.0 - w) * prev + w * float(gbps)
+    obs.gbps[rail] = new
+    obs.samples[rail] = obs.samples.get(rail, 0) + 1
+    obs.updated_at = time.time()
+    _OBSERVED = obs
+    save_rail_rates(obs, dir_override)
+    export_rail_rate_metrics(obs)
+    return obs
+
+
+def apply_observed_rates(
+    model: LinkModel, rates: ObservedRailRates
+) -> LinkModel:
+    """``model`` with every observed rail rate overriding the probed
+    (or fallback) figure for its leg. Latency and ICI stay as probed —
+    the striper only ever realizes host/DCN legs."""
+    kw = {}
+    for rail, gbps in rates.gbps.items():
+        fld = _RAIL_RATE_FIELDS.get(rail)
+        if fld is not None and gbps > 0.0:
+            kw[fld] = float(gbps)
+    return dc_replace(model, **kw) if kw else model
+
+
+def export_rail_rate_metrics(
+    rates: ObservedRailRates, registry=None
+) -> None:
+    """``dlrover_link_observed_gbps{rail}`` gauges
+    (docs/observability.md)."""
+    if registry is None:
+        from dlrover_tpu.obs.metrics import default_registry
+
+        registry = default_registry()
+    g = registry.gauge(
+        "dlrover_link_observed_gbps",
+        "EWMA realized rail throughput from striped transfers "
+        "(parallel/topology.py)",
+        labelnames=("rail",),
+    )
+    for rail, gbps in rates.gbps.items():
+        g.labels(rail).set(float(gbps))
+
+
 # -- measurement -------------------------------------------------------------
 
 
@@ -427,19 +655,28 @@ def get_link_model(
     probe cache for the fingerprint, else the documented fallback
     constants. NEVER probes — probing is an explicit startup/bench
     action (``probe_link_model``); estimation paths must stay cheap
-    and deterministic."""
+    and deterministic.
+
+    Observed rail rates (``observe_rail_rate`` — realized throughput
+    from the job's own striped transfers) overlay the result AFTER the
+    memo lookup, so a sample folded mid-run reprices every consumer on
+    its next query without invalidating the cached probe."""
     try:
         fp = device_fingerprint(devices)
     except Exception:  # no backend yet (early import paths)
         fp = ""
     if fp in _MEMO:
-        return _MEMO[fp]
-    if _CURRENT is not None:
-        return _CURRENT
-    model = load_cached(fp, cache_dir) if fp else None
-    if model is None:
-        model = fallback_link_model(fp, source="fallback")
-    _MEMO[fp] = model
+        model = _MEMO[fp]
+    elif _CURRENT is not None:
+        model = _CURRENT
+    else:
+        model = load_cached(fp, cache_dir) if fp else None
+        if model is None:
+            model = fallback_link_model(fp, source="fallback")
+        _MEMO[fp] = model
+    obs = _observed_for(fp, cache_dir)
+    if obs is not None and obs.gbps:
+        model = apply_observed_rates(model, obs)
     return model
 
 
@@ -457,6 +694,10 @@ def reset_link_model() -> None:
     _MEMO.clear()
     _CURRENT = None
     _FALLBACK_WARNED = False
+    # observed rail rates overlay whatever get_link_model returns, so a
+    # full model reset (tests/bench teardown) must drop them too or the
+    # "pristine" fallback would come back pre-overlaid
+    reset_rail_rates()
 
 
 def note_fallback_use(model: LinkModel) -> None:

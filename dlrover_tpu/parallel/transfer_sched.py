@@ -580,14 +580,42 @@ class TransferArbiter:
 
     def note_stripe(self, report: "StripeReport") -> None:
         """Fold a finished stripe's per-rail chunk counts and balance
-        into the rail gauges."""
+        into the rail gauges, and its realized per-rail throughput into
+        the topology's observed-rate EWMA (``observe_rail_rate``) —
+        every production stripe is a free bandwidth measurement, so the
+        cost model tracks the link the job has instead of the one it
+        probed at startup."""
+        from dlrover_tpu.parallel import topology
+
+        folds: List[Tuple[str, float]] = []
         with self._cond:
             for name, n in report.rail_chunks.items():
                 r = self._rails.get(name)
                 if r is not None:
                     r.stripe_chunks += int(n)
             self._last_stripe_balance = float(report.balance)
+            for name, nbytes in report.rail_bytes.items():
+                r = self._rails.get(name)
+                secs = report.rail_seconds.get(name, 0.0)
+                if (
+                    r is None
+                    # an explicit gbps override marks an emulated/
+                    # repriced rail (tests, bench) — its realized rate
+                    # measures the emulation, not a physical link
+                    or r.gbps is not None
+                    or secs <= 0.0
+                    # below this a chunk prices latency, not bandwidth
+                    or nbytes < topology.RAIL_RATE_MIN_BYTES
+                ):
+                    continue
+                folds.append((r.direction, nbytes / secs / 1e9))
             self._export()
+        # fold outside the lock: observe_rail_rate persists to disk
+        try:
+            for direction, gbps in folds:
+                topology.observe_rail_rate(direction, gbps)
+        except Exception:  # pricing feedback must never break transfers
+            pass
 
     def _export(self) -> None:
         """Registry gauges (lock held; cheap sets)."""
@@ -680,6 +708,10 @@ class StripeReport:
     chunks: int = 0
     rail_bytes: Dict[str, int] = field(default_factory=dict)
     rail_chunks: Dict[str, int] = field(default_factory=dict)
+    # wall seconds each rail spent actually executing its chunks
+    # (excludes queue wait): rail_bytes / rail_seconds is the realized
+    # throughput the arbiter folds into topology.observe_rail_rate
+    rail_seconds: Dict[str, float] = field(default_factory=dict)
     crc32: Optional[int] = None
     elapsed_s: float = 0.0
     requeued_chunks: int = 0
@@ -862,8 +894,13 @@ class StripedTransfer:
                 nbytes_of(item), priority=priority,
                 ignore_window=self.ignore_window, rail=rail,
             ):
+                ct0 = time.perf_counter()
                 exec_one(rail, item)
+                cdt = time.perf_counter() - ct0
             with lock:
+                report.rail_seconds[rail] = (
+                    report.rail_seconds.get(rail, 0.0) + cdt
+                )
                 report.rail_bytes[rail] = (
                     report.rail_bytes.get(rail, 0) + nbytes_of(item)
                 )

@@ -108,10 +108,15 @@ class TrainerConfig:
     # collectives XLA can overlap with backward compute, and
     # grad_accum syncs once per optimizer step
     comm_overlap: bool = False
-    # "none" | "int8": int8 collective payloads with error feedback
-    # (implies comm_overlap's explicit sync path; dp/fsdp plans only —
-    # tp plans run uncompressed)
+    # "none" | "int8" | "int8_topk" | "auto": compressed collective
+    # payloads with error feedback (implies comm_overlap's explicit
+    # sync path; dp/fsdp plans only — tp plans run uncompressed).
+    # "int8_topk" also ships only the top-k blocks of the cross-slice
+    # DCN shard; "auto" resolves per mesh from the measured ICI:DCN
+    # ratio (grad_sync.resolve_auto_compress)
     grad_compress: str = "none"
+    # requested DCN block density under int8_topk/auto
+    grad_topk_density: float = 0.25
     # target sync bucket size, MiB; 0 = auto-size per link from the
     # measured topology.LinkModel (DCN-leg target on multi-slice
     # meshes, ICI otherwise)
@@ -572,7 +577,9 @@ class ElasticTrainer:
         names = ()
         if self.tcfg.comm_overlap:
             names += ("comm_overlap",)
-        if self.tcfg.grad_compress == "int8":
+        if self.tcfg.grad_compress == "auto":
+            names += ("grad_compress_auto",)
+        elif self.tcfg.grad_compress != "none":
             names += ("grad_compress",)
         return names
 
@@ -587,6 +594,7 @@ class ElasticTrainer:
         from dlrover_tpu.parallel.grad_sync import (
             ensure_residual,
             estimate_overlap_pct,
+            export_compress_metrics,
             measure_sync_legs_ms,
             measure_sync_ms,
             resolve_plan,
@@ -599,6 +607,10 @@ class ElasticTrainer:
         # bench and the metrics registry (grad_sync_explicit gauge via
         # fold_pipeline_stats) can now see a mesh losing the fast path
         stats.grad_sync_path = "explicit" if plan is not None else "gspmd"
+        # mode/density gauges cover the plan-None case too (mode 0 =
+        # uncompressed GSPMD), so a downgrade is visible as a gauge
+        # step-change rather than a silently missing series
+        export_compress_metrics(plan, self._registry)
         if plan is None:
             # resolve_plan already emitted the once-per-mesh fallback
             # log when the explicit path was requested — the single
@@ -1582,6 +1594,7 @@ class ElasticTrainer:
             comm_overlap=s.comm_overlap,
             grad_compress=s.grad_compress,
             grad_bucket_mb=s.grad_bucket_mb,
+            grad_topk_density=s.grad_topk_density,
         )
 
     def _rebalanced_strategy_for(

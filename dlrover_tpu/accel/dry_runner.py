@@ -67,6 +67,13 @@ class DryRunReport:
     # OVERLAP_HIDDEN_FRACTION of it when comm_overlap is on)
     comm_bytes_per_device: float = 0.0
     comm_exposed_s: float = 0.0
+    # the exposed comm term itemized by interconnect (ICI vs DCN legs,
+    # from ``grad_sync.comm_time_legs_s``; the MoE all-to-all and pp
+    # bubble spill are attributed to the link they ride). Sums to
+    # comm_exposed_s; the step auditor's per-component drift reprices
+    # each leg independently.
+    comm_ici_s: float = 0.0
+    comm_dcn_s: float = 0.0
     # exposed seconds of the AGGREGATE host-link traffic registered
     # with the transfer arbiter (checkpoint staging + embedding
     # fault-in/spill streams, parallel/transfer_sched.py): D2H and H2D
@@ -295,7 +302,7 @@ def _comm_estimate(
     from dlrover_tpu.parallel.grad_sync import (
         OVERLAP_HIDDEN_FRACTION,
         comm_bytes_per_device,
-        comm_time_per_device_s,
+        comm_time_legs_s,
         resolve_sync_mode,
     )
 
@@ -327,12 +334,16 @@ def _comm_estimate(
         from dlrover_tpu.models.config import num_moe_layers
 
         n_moe = num_moe_layers(cfg)
+        a2a_dcn = "ep" in m.dcn_axes
         a2a_s = topology.alltoall_time_s(
-            int(a2a_payload), m.ep, dcn="ep" in m.dcn_axes
+            int(a2a_payload), m.ep, dcn=a2a_dcn
         )
-        report.comm_exposed_s += 4.0 * n_moe * a2a_s * max(
-            s.grad_accum, 1
-        )
+        a2a_total = 4.0 * n_moe * a2a_s * max(s.grad_accum, 1)
+        report.comm_exposed_s += a2a_total
+        if a2a_dcn:
+            report.comm_dcn_s += a2a_total
+        else:
+            report.comm_ici_s += a2a_total
 
     if m.dp * m.fsdp <= 1:
         return
@@ -349,9 +360,10 @@ def _comm_estimate(
         one_sync = comm_bytes_per_device(
             param_bytes, s, grad_itemsize=p_bytes
         )
-        one_sync_s = comm_time_per_device_s(
+        one_ici_s, one_dcn_s = comm_time_legs_s(
             param_bytes, s, grad_itemsize=p_bytes
         )
+        one_sync_s = one_ici_s + one_dcn_s
         syncs = 1
         if mode.kind == "pp":
             # per-stage sync scheduled INTO the pipeline bubble: the
@@ -368,7 +380,12 @@ def _comm_estimate(
             )
             bubble_s = compute_s * bubble_frac
             report.comm_bytes_per_device += one_sync
-            report.comm_exposed_s += max(0.0, one_sync_s - bubble_s)
+            spill = max(0.0, one_sync_s - bubble_s)
+            report.comm_exposed_s += spill
+            # the bubble credit shrinks both legs proportionally
+            if one_sync_s > 0:
+                report.comm_ici_s += spill * one_ici_s / one_sync_s
+                report.comm_dcn_s += spill * one_dcn_s / one_sync_s
             return
         exposed_frac = 1.0 - OVERLAP_HIDDEN_FRACTION
     else:
@@ -379,13 +396,16 @@ def _comm_estimate(
         one_sync = comm_bytes_per_device(
             param_bytes, s, grad_itemsize=p_bytes, compress="none"
         )
-        one_sync_s = comm_time_per_device_s(
+        one_ici_s, one_dcn_s = comm_time_legs_s(
             param_bytes, s, grad_itemsize=p_bytes, compress="none"
         )
+        one_sync_s = one_ici_s + one_dcn_s
         syncs = max(s.grad_accum, 1)
         exposed_frac = 1.0
     report.comm_bytes_per_device += one_sync * syncs
     report.comm_exposed_s += one_sync_s * syncs * exposed_frac
+    report.comm_ici_s += one_ici_s * syncs * exposed_frac
+    report.comm_dcn_s += one_dcn_s * syncs * exposed_frac
 
 
 def _finalize_estimate(
@@ -441,6 +461,31 @@ def _finalize_estimate(
     )
 
 
+def reprice_report(report: DryRunReport, factors: dict) -> float:
+    """``est_step_s`` with each priced component scaled by its drift
+    factor (``obs.audit.current_drift_factors`` vocabulary): the
+    compute roofline by ``compute``, the itemized sync legs by
+    ``ici_sync``/``dcn_sync``, the host term by ``host_xfer``. Comm
+    seconds not itemized into a leg (none today) pass through
+    unscaled."""
+    compute = max(
+        report.est_step_s
+        - report.comm_exposed_s
+        - report.host_exposed_s,
+        0.0,
+    )
+    ici = report.comm_ici_s
+    dcn = report.comm_dcn_s
+    other_comm = max(report.comm_exposed_s - ici - dcn, 0.0)
+    return (
+        compute * factors.get("compute", 1.0)
+        + ici * factors.get("ici_sync", 1.0)
+        + dcn * factors.get("dcn_sync", 1.0)
+        + other_comm
+        + report.host_exposed_s * factors.get("host_xfer", 1.0)
+    )
+
+
 def price_rebalance_options(
     cfg: TransformerConfig,
     batch: int,
@@ -468,9 +513,15 @@ def price_rebalance_options(
     term so the current world's estimate reproduces the trainer's
     MEASURED step time keeps the comparison in real seconds."""
     from dlrover_tpu.accel.profiler import profile_model
-    from dlrover_tpu.parallel.grad_sync import comm_time_per_device_s
+    from dlrover_tpu.obs.audit import current_drift_factors
+    from dlrover_tpu.parallel.grad_sync import comm_time_legs_s
 
     p_bytes = 2 if cfg.param_dtype in ("bfloat16", "float16") else 4
+    # the step auditor's per-component drift: the sync legs reprice by
+    # the interconnect that actually drifted (the row term carries its
+    # own measured-step self-calibration below, so the compute factor
+    # is deliberately NOT applied on top of it)
+    drift = current_drift_factors()
 
     def row_est(s: Strategy) -> float:
         shards = max(s.mesh.dp * s.mesh.fsdp, 1)
@@ -495,8 +546,13 @@ def price_rebalance_options(
     def est(s: Strategy) -> float:
         prof = profile_model(cfg, 1, seq)
         p_total = prof.total_params * p_bytes
-        return row_est(s) * calib + comm_time_per_device_s(
+        ici_s, dcn_s = comm_time_legs_s(
             p_total, s, grad_itemsize=p_bytes
+        )
+        return (
+            row_est(s) * calib
+            + ici_s * drift.get("ici_sync", 1.0)
+            + dcn_s * drift.get("dcn_sync", 1.0)
         )
 
     return est(idle_strategy), est(rebalanced_strategy)
@@ -618,21 +674,42 @@ def dry_run(
     # peak numbers, so on any other backend (virtual CPU meshes in
     # tests/dryruns) estimates are absolute nonsense even when the
     # flops/bytes are right. The timed finalists ARE ground truth for
-    # this backend — rescale every estimate by the median
-    # measured/estimated ratio so printed ests live in real seconds
-    # (ranking is unchanged; the rescale is monotonic).
+    # this backend. Calibration is PER COMPONENT now (obs.audit drift
+    # estimators, shared with the step auditor's live reconciliation):
+    # a timed row seeds the compute factor — the residual left after
+    # the priced comm/host legs is attributed to the roofline, the
+    # crudest term — and every estimate is repriced by whichever
+    # component actually drifted. One timed row is enough (the old
+    # scalar median only ever applied past a 3x gate, so single-point
+    # jobs and merely-2x-off backends stayed at raw roofline until
+    # their first resize mispriced).
     timed = [
         r
         for r in viable[:max_timed]
         if r.step_s is not None and r.est_step_s > 0
     ]
     if timed:
-        calib = float(np.median([r.step_s / r.est_step_s for r in timed]))
-        if calib > 3.0 or calib < 1.0 / 3.0:
-            for r in reports:
-                if r.ok and r.est_step_s > 0:
-                    r.est_step_s *= calib
-                    r.est_source += "+calib"
+        from dlrover_tpu.obs.audit import seed_default_drift
+
+        ratios = []
+        for r in timed:
+            compute_est = max(
+                r.est_step_s - r.comm_exposed_s - r.host_exposed_s,
+                0.0,
+            )
+            implied = r.step_s - r.comm_exposed_s - r.host_exposed_s
+            if compute_est > 0 and implied > 0:
+                ratios.append(implied / compute_est)
+        if ratios:
+            seed_default_drift("compute", float(np.median(ratios)))
+    from dlrover_tpu.obs.audit import current_drift_factors
+
+    factors = current_drift_factors()
+    if any(abs(f - 1.0) > 0.02 for f in factors.values()):
+        for r in reports:
+            if r.ok and r.est_step_s > 0:
+                r.est_step_s = reprice_report(r, factors)
+                r.est_source += "+calib"
 
     def rank(r: DryRunReport):
         """Same tier order as tpe_search: measured+fit < measured+unknown

@@ -45,7 +45,7 @@ from dlrover_tpu.k8s.scaler import JOB_LABEL, NODE_ID_LABEL
 
 def straggler_sink(
     servicer, job_name: str
-) -> Callable[[int, float, float], None]:
+) -> Callable[[int, float, float, str], None]:
     """Brain-ingestion leg of straggler detection: a reporter callable
     for ``obs.aggregate.TelemetryAggregator(brain_reporter=...)`` that
     persists each newly-flagged straggler as a ``node_events`` row
@@ -57,21 +57,30 @@ def straggler_sink(
     wire ``BrainClient.report_node_event`` instead; both write the same
     row."""
 
-    def report(worker_id: int, p50_s: float, fleet_median_s: float):
+    def report(
+        worker_id: int,
+        p50_s: float,
+        fleet_median_s: float,
+        detail: str = "",
+    ):
         # the row's numeric fields are memory/cpu-typed; the magnitude
         # of the slowness goes to the log, algorithms key on
-        # (job, node, event) incidence counts
+        # (job, node, event) incidence counts. `detail` carries the
+        # step-budget audit attribution ("dcn_sync is 2.4x its budget
+        # while compute is on-price") so the *why* survives this master
         servicer.record_node_event(
             comm.BrainNodeEventReport(
                 job_name=job_name,
                 node_id=worker_id,
                 event="straggler",
+                detail=detail,
             )
         )
         logger.info(
             f"brain ingested straggler: job {job_name} worker "
             f"{worker_id} (p50 {p50_s * 1e3:.0f} ms vs fleet median "
             f"{fleet_median_s * 1e3:.0f} ms)"
+            + (f" — {detail}" if detail else "")
         )
 
     return report
@@ -79,19 +88,27 @@ def straggler_sink(
 
 def straggler_client_sink(
     brain_client,
-) -> Callable[[int, float, float], None]:
+) -> Callable[[int, float, float, str], None]:
     """The remote-Brain leg of ``straggler_sink``: same reporter
     contract, writing the same ``node_events`` row through a
     ``BrainClient`` RPC instead of an in-process servicer — masters
     wired to a cluster Brain (``DLROVER_TPU_BRAIN_ADDR``) plug this
     into the aggregator."""
 
-    def report(worker_id: int, p50_s: float, fleet_median_s: float):
-        brain_client.report_node_event(worker_id, "", "straggler")
+    def report(
+        worker_id: int,
+        p50_s: float,
+        fleet_median_s: float,
+        detail: str = "",
+    ):
+        brain_client.report_node_event(
+            worker_id, "", "straggler", detail=detail
+        )
         logger.info(
             f"straggler reported to brain: worker {worker_id} "
             f"(p50 {p50_s * 1e3:.0f} ms vs fleet median "
             f"{fleet_median_s * 1e3:.0f} ms)"
+            + (f" — {detail}" if detail else "")
         )
 
     return report

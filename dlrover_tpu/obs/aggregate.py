@@ -24,6 +24,11 @@ the per-worker view:
   TrainingMonitor → ``TrainMetricsReport``) is kept with its receipt
   time, so a hang report can say "worker 3 stuck in ckpt_commit for
   42s" instead of "no step progress".
+- **step-budget attribution** — the per-component audit scalars
+  (``dlrover_audit_*``, obs/audit.py) ride the same metrics channel;
+  a straggler flag is upgraded from "worker 3 is slow" to "worker 3's
+  dcn_sync is 2.4× its budget while its compute is on-price", and that
+  *why* travels to the Brain in the straggler row's ``detail``.
 """
 
 from __future__ import annotations
@@ -56,6 +61,20 @@ _GOODPUT_SECONDS_RE = re.compile(
 )
 _GOODPUT_WALL_KEY = "dlrover_goodput_wall_seconds"
 
+# the step-budget auditor's per-component scalars (obs/audit.py) ride
+# the same flattened channel: the observed/budget ratio and the alarm
+# latch per priced component, e.g.
+# 'dlrover_audit_budget_ratio{component="dcn_sync"}'
+_AUDIT_RATIO_RE = re.compile(
+    r'^dlrover_audit_budget_ratio\{component="([a-z_]+)"\}$'
+)
+_AUDIT_ALARM_RE = re.compile(
+    r'^dlrover_audit_alarm\{component="([a-z_]+)"\}$'
+)
+# a component within this band of its (drift-corrected) budget reads
+# as "on-price" in the straggler attribution line
+_AUDIT_ON_PRICE_BAND = (0.75, 1.25)
+
 
 class TelemetryAggregator:
     def __init__(
@@ -86,6 +105,9 @@ class TelemetryAggregator:
         # worker -> {"wall_s": float, "seconds": {category: s}} — the
         # latest goodput-ledger snapshot each worker reported
         self._goodput: Dict[int, dict] = {}
+        # worker -> {"ratio": {component: x}, "alarm": {component: 0/1}}
+        # — the latest step-budget audit snapshot (obs/audit.py)
+        self._audit: Dict[int, dict] = {}
         # straggler auto-profile: called once per newly-flagged worker
         # (the master wires this to queue a `profile` worker command)
         self._profile_requester: Optional[Callable[[int], None]] = None
@@ -150,6 +172,7 @@ class TelemetryAggregator:
             if metrics:
                 self._last_metrics[worker_id] = dict(metrics)
                 self._ingest_goodput(worker_id, metrics)
+                self._ingest_audit(worker_id, metrics)
             st_ms = metrics.get("step_time_ms")
             if st_ms is not None and st_ms > 0:
                 if worker_id not in self._explicit:
@@ -188,6 +211,25 @@ class TelemetryAggregator:
             self._goodput[worker_id] = {
                 "wall_s": wall, "seconds": seconds,
             }
+
+    def _ingest_audit(self, worker_id: int, metrics: dict):
+        """Pick the step-budget audit scalars out of a metrics report
+        (lock held by caller): per-component observed/budget ratio plus
+        the alarm latch. This is what upgrades a straggler flag from
+        "worker 3 is slow" to "worker 3's dcn_sync is 2.4x its budget
+        while its compute is on-price"."""
+        ratio: Dict[str, float] = {}
+        alarm: Dict[str, float] = {}
+        for key, value in metrics.items():
+            m = _AUDIT_RATIO_RE.match(key)
+            if m:
+                ratio[m.group(1)] = float(value)
+                continue
+            m = _AUDIT_ALARM_RE.match(key)
+            if m:
+                alarm[m.group(1)] = float(value)
+        if ratio or alarm:
+            self._audit[worker_id] = {"ratio": ratio, "alarm": alarm}
 
     def set_profile_requester(self, fn: Optional[Callable[[int], None]]):
         """``fn(worker_id)`` fires once per newly-flagged straggler —
@@ -233,6 +275,69 @@ class TelemetryAggregator:
             "workers": len(recs),
         }
 
+    # -- step-budget audit (fleet attribution) -------------------------
+    def worker_audit(self, worker_id: int) -> Optional[dict]:
+        """Latest audit snapshot for one worker:
+        ``{"ratio": {component: x}, "alarm": {component: 0/1}}``."""
+        with self._lock:
+            rec = self._audit.get(worker_id)
+        if rec is None:
+            return None
+        return {
+            "ratio": dict(rec["ratio"]),
+            "alarm": dict(rec["alarm"]),
+        }
+
+    def audit_alarms(self) -> Dict[int, List[str]]:
+        """worker -> components with an active regression alarm — the
+        fleet view of the auditor's CUSUM latches."""
+        with self._lock:
+            items = [
+                (w, rec["alarm"]) for w, rec in self._audit.items()
+            ]
+        return {
+            w: sorted(c for c, v in alarm.items() if v >= 1.0)
+            for w, alarm in items
+            if any(v >= 1.0 for v in alarm.values())
+        }
+
+    def audit_attribution(self, worker_id: int) -> str:
+        """The per-component *why* behind a slow worker, from its last
+        audit snapshot: names the components over budget (worst first,
+        alarmed components always included) and contrasts with the
+        on-price ones. Empty string when the worker never reported
+        audit scalars — attribution then stays the bare time flag."""
+        rec = self.worker_audit(worker_id)
+        if rec is None:
+            return ""
+        lo, hi = _AUDIT_ON_PRICE_BAND
+        over = sorted(
+            (
+                (c, r)
+                for c, r in rec["ratio"].items()
+                if r > hi or rec["alarm"].get(c, 0.0) >= 1.0
+            ),
+            key=lambda cr: -cr[1],
+        )
+        if not over:
+            return ""
+        on_price = sorted(
+            c
+            for c, r in rec["ratio"].items()
+            if lo <= r <= hi and c not in {c for c, _ in over}
+        )
+        parts = [
+            f"{c} is {r:.1f}x its budget"
+            + (" [alarm]" if rec["alarm"].get(c, 0.0) >= 1.0 else "")
+            for c, r in over
+        ]
+        line = ", ".join(parts)
+        if on_price:
+            line += f" while {', '.join(on_price)} " + (
+                "are" if len(on_price) > 1 else "is"
+            ) + " on-price"
+        return line
+
     def remove_worker(self, worker_id: int):
         """A departed worker's history must not haunt the fleet median."""
         with self._lock:
@@ -243,6 +348,7 @@ class TelemetryAggregator:
             self._last_metrics.pop(worker_id, None)
             self._flagged.discard(worker_id)
             self._goodput.pop(worker_id, None)
+            self._audit.pop(worker_id, None)
 
     def _bucket(self, worker_id: int) -> Deque[float]:
         b = self._samples.get(worker_id)
@@ -304,14 +410,22 @@ class TelemetryAggregator:
             new = [w for w in flagged if w not in self._flagged]
             self._flagged = set(flagged)
         for w in new:
+            # the audit upgrade: when the worker ships step-budget
+            # scalars the flag carries the component-level *why*
+            why = self.audit_attribution(w)
             logger.warning(
                 f"straggler: worker {w} p50 step time "
                 f"{details[w] * 1e3:.0f} ms > {self.straggler_ratio}x "
                 f"fleet median {med * 1e3:.0f} ms"
+                + (f" — {why}" if why else "")
             )
             if self._brain_reporter is not None:
                 try:
-                    self._brain_reporter(w, details[w], med)
+                    try:
+                        self._brain_reporter(w, details[w], med, why)
+                    except TypeError:
+                        # pre-audit reporter contract (3-arg sinks)
+                        self._brain_reporter(w, details[w], med)
                 except Exception as e:
                     logger.warning(
                         f"straggler brain report failed: {e!r}"
@@ -403,6 +517,12 @@ class TelemetryAggregator:
         registry.gauge(
             "dlrover_straggler_count", "currently flagged stragglers"
         ).set(len(self.stragglers))
+        # fleet view of the step-budget auditor's regression latches:
+        # how many workers currently hold at least one component alarm
+        registry.gauge(
+            "dlrover_audit_alarm_workers",
+            "workers with an active step-budget regression alarm",
+        ).set(float(len(self.audit_alarms())))
         # fleet goodput accounting (the Brain objective + dashboards)
         fleet = self.fleet_goodput()
         gw = registry.gauge(

@@ -2086,9 +2086,10 @@ def comm_time_per_device_s(
     grad_itemsize: int = 4,
     compress: Optional[str] = None,
 ) -> float:
-    """Seconds of gradient-sync wire time per device per sync, priced
-    per link from the measured ``topology.LinkModel`` instead of one
-    flat ICI constant:
+    """Seconds of gradient-sync wire time per device per sync — the
+    sum of the per-interconnect split :func:`comm_time_legs_s` prices.
+    Priced per link from the measured ``topology.LinkModel`` instead
+    of one flat ICI constant:
 
     - hybrid dp axis (``dp_slices() > 1``, explicit two-level path):
       the slice-local RS + AG legs ride ICI at the ring factor over
@@ -2115,12 +2116,35 @@ def comm_time_per_device_s(
 
     Per-collective latency (one ring's worth of hops) is added from
     the model so tiny syncs don't price as free."""
+    ici_s, dcn_s = comm_time_legs_s(
+        n_param_bytes,
+        strategy,
+        link_model=link_model,
+        grad_itemsize=grad_itemsize,
+        compress=compress,
+    )
+    return ici_s + dcn_s
+
+
+def comm_time_legs_s(
+    n_param_bytes: float,
+    strategy,
+    link_model=None,
+    grad_itemsize: int = 4,
+    compress: Optional[str] = None,
+) -> Tuple[float, float]:
+    """``(ici_s, dcn_s)`` — :func:`comm_time_per_device_s` itemized by
+    the interconnect each leg rides. The step auditor's budget side
+    (``obs.audit.StepBudget``) prices ``ici_sync`` and ``dcn_sync``
+    separately from this split, so a drifted or regressed sync
+    attributes to the link that actually moved the bytes instead of to
+    "comm"."""
     from dlrover_tpu.parallel import topology
 
     m = strategy.mesh
     n = m.dp * m.fsdp
     if n <= 1:
-        return 0.0
+        return 0.0, 0.0
     model = link_model or topology.get_link_model()
     topology.note_fallback_use(model)
     payload = float(n_param_bytes)
@@ -2170,21 +2194,23 @@ def comm_time_per_device_s(
     explicit = mode is not None and strategy.resolved_comm_overlap()
 
     def _axis_rate(axis: str):
-        """(sec/byte, latency) of one collective over ``axis`` — an
-        axis listed WHOLE in dcn_axes rides DCN (the hybrid dp case,
-        dp_slices() > 1, is handled by the two-level split below, not
-        here), everything else its measured ICI rate."""
+        """(sec/byte, latency, rides_dcn) of one collective over
+        ``axis`` — an axis listed WHOLE in dcn_axes rides DCN (the
+        hybrid dp case, dp_slices() > 1, is handled by the two-level
+        split below, not here), everything else its measured ICI
+        rate."""
         whole_dcn = axis in m.dcn_axes and not (
             axis == "dp" and slices > 1
         )
         if whole_dcn:
-            return model.sec_per_dcn_byte(), model.dcn_lat_s
-        return model.sec_per_axis_byte(axis), model.ici_lat_s
+            return model.sec_per_dcn_byte(), model.dcn_lat_s, True
+        return model.sec_per_axis_byte(axis), model.ici_lat_s, False
 
-    def _dp_legs(chunk: float, dp: int) -> float:
-        """Seconds of the dp-axis sync of a per-device ``chunk``."""
+    def _dp_legs(chunk: float, dp: int) -> Tuple[float, float]:
+        """(ici_s, dcn_s) of the dp-axis sync of a per-device
+        ``chunk``."""
         if dp <= 1:
-            return 0.0
+            return 0.0, 0.0
         if slices > 1:
             per = dp // slices
             # ICI legs stay full precision; only the DCN shard
@@ -2192,22 +2218,26 @@ def comm_time_per_device_s(
             return (
                 2.0 * (per - 1) / per * chunk
                 * model.sec_per_axis_byte("dp")
-                + 2 * per * model.ici_lat_s
-                + 2.0 * (slices - 1) / slices * (chunk / per) * c
+                + 2 * per * model.ici_lat_s,
+                2.0 * (slices - 1) / slices * (chunk / per) * c
                 * model.sec_per_dcn_byte()
-                + 2 * slices * model.dcn_lat_s
+                + 2 * slices * model.dcn_lat_s,
             )
-        rate, lat = _axis_rate("dp")
-        return 2.0 * (dp - 1) / dp * chunk * c * rate + 2 * dp * lat
+        rate, lat, dcn = _axis_rate("dp")
+        t = 2.0 * (dp - 1) / dp * chunk * c * rate + 2 * dp * lat
+        return (0.0, t) if dcn else (t, 0.0)
 
     if explicit and mode.kind in ("zero", "3d"):
         F = mode.fsdp
         if mode.kind == "3d":
             payload /= mode.model_shard  # tp-local buckets
             c = 1.0  # 3d plans never compress
-        rate, lat = _axis_rate("fsdp")
+        rate, lat, dcn = _axis_rate("fsdp")
         fsdp_s = (F - 1) / F * payload * rate + F * lat
-        return fsdp_s + _dp_legs(payload / F, mode.dp)
+        dp_ici, dp_dcn = _dp_legs(payload / F, mode.dp)
+        if dcn:
+            return dp_ici, fsdp_s + dp_dcn
+        return fsdp_s + dp_ici, dp_dcn
     if explicit and mode.kind in ("tp", "ep"):
         # tp/ep plans never compress and sync with one flat psum per
         # bucket over the WHOLE dp axis — if dp spans DCN anywhere
@@ -2216,14 +2246,17 @@ def comm_time_per_device_s(
         # paths; plans force slices=1)
         dp = mode.dp
         if "dp" in m.dcn_axes:
-            rate, lat = model.sec_per_dcn_byte(), model.dcn_lat_s
+            rate, lat, dcn = (
+                model.sec_per_dcn_byte(), model.dcn_lat_s, True,
+            )
         else:
-            rate, lat = _axis_rate("dp")
+            rate, lat, dcn = _axis_rate("dp")
         # ep modes carry model_shard=1 (dense-majority payload whole)
-        return (
+        t = (
             2.0 * (dp - 1) / dp * (payload / mode.model_shard) * rate
             + 2 * dp * lat
         )
+        return (0.0, t) if dcn else (t, 0.0)
     if explicit and mode.kind == "pp":
         # per-stage dp legs on the stage share (flat or two-level;
         # payload is already /pp above), never compressed
@@ -2241,7 +2274,8 @@ def comm_time_per_device_s(
     lat = model.dcn_lat_s if crosses_dcn else model.ici_lat_s
     if explicit:
         payload *= c  # flat explicit path compresses the whole ring
-    return ring * payload * sec_per_byte + 2 * n * lat
+    t = ring * payload * sec_per_byte + 2 * n * lat
+    return (0.0, t) if crosses_dcn else (t, 0.0)
 
 
 def estimate_overlap_pct(strategy) -> Optional[float]:

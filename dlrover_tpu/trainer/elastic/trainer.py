@@ -40,6 +40,12 @@ from dlrover_tpu.obs.flight_recorder import (
     ProfilerCapture,
     default_recorder,
 )
+from dlrover_tpu.obs.audit import (
+    StepAuditor,
+    StepBudget,
+    install_default_auditor,
+    load_audit_calibration,
+)
 from dlrover_tpu.obs.goodput import GoodputLedger, install_default_ledger
 from dlrover_tpu.obs.metrics import default_registry, fold_pipeline_stats
 from dlrover_tpu.obs.trace import SpanHeartbeat, span
@@ -365,6 +371,16 @@ class ElasticTrainer:
         self._goodput = install_default_ledger(
             GoodputLedger(tid_fn=lambda: self._train_tid)
         )
+        # step-budget auditor (obs/audit): reconciles the pricing
+        # side's per-component StepBudget against the span stream each
+        # step — drift reprices, sustained regressions alarm with the
+        # component named and a flight bundle captured
+        self._auditor = install_default_auditor(
+            StepAuditor(
+                tid_fn=lambda: self._train_tid,
+                on_alarm=self._on_audit_alarm,
+            )
+        )
         self._replay_until_step: Optional[int] = None
         self._flight = default_recorder()
         self._flight.set_identity(
@@ -442,6 +458,8 @@ class ElasticTrainer:
         self._link_fp: Optional[str] = None
         self._setup_link_model()
         self._setup_grad_sync()
+        self._audit_cal_loaded = False
+        self._setup_audit_budget()
         self._state_nbytes = sum(
             x.size * x.dtype.itemsize
             for x in jax.tree_util.tree_leaves(self.state)
@@ -648,6 +666,86 @@ class ElasticTrainer:
                     f"grad-sync timing probe failed: {e!r}"
                 )
         logger.info(f"grad sync: {plan.describe()}")
+
+    # -- step-budget audit (obs/audit.py) -------------------------------
+    def _setup_audit_budget(self):
+        """Assemble the per-component :class:`StepBudget` for the
+        CURRENT world and hand it to the auditor. Called at startup and
+        after every resize (the ici/dcn split and the host-transfer
+        demand are per-world facts). Components the trainer cannot
+        price cheaply (compute, data_wait) stay 0.0 — the auditor
+        adopts their warmup-mean observation as the budget instead."""
+        import jax
+
+        from dlrover_tpu.parallel import transfer_sched
+        from dlrover_tpu.parallel.grad_sync import (
+            OVERLAP_HIDDEN_FRACTION,
+            comm_time_legs_s,
+        )
+
+        try:
+            if not self._audit_cal_loaded and self._link_fp:
+                # warm restart on the same hardware: start from the
+                # persisted per-component drift instead of re-learning
+                cal = load_audit_calibration(self._link_fp)
+                if cal is not None:
+                    self._auditor.apply_calibration(cal)
+                self._audit_cal_loaded = True
+            budget = StepBudget()
+            param_bytes = 0
+            itemsize = 4
+            for x in jax.tree_util.tree_leaves(self.state.params):
+                if hasattr(x, "dtype"):
+                    param_bytes += x.size * x.dtype.itemsize
+                    itemsize = x.dtype.itemsize
+            ici_s, dcn_s = comm_time_legs_s(
+                param_bytes,
+                self.accel.strategy,
+                grad_itemsize=itemsize,
+            )
+            # the explicit bucketed path overlaps most of the wire time
+            # behind compute; only the exposed remainder is step time
+            exposed = (
+                1.0 - OVERLAP_HIDDEN_FRACTION
+                if self._grad_sync_plan is not None
+                else 1.0
+            )
+            budget.set_component("ici_sync", ici_s * exposed, "priced")
+            budget.set_component("dcn_sync", dcn_s * exposed, "priced")
+            budget.set_component(
+                "host_xfer",
+                transfer_sched.aggregate_host_exposed_s(),
+                "priced",
+            )
+            self._auditor.set_budget(budget)
+            # the sync legs run inside the jitted step (no per-step
+            # spans) — feed the probe-measured wall times as the
+            # standing observation for those components
+            stats = self.pipeline_stats
+            if stats.grad_sync_ici_ms:
+                self._auditor.set_measured(
+                    "ici_sync", stats.grad_sync_ici_ms / 1e3 * exposed
+                )
+            if stats.grad_sync_dcn_ms:
+                self._auditor.set_measured(
+                    "dcn_sync", stats.grad_sync_dcn_ms / 1e3 * exposed
+                )
+        except Exception as e:
+            logger.warning(f"audit budget assembly failed: {e!r}")
+
+    def _on_audit_alarm(self, component: str, ratio: float, detail: str):
+        """Sustained regression: capture forensics at the moment the
+        detector fires, and leave a breadcrumb in the recorder's event
+        log so later dumps carry the attribution too."""
+        self._flight.note_event("audit_regression", detail)
+        self._flight.dump(
+            "audit_regression",
+            extra={
+                "component": component,
+                "ratio": round(ratio, 3),
+                "detail": detail,
+            },
+        )
 
     def _maybe_rebalance_experts(self, load) -> bool:
         """Fold one measured per-expert routing-load vector into the
@@ -1950,6 +2048,12 @@ class ElasticTrainer:
         # error-feedback residual attached (shapes changed with dp);
         # the timing probe is skipped — downtime window
         self._setup_grad_sync(measure=False)
+        # spans straddling the rebuild belong to neither world's
+        # budget: drop everything buffered so far, then re-price the
+        # per-component budget for the new mesh (tests/test_audit.py
+        # guards the no-double-count property)
+        self._auditor.skip_to_now()
+        self._setup_audit_budget()
         new_state = self.state
         # candidates already seen were filtered against the OLD world;
         # the next poll must re-evaluate them for this one
@@ -2224,6 +2328,14 @@ class ElasticTrainer:
         # gauges (the aggregator re-assembles the fleet number from
         # these scalars)
         self._goodput.export(self._registry)
+        # budget reconciliation rides the same cadence: audit every
+        # step completed since the last report, publish the
+        # dlrover_audit_* series (residual/drift/alarm per component)
+        # and rate-limited-persist the drift snapshot beside the rail
+        # cache so a warm restart starts repriced
+        self._auditor.export(self._registry)
+        if self._link_fp:
+            self._auditor.persist(fingerprint=self._link_fp)
         self._poll_worker_commands()
         if self.tcfg.report_metrics:
             report_runtime_metrics(
@@ -2489,6 +2601,10 @@ class ElasticTrainer:
         if self._span_heartbeat is not None:
             self._span_heartbeat.stop()
             self._span_heartbeat = None
+        # final drift snapshot, bypassing the rate limit — short jobs
+        # still leave a calibration for the next run on this hardware
+        if self._link_fp:
+            self._auditor.persist(fingerprint=self._link_fp, force=True)
         self._flight.stop_watchdog()
         self._profiler_capture.abort()
         self._close_prefetcher()

@@ -780,6 +780,131 @@ class TestDurableRename:
 
 
 # ---------------------------------------------------------------------------
+# audit-budget-coverage
+# ---------------------------------------------------------------------------
+_AUDIT_FIXTURE_OK = {
+    "dlrover_tpu/obs/audit.py": """
+        COMPONENTS = ("compute", "data_wait")
+
+        OBSERVED = {
+            "compute": ("compute",),
+            "data_wait": ("data_wait",),
+        }
+
+        class StepBudget:
+            compute_s: float = 0.0
+            data_wait_s: float = 0.0
+        """,
+    "dlrover_tpu/trainer.py": """
+        from dlrover_tpu.obs.trace import span
+
+        def loop():
+            with span("data_wait"):
+                pass
+            with span("compute"):
+                pass
+        """,
+}
+
+
+class TestAuditBudgetCoverage:
+    def test_negative_aligned_vocabularies(self, tmp_path):
+        from tools.graftlint.checkers.audit_budget import (
+            AuditBudgetCoverageChecker,
+        )
+
+        ctx = mini_repo(tmp_path, dict(_AUDIT_FIXTURE_OK))
+        assert live(run_one(AuditBudgetCoverageChecker(), ctx)) == []
+
+    def test_positive_all_rules(self, tmp_path):
+        from tools.graftlint.checkers.audit_budget import (
+            AuditBudgetCoverageChecker,
+        )
+
+        ctx = mini_repo(tmp_path, {
+            # priced_only: no budget field, no OBSERVED entry;
+            # ghost: OBSERVED span nothing emits; stale_field /
+            # stale_key: budget field / OBSERVED key not in COMPONENTS
+            "dlrover_tpu/obs/audit.py": """
+                COMPONENTS = ("compute", "priced_only", "ghost")
+
+                OBSERVED = {
+                    "compute": ("compute",),
+                    "ghost": ("never_emitted",),
+                    "stale_key": ("compute",),
+                }
+
+                class StepBudget:
+                    compute_s: float = 0.0
+                    ghost_s: float = 0.0
+                    stale_field_s: float = 0.0
+                """,
+            "dlrover_tpu/trainer.py": """
+                from dlrover_tpu.obs.trace import span
+
+                def loop():
+                    with span("compute"):
+                        pass
+                """,
+        })
+        found = live(run_one(AuditBudgetCoverageChecker(), ctx))
+        msgs = "\n".join(f"{f.line}:{f.message}" for f in found)
+        assert "'priced_only'" in msgs and "never be priced" in msgs
+        assert "reconciles against nothing" in msgs
+        assert "'never_emitted'" in msgs and "never emitted" in msgs
+        assert "stale_field" in msgs and "never audited" in msgs
+        assert "'stale_key'" in msgs and "stale registry" in msgs
+        assert len(found) == 5, msgs
+
+    def test_span_emitted_in_tests_does_not_count(self, tmp_path):
+        from tools.graftlint.checkers.audit_budget import (
+            AuditBudgetCoverageChecker,
+        )
+
+        files = dict(_AUDIT_FIXTURE_OK)
+        # move the data_wait emission into a test file: production
+        # never emits it, so the auditor measures zero forever
+        files["dlrover_tpu/trainer.py"] = """
+            from dlrover_tpu.obs.trace import span
+
+            def loop():
+                with span("compute"):
+                    pass
+            """
+        files["tests/test_x.py"] = """
+            from dlrover_tpu.obs.trace import span
+
+            def test_loop():
+                with span("data_wait"):
+                    pass
+            """
+        ctx = mini_repo(tmp_path, files)
+        found = live(run_one(AuditBudgetCoverageChecker(), ctx))
+        assert len(found) == 1
+        assert "'data_wait'" in found[0].message
+
+    def test_real_tree_vocabularies_parse(self):
+        """The checker must statically read all three views from the
+        real obs/audit.py (an unparseable vocabulary is itself a
+        finding, by design)."""
+        import ast as _ast
+
+        from tools.graftlint.checkers.audit_budget import (
+            AuditBudgetCoverageChecker,
+        )
+
+        path = os.path.join(REPO_ROOT, "dlrover_tpu/obs/audit.py")
+        tree = _ast.parse(open(path).read())
+        chk = AuditBudgetCoverageChecker()
+        comps = chk._components(tree)
+        obs = chk._observed(tree)
+        fields = chk._budget_fields(tree)
+        assert comps is not None and obs is not None
+        assert fields is not None
+        assert comps[0] == fields[0] == set(obs[0])
+
+
+# ---------------------------------------------------------------------------
 # suppression machinery
 # ---------------------------------------------------------------------------
 class TestSuppressions:

@@ -8,6 +8,9 @@ from tools.graftlint.checkers.rpc import RpcIdempotencyChecker
 from tools.graftlint.checkers.metrics_docs import MetricDocDriftChecker
 from tools.graftlint.checkers.fault_sites import FaultSiteChecker
 from tools.graftlint.checkers.durable_rename import DurableRenameChecker
+from tools.graftlint.checkers.audit_budget import (
+    AuditBudgetCoverageChecker,
+)
 
 ALL_CHECKERS = (
     LockDisciplineChecker(),
@@ -16,4 +19,5 @@ ALL_CHECKERS = (
     MetricDocDriftChecker(),
     FaultSiteChecker(),
     DurableRenameChecker(),
+    AuditBudgetCoverageChecker(),
 )

@@ -1,0 +1,290 @@
+"""audit-budget-coverage: the step auditor's three component views
+must agree, and every observed span name must really be emitted.
+
+``obs/audit.py`` keeps three parallel vocabularies for the priced step
+components: the ``COMPONENTS`` export tuple, one ``<component>_s``
+field per component on ``StepBudget``, and the ``OBSERVED``
+component→span-name registry the auditor harvests from the trace
+stream. They only work as a loop when all three line up — a component
+priced but never observed reconciles against nothing (its residual is
+its whole budget, a standing false alarm), and an observed name no
+``span(...)`` call ever emits measures zero forever (the regression
+detector is structurally blind to that component). Both failure modes
+are silent at runtime; this pass makes them lint errors:
+
+- every ``COMPONENTS`` entry must have a ``StepBudget`` ``<c>_s``
+  field AND a non-empty ``OBSERVED`` entry (and vice versa — stale
+  fields/keys are registry rot);
+- every span name listed in ``OBSERVED`` must appear as the name
+  argument of at least one ``span(...)`` call in production code —
+  the auditor can only harvest spans somebody emits.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.core import (
+    Context,
+    Finding,
+    call_name,
+    last_segment,
+)
+
+_AUDIT_SUFFIX = "obs/audit.py"
+
+
+class AuditBudgetCoverageChecker:
+    id = "audit-budget-coverage"
+    scope = "repo"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        audit_path = ctx.find_file(_AUDIT_SUFFIX)
+        if audit_path is None:
+            return []
+        try:
+            tree = ctx.tree(audit_path)
+        except (OSError, SyntaxError):
+            return []
+
+        components = self._components(tree)
+        observed = self._observed(tree)
+        budget_fields = self._budget_fields(tree)
+        if components is None or observed is None or budget_fields is None:
+            # the module exists but one vocabulary is unparseable —
+            # that IS the drift this pass guards against
+            missing = [
+                name
+                for name, v in (
+                    ("COMPONENTS", components),
+                    ("OBSERVED", observed),
+                    ("StepBudget fields", budget_fields),
+                )
+                if v is None
+            ]
+            return [
+                Finding(
+                    checker=self.id,
+                    path=ctx.rel(audit_path),
+                    line=1,
+                    message=(
+                        "could not statically read "
+                        + ", ".join(missing)
+                        + " from obs/audit.py"
+                    ),
+                    hint=(
+                        "keep COMPONENTS a literal tuple, OBSERVED a "
+                        "literal dict and StepBudget fields simple "
+                        "annotated `<c>_s` attributes"
+                    ),
+                )
+            ]
+        comp_set, comp_lines = components
+        obs_map, obs_lines, obs_decl_line = observed
+        field_set, field_lines, class_line = budget_fields
+
+        rel = ctx.rel(audit_path)
+        findings: List[Finding] = []
+        for c in sorted(comp_set):
+            line = comp_lines.get(c, 1)
+            if c not in field_set:
+                findings.append(
+                    Finding(
+                        checker=self.id,
+                        path=rel,
+                        line=line,
+                        message=(
+                            f"component {c!r} has no StepBudget "
+                            f"`{c}_s` field — it can never be priced"
+                        ),
+                        hint=f"add `{c}_s: float = 0.0` to StepBudget",
+                    )
+                )
+            spans = obs_map.get(c)
+            if not spans:
+                findings.append(
+                    Finding(
+                        checker=self.id,
+                        path=rel,
+                        line=line,
+                        message=(
+                            f"component {c!r} has no observed span "
+                            "name in OBSERVED — its budget reconciles "
+                            "against nothing"
+                        ),
+                        hint=(
+                            "register the span name(s) that realize "
+                            "it in OBSERVED"
+                        ),
+                    )
+                )
+        for c in sorted(field_set - comp_set):
+            findings.append(
+                Finding(
+                    checker=self.id,
+                    path=rel,
+                    line=field_lines.get(c, class_line),
+                    message=(
+                        f"StepBudget field `{c}_s` is not in "
+                        "COMPONENTS — it is never audited"
+                    ),
+                    hint="add it to COMPONENTS or drop the field",
+                )
+            )
+        for c in sorted(set(obs_map) - comp_set):
+            findings.append(
+                Finding(
+                    checker=self.id,
+                    path=rel,
+                    line=obs_lines.get(c, obs_decl_line),
+                    message=(
+                        f"OBSERVED maps unknown component {c!r} — "
+                        "stale registry entry"
+                    ),
+                    hint="add it to COMPONENTS or remove the mapping",
+                )
+            )
+
+        emitted = self._emitted_span_names(ctx, audit_path)
+        for c in sorted(comp_set):
+            for name in obs_map.get(c, ()):
+                if name not in emitted:
+                    findings.append(
+                        Finding(
+                            checker=self.id,
+                            path=rel,
+                            line=obs_lines.get(c, obs_decl_line),
+                            message=(
+                                f"observed span {name!r} (component "
+                                f"{c!r}) is never emitted by a "
+                                "span(...) call — the auditor "
+                                "measures zero forever"
+                            ),
+                            hint=(
+                                "emit the span on the train path or "
+                                "fix the OBSERVED name"
+                            ),
+                        )
+                    )
+        return findings
+
+    # -- vocabulary extraction -----------------------------------------
+    def _components(
+        self, tree: ast.AST
+    ) -> Optional[Tuple[Set[str], Dict[str, int]]]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == "COMPONENTS"
+                for t in node.targets
+            ):
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                comps: Set[str] = set()
+                lines: Dict[str, int] = {}
+                for el in node.value.elts:
+                    if isinstance(el, ast.Constant) and isinstance(
+                        el.value, str
+                    ):
+                        comps.add(el.value)
+                        lines[el.value] = el.lineno
+                return comps, lines
+        return None
+
+    def _observed(
+        self, tree: ast.AST
+    ) -> Optional[Tuple[Dict[str, Tuple[str, ...]], Dict[str, int], int]]:
+        for node in ast.walk(tree):
+            target = None
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "OBSERVED"
+                for t in node.targets
+            ):
+                target = node.value
+            elif (
+                isinstance(node, ast.AnnAssign)
+                and isinstance(node.target, ast.Name)
+                and node.target.id == "OBSERVED"
+            ):
+                target = node.value
+            if target is None or not isinstance(target, ast.Dict):
+                continue
+            mapping: Dict[str, Tuple[str, ...]] = {}
+            lines: Dict[str, int] = {}
+            for k, v in zip(target.keys, target.values):
+                if not (
+                    isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                ):
+                    continue
+                names = []
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    names = [
+                        el.value
+                        for el in v.elts
+                        if isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)
+                    ]
+                elif isinstance(v, ast.Constant) and isinstance(
+                    v.value, str
+                ):
+                    names = [v.value]
+                mapping[k.value] = tuple(names)
+                lines[k.value] = k.lineno
+            return mapping, lines, node.lineno
+        return None
+
+    def _budget_fields(
+        self, tree: ast.AST
+    ) -> Optional[Tuple[Set[str], Dict[str, int], int]]:
+        for node in ast.walk(tree):
+            if (
+                not isinstance(node, ast.ClassDef)
+                or node.name != "StepBudget"
+            ):
+                continue
+            fields: Set[str] = set()
+            lines: Dict[str, int] = {}
+            for stmt in node.body:
+                if not (
+                    isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                ):
+                    continue
+                name = stmt.target.id
+                if name.endswith("_s"):
+                    fields.add(name[:-2])
+                    lines[name[:-2]] = stmt.lineno
+            return fields, lines, node.lineno
+        return None
+
+    def _emitted_span_names(
+        self, ctx: Context, audit_path: str
+    ) -> Set[str]:
+        """First-arg string literals of ``span(...)`` /
+        ``tracer.span(...)`` calls across production code (the audit
+        module itself and tests don't count as emission)."""
+        names: Set[str] = set()
+        for path in ctx.iter_files(respect_changed=False):
+            if os.path.abspath(path) == os.path.abspath(audit_path):
+                continue
+            rel = ctx.rel(path).replace(os.sep, "/")
+            if rel.startswith("tests/") or "/tests/" in rel:
+                continue
+            try:
+                tree = ctx.tree(path)
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if last_segment(call_name(node)) != "span":
+                    continue
+                if node.args and isinstance(
+                    node.args[0], ast.Constant
+                ) and isinstance(node.args[0].value, str):
+                    names.add(node.args[0].value)
+        return names

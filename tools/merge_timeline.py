@@ -11,9 +11,10 @@ its wall-clock anchor for exactly this purpose:
 tracer's ``ts == 0``. This tool re-bases every input onto one shared
 axis (the earliest anchor across all inputs), assigns each worker its
 own Perfetto process row, and overlays the master's node events
-(restarts, degraded episodes, straggler flags, injected faults) as
-instant markers — so one artifact answers "what was the whole fleet
-doing when X happened".
+(restarts, degraded episodes, straggler flags, injected faults, and
+step-budget audit alarms with their offending component in the marker
+name) as instant markers — so one artifact answers "what was the whole
+fleet doing when X happened".
 
 Usage::
 
@@ -39,6 +40,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -57,8 +59,16 @@ def _anchor_s(trace: dict) -> Optional[float]:
     return None
 
 
+_AUDIT_DETAIL_RE = re.compile(r"^([a-z_]+) observed ")
+
+
 def _normalize_event(e: dict) -> Optional[Tuple[float, str, dict]]:
-    """(wall_ts_s, name, args) from either node-event shape."""
+    """(wall_ts_s, name, args) from either node-event shape. Step-budget
+    audit alarms (flight-recorder ``audit_regression`` entries, see
+    obs/audit.py) surface their offending component in the marker name
+    itself — the merged timeline reads "audit_regression:dcn_sync" at
+    the instant the detector fired, same shape as every other node
+    event."""
     try:
         ts = float(e["ts"])
     except (KeyError, TypeError, ValueError):
@@ -69,6 +79,15 @@ def _normalize_event(e: dict) -> Optional[Tuple[float, str, dict]]:
         for k in ("node_type", "node_id", "detail")
         if e.get(k) not in (None, "")
     }
+    if name == "audit_regression":
+        component = str(e.get("component") or "")
+        if not component:
+            m = _AUDIT_DETAIL_RE.match(str(e.get("detail") or ""))
+            if m:
+                component = m.group(1)
+        if component:
+            name = f"audit_regression:{component}"
+            args["component"] = component
     return ts, name, args
 
 

@@ -29,6 +29,7 @@ Prints ONE JSON line: {"metric","value","unit","vs_baseline","mfu_pct",
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import tempfile
@@ -3200,6 +3201,114 @@ def run_chaos_bench(jax, results: dict, smoke: bool = False):
     results["chaos_kill_loss_bitwise"] = k.get("loss_bitwise")
 
 
+# the SDC gates (ISSUE 20): the tier-1 fence must flag the injected
+# chip within this many steps of corruption onset (measured: 1 — the
+# cross-lane test needs no history)
+SDC_DETECT_STEP_GATE = 10
+# extra seeds for the innocent-conviction sweep: with the full
+# scenario's seed 7 (lane 3) these cover three distinct injected lanes
+SDC_EXTRA_SEEDS = (13, 20)  # lanes 1 and 0
+
+
+def run_sdc_bench(jax, results: dict, smoke: bool = False):
+    """Silent-data-corruption defense leg (ISSUE 20): the chaos
+    scenario's full chain plus the two properties a scenario run alone
+    cannot gate.
+
+    - **sdc_quarantine** (``tools/chaos.py``): one chip computes
+      wrong-but-finite numbers; the fence must detect within
+      ``SDC_DETECT_STEP_GATE`` steps of onset, the paired audit must
+      convict EXACTLY the injected lane, rollback must land on the
+      verified step with the replay booked to ``restart_replay``, the
+      convicted rank must be absent from the next frozen rendezvous
+      world, and the resumed run must match the golden losses BITWISE.
+    - **innocent-conviction sweep**: the convict-only leg re-runs the
+      injection under ``SDC_EXTRA_SEEDS`` (different lanes): across
+      all three seeds no lane other than the injected one may ever be
+      convicted — a defense that shoots bystanders is worse than none.
+    - **detector overhead**: the steady-state per-step cost of
+      :meth:`SdcDetector.observe` (host-side Python on a handful of
+      floats), gated under the tracer-overhead budget
+      (``TRACER_OVERHEAD_GATE_PCT`` / ``TRACER_OVERHEAD_FLOOR_MS``) —
+      an always-on fence must be too cheap to ever turn off.
+
+    Keys: ``sdc_*``; ``--smoke`` exits nonzero when any gate fails.
+    """
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tools"))
+    try:
+        import chaos
+    finally:
+        sys.path.pop(0)
+
+    r = chaos.run_scenario("sdc_quarantine", seed=7)
+    results["sdc_quarantine_ok"] = bool(r.get("ok"))
+    results["sdc_detect_steps"] = r.get("detect_steps")
+    results["sdc_convicted_exact"] = bool(
+        r.get("convicted") == [r.get("injected_lane")]
+    )
+    results["sdc_rollback_ok"] = bool(
+        r.get("verified_step", -1) >= 0
+        and r.get("halted_step") == r.get("verified_step")
+        and r.get("resumed_step") == r.get("verified_step")
+        and (r.get("goodput_replay_s") or 0) > 0
+    )
+    results["sdc_loss_bitwise"] = bool(r.get("loss_bitwise"))
+    results["sdc_excluded_from_world"] = bool(
+        r.get("injected_lane") in r.get("excluded_ranks", [])
+        and r.get("injected_lane") not in r.get("world_ranks", [])
+        and len(r.get("world_ranks", [])) == 3
+    )
+    results["sdc_rollback_steps_lost"] = (
+        (r.get("detect_step") or 0) - (r.get("verified_step") or 0)
+    )
+
+    innocent = r.get("innocent_convictions", 1)
+    import tempfile as _tf
+
+    for seed in SDC_EXTRA_SEEDS:
+        with _tf.TemporaryDirectory(prefix="dlrover_sdc_bench_") as wd:
+            c = chaos.sdc_convict_only(seed, wd)
+        innocent += c.get("innocent_convictions", 1)
+        if not c.get("ok"):
+            results[f"sdc_convict_seed{seed}_ok"] = False
+    results["sdc_innocent_convictions"] = innocent
+    results["sdc_seeds_swept"] = 1 + len(SDC_EXTRA_SEEDS)
+
+    # steady-state detector cost: clean observations (the common case —
+    # every anomaly-free step pays exactly this)
+    from dlrover_tpu.parallel.sdc import SdcDetector
+
+    det = SdcDetector(n_lanes=8)
+    rng = np.random.default_rng(0)
+    lanes = rng.uniform(0.9, 1.1, size=(512, 8))
+    for i in range(64):  # warm the window
+        det.observe(i, 1.0, lanes[i % 512])
+    # best-of-segments (the drift-hardened idiom): the detector's true
+    # per-step cost is what the gate prices, not scheduler noise from
+    # whatever else the bench process is doing — a single long loop
+    # absorbs every preemption that lands inside it
+    per_step_s = math.inf
+    step = 64
+    for _ in range(8):
+        t0 = time.perf_counter()
+        for i in range(128):
+            det.observe(step, 1.0, lanes[(step + i) % 512])
+        per_step_s = min(
+            per_step_s, (time.perf_counter() - t0) / 128
+        )
+        step += 128
+    results["sdc_detector_overhead_ms"] = round(per_step_s * 1e3, 4)
+    # same two-clause budget as the tracer: percentage gate against a
+    # smoke-scale step, absolute noise floor below it
+    step_s = (results.get("trace_step_ms_off") or 100.0) / 1e3
+    overhead_pct = 100.0 * per_step_s / step_s
+    results["sdc_detector_overhead_pct"] = round(overhead_pct, 3)
+    results["sdc_overhead_ok"] = bool(
+        overhead_pct <= TRACER_OVERHEAD_GATE_PCT
+        or per_step_s * 1e3 <= TRACER_OVERHEAD_FLOOR_MS
+    )
+
+
 # the sparse-embedding gates (ISSUE 12). Overlap: the device-tier
 # pipelined cycle must beat the synchronous host gather→step→scatter
 # cycle by at least 5% on the smoke config (measured steady-state
@@ -4363,6 +4472,10 @@ def run_smoke() -> int:
     except Exception as e:
         results["chaos_error"] = repr(e)
     try:
+        run_sdc_bench(jax, results, smoke=True)
+    except Exception as e:
+        results["sdc_error"] = repr(e)
+    try:
         run_sparse_bench(jax, results, smoke=True)
     except Exception as e:
         results["sparse_error"] = repr(e)
@@ -4551,6 +4664,23 @@ def run_smoke() -> int:
             results["chaos_kill_lost_steps"]
             <= results["chaos_kill_commit_interval"]
         )
+        # the SDC gates (ISSUE 20): the injected wrong-but-finite chip
+        # must be detected within the step gate, convicted EXACTLY (no
+        # innocent conviction across three seeds / three lanes),
+        # rolled back to the verified step with bitwise loss
+        # continuity on the clean remainder, quarantined out of the
+        # next rendezvous world, and the always-on detector must cost
+        # less than the tracer-overhead budget
+        and "sdc_error" not in results
+        and results.get("sdc_quarantine_ok") is True
+        and results.get("sdc_detect_steps") is not None
+        and results["sdc_detect_steps"] <= SDC_DETECT_STEP_GATE
+        and results.get("sdc_convicted_exact") is True
+        and results.get("sdc_innocent_convictions") == 0
+        and results.get("sdc_rollback_ok") is True
+        and results.get("sdc_loss_bitwise") is True
+        and results.get("sdc_excluded_from_world") is True
+        and results.get("sdc_overhead_ok") is True
         # the sparse-embedding gates (ISSUE 12): the overlapped
         # device-tier cycle must be strictly faster than the
         # synchronous host gather/scatter cycle (documented floor
@@ -4871,6 +5001,11 @@ def main() -> int:
     except Exception as e:
         results["chaos_evict_ok"] = None
         results["chaos_error"] = repr(e)
+    try:
+        run_sdc_bench(jax, results)
+    except Exception as e:
+        results["sdc_quarantine_ok"] = None
+        results["sdc_error"] = repr(e)
     try:
         run_sparse_bench(jax, results)
     except Exception as e:

@@ -258,9 +258,14 @@ def bad_node_exclusion(
     ds: Datastore, now: Optional[float] = None, cluster: str = "default"
 ) -> Tuple[str, ...]:
     """Hostnames condemned by the CLUSTER's recent evidence: an
-    oom/failed event in >= BAD_NODE_MIN_JOBS distinct jobs, or sustained
-    hot-cpu events (>= HOT_MIN_EVENTS at >= HOT_CPU_THRESHOLD%), all
-    within ``BAD_NODE_WINDOW_S``. Datastores exposing per-cluster
+    oom/failed event in >= BAD_NODE_MIN_JOBS distinct jobs, sustained
+    hot-cpu events (>= HOT_MIN_EVENTS at >= HOT_CPU_THRESHOLD%), or a
+    single ``sdc_conviction`` event, all within ``BAD_NODE_WINDOW_S``.
+    SDC convictions condemn on ONE event: unlike an oom (often the
+    job's fault), the conviction already carries its own two-peer
+    audit-vote evidence against the chip, and silently-wrong hardware
+    corrupts every job it touches — the scheduler must treat the host
+    as absent capacity immediately. Datastores exposing per-cluster
     config records (``cluster_config``) can override the thresholds
     with ``bad_node_min_jobs`` / ``hot_cpu_threshold`` /
     ``hot_min_events`` — the reference Brain's multi-tenant config."""
@@ -279,6 +284,7 @@ def bad_node_exclusion(
     hot_min = int(cfg.get("hot_min_events", HOT_MIN_EVENTS))
     jobs_by_host: Dict[str, set] = {}
     hot_counts: Dict[str, int] = {}
+    sdc_hosts: set = set()
     for e in ds.node_events(since_ts=now - BAD_NODE_WINDOW_S):
         if not e.hostname:
             continue
@@ -286,10 +292,13 @@ def bad_node_exclusion(
             jobs_by_host.setdefault(e.hostname, set()).add(e.job_name)
         elif e.event == "hot" and e.cpu_percent >= hot_threshold:
             hot_counts[e.hostname] = hot_counts.get(e.hostname, 0) + 1
+        elif e.event == "sdc_conviction":
+            sdc_hosts.add(e.hostname)
     bad = {
         h for h, jobs in jobs_by_host.items() if len(jobs) >= min_jobs
     }
     bad |= {h for h, n in hot_counts.items() if n >= hot_min}
+    bad |= sdc_hosts
     return tuple(sorted(bad))
 
 

@@ -61,6 +61,12 @@ class NodeExitReason:
     # gap is booked to the `eviction` goodput category, and the Brain
     # prices the job's floor/dwell accordingly
     PREEMPTED = "preempted"
+    # convicted of silent data corruption by the paired-device audit
+    # vote (parallel/sdc.py): the chip computes wrong-but-finite
+    # numbers, so it must NEVER rejoin — permanent rendezvous
+    # quarantine until hardware replacement, and the scheduler treats
+    # the host as absent capacity
+    SDC_QUARANTINED = "sdc_quarantined"
 
 
 class JobExitReason:

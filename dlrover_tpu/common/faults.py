@@ -38,6 +38,13 @@ Fault kinds:
   durability); at fixed-size sites (shm) the tail is zeroed instead;
 - ``bit_flip`` — flip one seeded bit of the payload (bit rot / DMA
   corruption);
+- ``scale`` — multiply a deterministic slice of a *numeric* payload by
+  ``SCALE_FACTOR`` (silent data corruption: a chip computing
+  wrong-but-FINITE numbers — a bit flip on f32 usually yields NaN,
+  which a cheap finite fence catches trivially; finite-but-wrong is the
+  case the SDC detector must earn). Only meaningful at
+  :func:`corrupt_array` sites; :func:`corrupt` on raw bytes ignores it
+  (no dtype to scale);
 - ``kill`` — hard process death (``os._exit(137)``, no atexit, no
   flushes): a SIGKILL/OOM-killer/hard-preemption stand-in the chaos
   harness (``tools/chaos.py``) scripts at sites like ``node.preempt``.
@@ -74,8 +81,21 @@ ENV_VAR = "DLROVER_TPU_FAULTS"
 # race windows deterministically, not to stall test suites)
 DELAY_S = 0.05
 
-KINDS = ("enospc", "io_error", "delay", "torn_write", "bit_flip", "kill")
-_DATA_KINDS = ("torn_write", "bit_flip")
+KINDS = (
+    "enospc",
+    "io_error",
+    "delay",
+    "torn_write",
+    "bit_flip",
+    "scale",
+    "kill",
+)
+_DATA_KINDS = ("torn_write", "bit_flip", "scale")
+
+# the ``scale`` kind's corruption factor: large enough that a robust
+# z-score over replica peers saturates, small enough to stay finite
+# through a full fp32 backward pass (the point of the kind)
+SCALE_FACTOR = 32.0
 
 # the registered sites — arming a typo'd site is a hard error, so a
 # chaos matrix can never silently test nothing. Production code may
@@ -103,6 +123,9 @@ FAULT_SITES = frozenset(
         "serve.stale_read",  # between zero-copy map and the seqlock
         # generation re-check (a delay here widens the torn-frame
         # race window deterministically)
+        "device.sdc",  # one device silently computing wrong numbers
+        # (``scale`` corrupts that lane's local gradient; the SDC
+        # detector/audit chain must convict exactly that device)
     }
 )
 
@@ -329,6 +352,10 @@ class FaultInjector:
                 if armed.draw():
                     self._raise_or_delay(site, armed)
                 continue
+            if kind == "scale":
+                # raw bytes carry no dtype to scale — the kind only
+                # acts at corrupt_array sites
+                continue
             if not armed.draw():
                 continue
             self._count(site, kind)
@@ -349,8 +376,10 @@ class FaultInjector:
     def corrupt_array(self, site: str, arr: np.ndarray) -> np.ndarray:
         """Array flavor of :meth:`corrupt` for fixed-size destinations
         (shm chunks): ``bit_flip`` flips one seeded bit in a copy,
-        ``torn_write`` zeroes the tail half (a partial memcpy) — the
-        byte length never changes."""
+        ``torn_write`` zeroes the tail half (a partial memcpy),
+        ``scale`` multiplies a deterministic slice of a numeric array
+        by ``SCALE_FACTOR`` (finite-but-wrong values, the shape a
+        silently-bad chip produces) — the byte length never changes."""
         for armed in self._armed_for(site):
             kind = armed.spec.kind
             if kind not in _DATA_KINDS:
@@ -360,6 +389,24 @@ class FaultInjector:
             if not armed.draw():
                 continue
             self._count(site, kind)
+            if kind == "scale":
+                # operate on the TYPED values, not the byte view: the
+                # corruption must stay finite and dtype-shaped
+                typed = np.ascontiguousarray(arr).reshape(-1).copy()
+                if typed.size == 0 or not np.issubdtype(
+                    typed.dtype, np.number
+                ):
+                    continue
+                span = max(1, typed.size // 8)
+                start = int(
+                    armed.uniform() * max(1, typed.size - span)
+                ) % typed.size
+                typed[start:start + span] = (
+                    typed[start:start + span]
+                    * typed.dtype.type(SCALE_FACTOR)
+                )
+                arr = typed
+                continue
             flat = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
             flat = flat.copy()
             if flat.size == 0:

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.constants import (
     NodeEventType,
@@ -64,6 +64,14 @@ class JobManager:
         # auto-scaler pre-arming and telemetry maintenance here —
         # cb(node_type, node_id, grace_s, drain_ms)
         self._eviction_listeners: List[Callable] = []
+        # SDC conviction listeners (the master wires permanent
+        # rendezvous quarantine, scheduler anti-affinity and telemetry
+        # maintenance here) — cb(node_type, node_id, detail)
+        self._sdc_listeners: List[Callable] = []
+        # (node_type, node_id) convicted of silent data corruption:
+        # quarantined capacity, treated as absent until hardware
+        # replacement clears it
+        self._quarantined: List[Tuple[str, int]] = []
         # bounded log of non-fatal node incidents (degraded checkpoint
         # mode, recoveries, ...): queryable by operators/tests and
         # mirrored to the Brain when a reporter is wired
@@ -330,6 +338,55 @@ class JobManager:
                 cb(node_type, node_id, grace_s, drain_ms)
             except Exception as e:
                 logger.warning(f"eviction listener failed: {e!r}")
+
+    # -- silent-data-corruption quarantine (parallel/sdc.py tier 3) ----
+    def add_sdc_listener(self, cb: Callable):
+        """``cb(node_type, node_id, detail)`` fires on every SDC
+        conviction (the master wires permanent rendezvous quarantine,
+        scheduler anti-affinity and telemetry maintenance here)."""
+        self._sdc_listeners.append(cb)
+
+    def handle_sdc_conviction(
+        self, node_type: str, node_id: int, detail: str = ""
+    ):
+        """A worker's paired-device audit convicted this node's chip of
+        silent data corruption. Unlike an eviction this is NOT a
+        scheduled departure the node recovers from: the hardware lies,
+        so the node is quarantined — breakdown status, permanent
+        rendezvous exclusion via the listeners, and a
+        ``sdc_conviction`` node event (carrying the vote-matrix
+        evidence) rides to the Brain so the cluster-wide exclusion list
+        condemns the host for every job. Idempotent per node."""
+        node = self.get_node(node_type, node_id)
+        key = (node_type, node_id)
+        with self._lock:
+            already = key in self._quarantined
+            if not already:
+                self._quarantined.append(key)
+        if node is not None:
+            node.exit_reason = NodeExitReason.SDC_QUARANTINED
+            node.update_status(NodeStatus.BREAKDOWN)
+        self.record_node_event(
+            node_type, node_id, "sdc_conviction", detail
+        )
+        logger.error(
+            f"sdc conviction for {node_type}-{node_id}: chip "
+            f"quarantined (treated as absent capacity until hardware "
+            f"replacement)"
+        )
+        if already:
+            return
+        for cb in self._sdc_listeners:
+            try:
+                cb(node_type, node_id, detail)
+            except Exception as e:
+                logger.warning(f"sdc listener failed: {e!r}")
+
+    def quarantined_nodes(self) -> List[Tuple[str, int]]:
+        """Nodes convicted of silent data corruption this master's
+        lifetime — absent capacity for every scheduling decision."""
+        with self._lock:
+            return list(self._quarantined)
 
     def record_node_event(
         self, node_type: str, node_id: int, event: str, detail: str = ""

@@ -174,14 +174,20 @@ class LocalJobMaster:
         # maintenance window so the deliberate drain stall is never
         # attributed as a straggler or hang
         self.job_manager.add_eviction_listener(self._on_eviction_notice)
+        # SDC convictions fan out here too: permanent rendezvous
+        # quarantine (the chip must never rejoin), scheduler
+        # anti-affinity for the convicted host, and the same telemetry
+        # maintenance window — the convicted worker's rollback-replay
+        # is deliberate, not a straggler or a hang
+        self.job_manager.add_sdc_listener(self._on_sdc_conviction)
         # ...and the rank's HEALTHY replacement must not inherit the
         # doomed incarnation's exclusion: any relaunch/replacement of
-        # a rank clears it immediately instead of waiting out the TTL
+        # a rank clears it immediately instead of waiting out the TTL.
+        # EXCEPT quarantined ranks — a relaunch is the same silicon;
+        # only explicit hardware replacement (clear_exclusion by the
+        # operator path) lifts an SDC quarantine
         self.job_manager.add_relaunch_listener(
-            lambda old, new: [
-                mgr.clear_exclusion(new.rank_index)
-                for mgr in self.rdzv_managers.values()
-            ]
+            self._on_relaunch_clear_exclusion
         )
         self._server = None
         self._brain_end_thread: Optional[threading.Thread] = None
@@ -329,6 +335,45 @@ class LocalJobMaster:
         for mgr in self.rdzv_managers.values():
             mgr.exclude_node(rank, ttl_s=ttl)
         self.auto_scaler.note_eviction(node_id, grace_s=grace_s)
+
+    def _on_relaunch_clear_exclusion(self, old, new):
+        """A relaunched/replaced rank sheds its eviction exclusion —
+        but never an SDC quarantine (same rank after a relaunch means
+        the same convicted chip)."""
+        quarantined_ranks = set()
+        for nt, nid in self.job_manager.quarantined_nodes():
+            n = self.job_manager.get_node(nt, nid)
+            quarantined_ranks.add(
+                n.rank_index if n is not None else nid
+            )
+        # the replacement carries a fresh node id but the SAME rank —
+        # rank is what rendezvous excludes, so rank is what must hold
+        if new.rank_index in quarantined_ranks:
+            return
+        for mgr in self.rdzv_managers.values():
+            mgr.clear_exclusion(new.rank_index)
+
+    def _on_sdc_conviction(
+        self, node_type: str, node_id: int, detail: str
+    ):
+        """JobManager SDC-listener leg: quarantine the convicted rank
+        out of every rendezvous plane permanently, hand the scheduler
+        the host as anti-affinity (absent capacity), and open a
+        telemetry maintenance window over the fleet's rollback-replay
+        so the straggler/hang detectors don't mint alarms against a
+        deliberately-replaying world (PR-19 interop)."""
+        node = self.job_manager.get_node(node_type, node_id)
+        rank = node.rank_index if node is not None else node_id
+        for mgr in self.rdzv_managers.values():
+            mgr.quarantine_node(rank)
+        self.telemetry.note_maintenance(120.0)
+        if node is not None and node.hostname:
+            try:
+                self.auto_scaler.set_exclude_hosts([node.hostname])
+            except Exception as e:
+                logger.warning(
+                    f"sdc anti-affinity for {node.hostname} failed: {e!r}"
+                )
 
     def evict_worker(
         self, node_id: int, grace_s: float = 0.0, reason: str = "operator"

@@ -163,6 +163,20 @@ class RendezvousManager:
             f"{ttl_s:.0f}s (eviction drain)"
         )
 
+    def quarantine_node(self, node_rank: int):
+        """Permanent exclusion (no TTL): the rank's chip was convicted
+        of silent data corruption — hardware that LIES must never
+        rejoin a world, however long it waits. ``clear_exclusion``
+        still lifts it: that is the hardware-replacement path (the
+        replaced rank is new silicon, not the convicted chip)."""
+        with self._lock:
+            self._excluded_until[node_rank] = float("inf")
+            self._waiting_nodes.pop(node_rank, None)
+        logger.warning(
+            f"rdzv[{self.name}]: rank {node_rank} quarantined "
+            f"permanently (sdc conviction)"
+        )
+
     def clear_exclusion(self, node_rank: int):
         with self._lock:
             self._excluded_until.pop(node_rank, None)

@@ -660,9 +660,48 @@ def build_train_step(
             if getattr(plan, "compressed", False)
             else None
         )
-        grads, new_residual, gnorm = sync_grads(
-            g_stacked, mesh, plan, residual=residual
-        )
+        from dlrover_tpu.parallel import sdc as sdc_mod
+
+        sdc_on = sdc_mod.enabled()
+        dev_norms = None
+        if sdc_on and not getattr(plan, "three_d", False):
+            # SDC injection (site device.sdc, kind scale): resolved
+            # ONCE at trace time into a per-lane scale vector baked
+            # into the compiled step — lane ``inj.device`` multiplies
+            # its LOCAL gradient by the finite corruption factor from
+            # step ``inj.from_step`` on, exactly what a silently-bad
+            # chip does. Baking means conviction must retire this
+            # incarnation (the trainer halts and the master excludes
+            # the chip from the next world) — which is the real
+            # quarantine-drain model anyway.
+            inj = sdc_mod.injection_plan(plan.total)
+            if inj is not None:
+                sv = (
+                    jnp.ones((plan.total,), jnp.float32)
+                    .at[inj.device]
+                    .set(jnp.float32(inj.factor))
+                )
+                sv = jnp.where(
+                    state.step + 1 >= inj.from_step,
+                    sv,
+                    jnp.ones((plan.total,), jnp.float32),
+                )
+                g_stacked = jax.tree_util.tree_map(
+                    lambda g: g
+                    * sv.reshape((plan.total,) + (1,) * (g.ndim - 1)),
+                    g_stacked,
+                )
+            grads, new_residual, gnorm, dev_norms = sync_grads(
+                g_stacked,
+                mesh,
+                plan,
+                residual=residual,
+                device_norms=True,
+            )
+        else:
+            grads, new_residual, gnorm = sync_grads(
+                g_stacked, mesh, plan, residual=residual
+            )
         grads = jax.tree_util.tree_map(
             lambda g, sh: jax.lax.with_sharding_constraint(g, sh),
             grads,
@@ -674,7 +713,7 @@ def build_train_step(
             gnorm = optax.global_norm(grads)
         if residual is None:
             new_residual = state.grad_residual
-        return loss, aux, grads, gnorm, new_residual
+        return loss, aux, grads, gnorm, new_residual, dev_norms
 
     def gspmd_grads(state, tokens, targets):
         """The default path: XLA's implicit sync. Microbatch grads
@@ -717,13 +756,14 @@ def build_train_step(
         return loss, aux, grads, optax.global_norm(grads), None
 
     def train_step(state: TrainState, tokens, targets):
+        dev_norms = None
         if plan is not None and getattr(plan, "kind", "") == "ep":
             loss, aux, grads, gnorm, new_residual = ep_synced_grads(
                 state, tokens, targets
             )
         elif plan is not None:
-            loss, aux, grads, gnorm, new_residual = synced_grads(
-                state, tokens, targets
+            loss, aux, grads, gnorm, new_residual, dev_norms = (
+                synced_grads(state, tokens, targets)
             )
         else:
             loss, aux, grads, gnorm, _ = gspmd_grads(
@@ -742,6 +782,11 @@ def build_train_step(
             new_opt = offload_tree(new_opt, opt_sh)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, "grad_norm": gnorm}
+        if dev_norms is not None:
+            # SDC tier-1 fence input: each lane's LOCAL pre-sync grad
+            # norm (a [plan.total] vector — consumers that report
+            # scalars must pop it, same contract as moe_expert_load)
+            metrics["sdc_device_norms"] = dev_norms
         if cfg.num_experts:
             metrics["moe_balance_loss"] = aux["balance"]
             metrics["moe_z_loss"] = aux["z"]

@@ -1715,6 +1715,7 @@ def sync_grads(
     plan: BucketPlan,
     residual: Optional[Tuple] = None,
     _legs: str = "all",
+    device_norms: bool = False,
 ):
     """Bucketed sync of per-device local grads → (synced grad tree,
     new residual tuple or None, global grad norm).
@@ -1738,6 +1739,16 @@ def sync_grads(
     The grad norm falls out of the bucket walk (sum of squares of each
     synced bucket, padding is zero) — callers must NOT run a second
     ``optax.global_norm`` pass over the tree.
+
+    ``device_norms=True`` additionally returns a 4th element: the
+    ``[plan.total]`` vector of each device's LOCAL (pre-sync) grad
+    norm, riding the same shard_map out-spec as the residuals — one
+    extra sum-of-squares per bucket inside the walk, no extra
+    collective. This is the SDC tier-1 fence input: a silently-bad
+    chip shows up as one divergent lane BEFORE the mean averages its
+    corruption into everyone (and NaN/Inf propagates into its lane, so
+    the finite check rides free). Shape of the return switches to
+    ``(tree, new_res, gnorm, dev_norms)``.
     """
     import jax
     import jax.numpy as jnp
@@ -1746,7 +1757,8 @@ def sync_grads(
     from dlrover_tpu.common.jax_compat import shard_map
 
     if plan.three_d:
-        return _sync_grads_3d(stacked_grads, mesh, plan)
+        out = _sync_grads_3d(stacked_grads, mesh, plan)
+        return out + (None,) if device_norms else out
     leaves, treedef = jax.tree_util.tree_flatten(stacked_grads)
     ef = plan.compressed and residual is not None
     res_in = tuple(residual) if ef else ()
@@ -1756,8 +1768,13 @@ def sync_grads(
         flats: List = []
         new_res: List = []
         sumsq = jnp.float32(0.0)
+        local_ss = jnp.float32(0.0)
         for b in plan.buckets:
             flat = _bucket_flat(local, b, plan.dp)
+            if device_norms:
+                # pre-sync: this device's own numbers, before any
+                # collective mixes lanes
+                local_ss = local_ss + jnp.sum(flat * flat)
             r = res_in[b.index][0] if ef else None
             mean, nr, ss = _sync_one_bucket(
                 flat, r, plan, legs=_legs
@@ -1766,7 +1783,10 @@ def sync_grads(
             flats.append(mean)
             if ef:
                 new_res.append(nr[None])
-        return tuple(flats), tuple(new_res), sumsq[None]
+        out = (tuple(flats), tuple(new_res), sumsq[None])
+        if device_norms:
+            out = out + (local_ss[None],)
+        return out
 
     stacked = P(plan.stack_axes)
     # ZeRO buckets come out sharded over fsdp (no gather leg); dp and
@@ -1777,21 +1797,25 @@ def sync_grads(
         # manual over dp only; tp/sp stay GSPMD ("auto") axes so the
         # sharded matmuls around this sync keep their native schedule
         kw["axis_names"] = ("dp",)
-    flats, new_res, sumsq = shard_map(
+    out_specs = (
+        tuple(bucket_out for _ in plan.buckets),
+        tuple(stacked for _ in res_in),
+        stacked,
+    )
+    if device_norms:
+        out_specs = out_specs + (stacked,)
+    res = shard_map(
         body,
         mesh=mesh,
         in_specs=(
             tuple(stacked for _ in leaves),
             tuple(stacked for _ in res_in),
         ),
-        out_specs=(
-            tuple(bucket_out for _ in plan.buckets),
-            tuple(stacked for _ in res_in),
-            stacked,
-        ),
+        out_specs=out_specs,
         check_vma=False,
         **kw,
     )(tuple(leaves), res_in)
+    flats, new_res, sumsq = res[0], res[1], res[2]
     out_parts: List = []
     for b, flat in zip(plan.buckets, flats):
         out_parts.extend(_unflatten_bucket(flat, b, plan))
@@ -1799,11 +1823,10 @@ def sync_grads(
     # fsdp chunk (ZeRO — the chunks partition the bucket, so summing
     # over all total devices still counts every element dp times)
     gnorm = jnp.sqrt(jnp.sum(sumsq) / plan.dp)
-    return (
-        jax.tree_util.tree_unflatten(treedef, out_parts),
-        new_res if ef else None,
-        gnorm,
-    )
+    tree = jax.tree_util.tree_unflatten(treedef, out_parts)
+    if device_norms:
+        return tree, new_res if ef else None, gnorm, jnp.sqrt(res[3])
+    return tree, new_res if ef else None, gnorm
 
 
 def _sync_grads_3d(stacked_grads: Any, mesh, plan: BucketPlan):

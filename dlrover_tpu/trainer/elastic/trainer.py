@@ -151,6 +151,23 @@ class TrainerConfig:
     # of the grace window after the shm commit — the degraded-mode shm
     # handoff (agent persists shm on restart) already covers it
     eviction_persist_floor_s: float = 5.0
+    # -- silent-data-corruption defense (parallel/sdc.py, ISSUE 20) ----
+    # tier-1 fence: per-lane local grad norms ride the sync out-spec
+    # and a robust median+MAD detector classifies each step (data
+    # spike: skip-and-log; device suspect: escalate to the paired
+    # audit probe; conviction: verified rollback + quarantine halt).
+    # DLROVER_TPU_SDC=1 enables without the knob; explicit dp-family
+    # sync plans only (comm_overlap/grad_compress — the per-lane
+    # vector falls out of the bucket walk there)
+    sdc_detect: bool = False
+    sdc_window: int = 32  # clean-step window behind the temporal test
+    sdc_min_history: int = 8  # observations before that test arms
+    sdc_spike_sigma: float = 6.0  # temporal (data-spike) threshold
+    sdc_suspect_sigma: float = 6.0  # cross-lane (device) threshold
+    # >0: also audit every N steps regardless of suspicion (a chip can
+    # be wrong in ways the norm fence misses);
+    # DLROVER_TPU_SDC_AUDIT_STEPS overrides
+    sdc_audit_steps: int = 0
 
 
 def build_optimizer(
@@ -292,6 +309,15 @@ class ElasticTrainer:
         # artifacts from the SAME model config and optimizer
         self._model_cfg = model_cfg
         self._tx = tx
+        # SDC defense must be switched on BEFORE the step is built:
+        # build_train_step reads the module switch at trace time to
+        # decide whether the per-lane norm vector rides the sync (the
+        # module-level switch covers the donating twin, the dry-runner
+        # and resize rebuilds consistently — no signature threading)
+        if self.tcfg.sdc_detect:
+            from dlrover_tpu.parallel import sdc as _sdc
+
+            _sdc.set_enabled(True)
         # async flash staging reads state buffers after the step returns,
         # so the production step must NOT donate them
         self.accel: AccelerateResult = auto_accelerate(
@@ -458,6 +484,7 @@ class ElasticTrainer:
         self._link_fp: Optional[str] = None
         self._setup_link_model()
         self._setup_grad_sync()
+        self._setup_sdc()
         self._audit_cal_loaded = False
         self._setup_audit_budget()
         self._state_nbytes = sum(
@@ -746,6 +773,217 @@ class ElasticTrainer:
                 "detail": detail,
             },
         )
+
+    # -- silent-data-corruption defense (parallel/sdc.py, ISSUE 20) ----
+    def _setup_sdc(self):
+        """Build the tier-1 detector + tier-2 probe for the CURRENT
+        world (lane count = the sync plan's device total). Re-run after
+        a resize — the lane axis is per-world. Detection needs the
+        explicit dp-family sync path: that is where the per-lane norm
+        vector falls out of the bucket walk for free."""
+        from dlrover_tpu.parallel import sdc as sdc_mod
+
+        self._sdc: Optional[sdc_mod.SdcDetector] = None
+        self._sdc_probe = None
+        # 1-step-delayed (step, loss_ref, norms_ref): the freshly
+        # dispatched step's outputs stay on device; the PREVIOUS
+        # step's are already materialized by dispatch depth, so the
+        # fetch adds no host sync to the critical path
+        self._sdc_pending = None
+        self._sdc_halt = False
+        self.sdc_convicted: tuple = ()
+        self.sdc_detect_step: Optional[int] = None
+        if not (self.tcfg.sdc_detect or sdc_mod.enabled()):
+            return
+        plan = self._grad_sync_plan
+        if (
+            plan is None
+            or getattr(plan, "three_d", False)
+            or getattr(plan, "kind", "") == "ep"
+        ):
+            logger.warning(
+                "sdc detection requested but this mesh has no per-lane"
+                " norm path (needs the explicit dp/ZeRO/tp sync plan —"
+                " comm_overlap or grad_compress); fences disabled"
+            )
+            return
+        cfg = sdc_mod.SdcConfig(
+            window=self.tcfg.sdc_window,
+            min_history=self.tcfg.sdc_min_history,
+            spike_sigma=self.tcfg.sdc_spike_sigma,
+            suspect_sigma=self.tcfg.sdc_suspect_sigma,
+            audit_steps=sdc_mod.audit_steps_from_env(
+                self.tcfg.sdc_audit_steps
+            ),
+        )
+        self._sdc = sdc_mod.SdcDetector(plan.total, cfg)
+        # lane i of the norm vector is device i of the mesh's stacked
+        # data axes — the probe must vote over the same ordering
+        self._sdc_probe = sdc_mod.AuditProbe(
+            devices=list(self.mesh.devices.flatten())
+        )
+        logger.info(
+            f"sdc defense armed: {plan.total} lanes, window "
+            f"{cfg.window}, suspect sigma {cfg.suspect_sigma}, audit "
+            f"cadence {cfg.audit_steps or 'on-suspicion'}"
+        )
+
+    def _sdc_step(self, step: int, metrics: Dict, dev_norms):
+        """One detector observation per step (1-step delayed). Tier-1
+        verdicts route: data spike → count + log + black-box event
+        (never escalates — satellite 3's false-positive gate); device
+        suspect → tier-2 paired audit; audit conviction → tier-3
+        response (:meth:`_sdc_convict`)."""
+        # graftlint fault-site coverage + control-kind composability:
+        # device.sdc control kinds (delay — "the bad chip is also
+        # slow") fire here; the scale kind itself is a data kind baked
+        # into the step at trace time (models/train.py)
+        faults.fire("device.sdc")
+        pending, self._sdc_pending = self._sdc_pending, (
+            (step, metrics.get("loss"), dev_norms)
+            if dev_norms is not None
+            else None
+        )
+        if pending is None:
+            return
+        p_step, p_loss, p_norms = pending
+        try:
+            loss = float(p_loss)
+            norms = np.asarray(p_norms, dtype=np.float64).reshape(-1)
+        except Exception as e:
+            logger.warning(
+                f"sdc: fetching step {p_step} telemetry failed: {e!r}"
+            )
+            return
+        verdict = self._sdc.observe(p_step, loss, norms)
+        suspects: tuple = ()
+        if verdict.kind == "data_spike":
+            self._registry.counter(
+                "dlrover_sdc_data_spikes_total",
+                "steps classified as data spikes (skipped, not escalated)",
+            ).inc()
+            detail = (
+                f"step {p_step} (batch at sampler position "
+                f"{self.sampler.state_dict().get('completed_num', -1)})"
+                f": {verdict.detail}"
+            )
+            self._flight.note_event("sdc_data_spike", detail)
+            logger.warning(f"sdc data spike, skip-and-log: {detail}")
+        elif verdict.kind == "device_suspect":
+            self._registry.counter(
+                "dlrover_sdc_suspicions_total",
+                "tier-1 device-suspect verdicts (escalated to audit)",
+            ).inc()
+            if self.sdc_detect_step is None:
+                self.sdc_detect_step = p_step
+            logger.warning(
+                f"sdc device suspect at step {p_step}: lanes "
+                f"{list(verdict.suspects)} ({verdict.detail})"
+            )
+            suspects = verdict.suspects
+        cadence = self._sdc.cfg.audit_steps
+        if suspects or (cadence and p_step % cadence == 0):
+            self._registry.counter(
+                "dlrover_sdc_audits_run_total",
+                "tier-2 paired-device audit probes executed",
+            ).inc()
+            result = self._sdc_probe.run(p_step, suspects=suspects)
+            if result.convicted:
+                self._sdc_convict(p_step, result, verdict)
+            elif suspects and not result.inconclusive:
+                logger.info(
+                    f"sdc audit cleared lanes {list(suspects)} at step "
+                    f"{p_step} (bitwise agreement across rotated pairs)"
+                )
+
+    def _sdc_convict(self, step: int, result, verdict):
+        """Tier-3 response: evidence bundle (norm history + vote
+        matrix), ``sdc_conviction`` event to the master/Brain, verified
+        rollback with the downtime booked to ``restart_replay``, then
+        HALT this incarnation — the injected corruption is baked into
+        the compiled step (exactly like a real bad chip is baked into
+        the hardware), so the quarantine-drain model applies: the
+        master excludes the convicted host and the next world
+        re-assembles without it."""
+        import json as _json
+
+        from dlrover_tpu.parallel.grad_sync import ensure_residual
+
+        self.sdc_convicted = tuple(result.convicted)
+        evidence = {
+            "step": step,
+            "convicted": list(result.convicted),
+            "votes": {
+                str(lane): [[p, bool(a)] for p, a in vv]
+                for lane, vv in result.votes.items()
+            },
+            "digests": list(result.digests),
+            "suspect_detail": verdict.detail if verdict else "",
+            "norm_history": self._sdc.history(),
+        }
+        self._registry.counter(
+            "dlrover_sdc_convictions_total",
+            "devices convicted by the paired audit vote",
+        ).inc(len(result.convicted))
+        self._flight.note_event(
+            "sdc_conviction",
+            f"lanes {list(result.convicted)} at step {step}",
+        )
+        self._flight.dump("sdc_conviction", extra=evidence, force=True)
+        if self._event_reporter is not None:
+            try:
+                self._event_reporter(
+                    "sdc_conviction", _json.dumps(evidence)
+                )
+            except Exception as e:
+                logger.warning(f"sdc conviction report failed: {e!r}")
+        # PR-19 interop: the rollback stall and the replayed window are
+        # deliberate — the hang watchdog must not dump a bundle for
+        # them, and the step auditor must not reconcile pre-rollback
+        # spans against the post-rollback budget
+        self._flight.suppress_watchdog(120.0)
+        rolled_to = -1
+        if self._ckptr is not None:
+            self._goodput.replay_begin()
+            try:
+                tgt, restored = self._ckptr.load_checkpoint(
+                    self._ckpt_state()
+                )
+                if restored is not None and tgt >= 0:
+                    self.state = ensure_residual(
+                        restored["train"], self._grad_sync_plan, self.mesh
+                    )
+                    self.sampler.load_state_dict(restored["sampler"])
+                    rolled_to = tgt
+                    lost = max(0, step - tgt)
+                    self._registry.gauge(
+                        "dlrover_sdc_rollback_steps_lost",
+                        "steps discarded by the last SDC rollback",
+                    ).set(lost)
+                else:
+                    logger.error(
+                        "sdc conviction: no verified checkpoint to "
+                        "roll back to — halting with corrupt state "
+                        "DISCARDED by the restart"
+                    )
+            finally:
+                self._goodput.replay_end()
+        logger.error(
+            f"sdc conviction at step {step}: lanes "
+            f"{list(result.convicted)} convicted"
+            + (
+                f"; rolled back to verified step {rolled_to}"
+                if rolled_to >= 0
+                else ""
+            )
+            + "; halting for quarantine-drain"
+        )
+        # the detector's window described the corrupted trajectory and
+        # the auditor's recorded spans the pre-rollback incarnation
+        self._sdc.reset()
+        self._auditor.skip_to_now()
+        self._sdc_pending = None
+        self._sdc_halt = True
 
     def _maybe_rebalance_experts(self, load) -> bool:
         """Fold one measured per-expert routing-load vector into the
@@ -2048,6 +2286,10 @@ class ElasticTrainer:
         # error-feedback residual attached (shapes changed with dp);
         # the timing probe is skipped — downtime window
         self._setup_grad_sync(measure=False)
+        # the SDC lane axis is per-world: rebuild the detector and
+        # probe for the new device total (history from the old world
+        # describes different lanes)
+        self._setup_sdc()
         # spans straddling the rebuild belong to neither world's
         # budget: drop everything buffered so far, then re-price the
         # per-component budget for the new mesh (tests/test_audit.py
@@ -2458,6 +2700,13 @@ class ElasticTrainer:
                     # computes (the engine emits its own ckpt_stage
                     # span)
                     self._advance_stager()
+                    # the per-lane norm vector is detector input, not
+                    # a reporting scalar — pop it before any consumer
+                    # that reports scalars sees it (same contract as
+                    # moe_expert_load)
+                    dev_norms = metrics.pop("sdc_device_norms", None)
+                    if self._sdc is not None:
+                        self._sdc_step(step, metrics, dev_norms)
                     if self._metrics_hook is not None:
                         self._metrics_hook(step, metrics)
                     if (
@@ -2523,6 +2772,16 @@ class ElasticTrainer:
                             step_sp.end()
                             jax.block_until_ready(self.state.params)
                             return self.state
+                    if self._sdc_halt:
+                        # tier-3 conviction already rolled the state
+                        # back — saving at THIS step would commit a
+                        # checkpoint claiming progress the rollback
+                        # discarded. End the step cleanly and halt
+                        # (the quarantine-drain: the master excludes
+                        # the convicted chip; the next incarnation
+                        # resumes from the verified step)
+                        step_sp.end()
+                        break
                     with span("ckpt_save"):
                         self._maybe_save(step)
                     step_sp.end()
@@ -2543,6 +2802,8 @@ class ElasticTrainer:
                     raise
                 if step >= num_steps:
                     break
+            if self._sdc_halt:
+                break
             if self.eviction_pending:
                 # the prefetcher stays up: the emergency checkpoint's
                 # sampler snapshot rewinds by its buffered lookahead

@@ -170,6 +170,34 @@ class TestFaultSpecs:
         arr = np.arange(4.0)
         assert faults.corrupt_array("ckpt.shm_stage", arr) is arr
 
+    def test_corrupt_array_scale_is_finite_but_wrong(self):
+        # the SDC kind: a deterministic slice multiplied by a large
+        # factor — wrong numbers that every finite fence passes
+        faults.configure("device.sdc:scale:1.0:7")
+        arr = np.ones(64, np.float32)
+        out = np.asarray(faults.corrupt_array("device.sdc", arr))
+        assert out.shape == arr.shape
+        assert np.all(np.isfinite(out))
+        scaled = int(np.sum(out == np.float32(faults.SCALE_FACTOR)))
+        assert scaled == 64 // 8  # an eighth of the elements
+        assert int(np.sum(out == 1.0)) == 64 - scaled
+
+    def test_corrupt_array_scale_is_seed_deterministic(self):
+        arr = np.arange(1, 65, dtype=np.float32)
+        faults.configure("device.sdc:scale:1.0:7")
+        a = np.asarray(faults.corrupt_array("device.sdc", arr.copy()))
+        faults.reset()
+        faults.configure("device.sdc:scale:1.0:7")
+        b = np.asarray(faults.corrupt_array("device.sdc", arr.copy()))
+        assert np.array_equal(a, b)
+
+    def test_corrupt_bytes_ignores_scale_kind(self):
+        # bytes carry no dtype to scale: the data kind must act only at
+        # array sites, never rot a byte stream it cannot interpret
+        faults.configure("device.sdc:scale:1.0:7")
+        blob = bytes(range(64))
+        assert faults.corrupt("device.sdc", blob) == blob
+
 
 # ---------------------------------------------------------------------------
 # step-dir integrity primitives

@@ -36,6 +36,11 @@ Scenarios (each takes a seed; the same seed replays the same run):
 |                          | flip after the writer's checksum); the      |
 |                          | serving subscriber names the record, skips  |
 |                          | the generation, adopts the next clean commit|
+| sdc_quarantine           | one chip computes wrong-but-finite numbers  |
+|                          | (`device.sdc:scale`); fence detects, paired |
+|                          | audit convicts exactly that chip, verified  |
+|                          | rollback + permanent rendezvous quarantine, |
+|                          | bitwise resume on the surviving devices     |
 
 Usage:
 
@@ -591,6 +596,277 @@ def serving_crc_retry(seed: int, workdir: str) -> Dict:
 
 
 # ---------------------------------------------------------------------------
+# scenario: silent data corruption -> audit conviction -> quarantine
+# ---------------------------------------------------------------------------
+SDC_ONSET = 6  # 1-based step the injected chip starts lying at
+
+
+def _make_sdc_trainer(ckpt_dir: str, seed: int, metrics_hook=None):
+    """dp=4 variant of :func:`_make_trainer`: the SDC detector needs
+    replica peers to vote against, so the scenario runs four lanes on
+    four (virtual) devices with the tier-1 fences armed."""
+    import jax
+    import optax
+
+    from dlrover_tpu.accel.strategy import Strategy
+    from dlrover_tpu.models import tiny
+    from dlrover_tpu.parallel.mesh import MeshConfig
+    from dlrover_tpu.trainer.elastic.trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
+
+    return ElasticTrainer(
+        model_cfg=tiny(num_layers=1),
+        tx=optax.adamw(1e-2),
+        dataset=_Tokens(seed=seed),
+        trainer_cfg=TrainerConfig(
+            batch_size=8,
+            seq_len=32,
+            ckpt_dir=ckpt_dir,
+            save_memory_interval=SAVE_MEMORY_INTERVAL,
+            # the rollback target must survive the halted incarnation:
+            # commit to storage at the same cadence
+            save_storage_interval=SAVE_MEMORY_INTERVAL,
+            report_metrics=False,
+            log_interval=4,
+            prefetch=0,
+            donation_aware=False,
+            speculative_compile=False,
+            comm_overlap=True,
+            sdc_detect=True,
+        ),
+        strategy=Strategy(mesh=MeshConfig(dp=4), dtype="float32"),
+        devices=list(jax.devices())[:4],
+        metrics_hook=metrics_hook,
+    )
+
+
+def _sdc_cleanup():
+    from dlrover_tpu.common import faults
+    from dlrover_tpu.parallel import sdc as sdc_mod
+
+    faults.reset()
+    sdc_mod.set_enabled(False)
+
+
+def sdc_convict_only(seed: int, workdir: str) -> Dict:
+    """Light leg (no golden / no resume): arm ``device.sdc`` against
+    lane ``seed % 4`` and gate that the audit convicts EXACTLY that
+    lane. The bench runs this across extra seeds as the
+    innocent-conviction sweep."""
+    from dlrover_tpu.common import faults
+
+    faults.reset()
+    expected = seed % 4
+    out: Dict = {
+        "scenario": "sdc_convict_only",
+        "seed": seed,
+        "expected_lane": expected,
+    }
+    faults.configure(f"device.sdc:scale:@{SDC_ONSET}:{seed}")
+    tr = _make_sdc_trainer(
+        os.path.join(workdir, f"sdc_only_{seed}"), seed
+    )
+    try:
+        tr.train(TOTAL_STEPS)
+        out["convicted"] = list(tr.sdc_convicted)
+        out["detect_step"] = tr.sdc_detect_step
+        out["halted_step"] = tr.global_step
+    finally:
+        tr.close()
+        _sdc_cleanup()
+    out["detect_steps"] = (
+        out["detect_step"] - SDC_ONSET + 1
+        if out.get("detect_step") is not None
+        else TOTAL_STEPS
+    )
+    out["innocent_convictions"] = sum(
+        1 for lane in out.get("convicted", []) if lane != expected
+    )
+    out["ok"] = bool(
+        out.get("convicted") == [expected]
+        and out["innocent_convictions"] == 0
+        and out["detect_steps"] <= 10
+    )
+    return out
+
+
+def sdc_quarantine(seed: int, workdir: str) -> Dict:
+    """One chip silently computes wrong-but-finite numbers
+    (``device.sdc:scale:@{onset}:{seed}`` scales lane ``seed % 4``'s
+    local gradient by a large finite factor): the tier-1 fence flags
+    the lane within 10 steps, the paired audit probe convicts exactly
+    the injected chip, the trainer rolls back to the last verified
+    checkpoint (replay booked to ``restart_replay``) and halts the
+    incarnation; the master quarantines the convicted rank out of the
+    next rendezvous world PERMANENTLY; a fresh trainer — the convicted
+    chip replaced, fault disarmed — resumes from the verified step and
+    reproduces the uninterrupted run's losses bitwise."""
+    from dlrover_tpu.common import faults
+    from dlrover_tpu.common.constants import NodeExitReason
+    from dlrover_tpu.master.job_manager import JobManager
+    from dlrover_tpu.master.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+    from dlrover_tpu.obs import flight_recorder as obs_flight
+
+    faults.reset()
+    lane = seed % 4
+    out: Dict = {
+        "scenario": "sdc_quarantine",
+        "seed": seed,
+        "injected_lane": lane,
+    }
+    prev_flight = os.environ.get(obs_flight.ENV_FLIGHT_DIR)
+    os.environ[obs_flight.ENV_FLIGHT_DIR] = os.path.join(
+        workdir, "flight"
+    )
+    threads_before = _thread_names()
+    golden_dir = os.path.join(workdir, "golden_ckpt")
+    ckpt_dir = os.path.join(workdir, "sdc_ckpt")
+
+    try:
+        # golden: the uninterrupted dp=4 trajectory, detector armed but
+        # nothing to find (the step graph must be the same one the
+        # faulted and resumed runs trace)
+        golden: Dict[int, float] = {}
+        t = _make_sdc_trainer(golden_dir, seed, _loss_recorder(golden))
+        try:
+            t.train(TOTAL_STEPS)
+        finally:
+            t.close()
+
+        # the in-process master: conviction events fan out to permanent
+        # rendezvous quarantine, exactly as LocalJobMaster wires it
+        jm = JobManager()
+        jm.create_initial_nodes(4)
+        rdzv = ElasticTrainingRendezvousManager()
+        rdzv.update_rdzv_params(
+            min_nodes=1, max_nodes=4, waiting_timeout=0.0
+        )
+        jm.add_sdc_listener(
+            lambda nt, nid, detail: rdzv.quarantine_node(nid)
+        )
+        events: List[str] = []
+
+        def reporter(event: str, detail: str):
+            events.append(event)
+            if event != "sdc_conviction":
+                return
+            for convicted in json.loads(detail).get("convicted", []):
+                jm.handle_sdc_conviction(
+                    "worker", int(convicted), detail="chaos sdc"
+                )
+
+        # run A: the chip goes bad at SDC_ONSET; detect -> audit ->
+        # convict -> rollback -> halt
+        faults.configure(f"device.sdc:scale:@{SDC_ONSET}:{seed}")
+        losses_a: Dict[int, float] = {}
+        tr = _make_sdc_trainer(ckpt_dir, seed, _loss_recorder(losses_a))
+        tr.set_event_reporter(reporter)
+        try:
+            tr.train(TOTAL_STEPS)
+            out["convicted"] = list(tr.sdc_convicted)
+            out["detect_step"] = tr.sdc_detect_step
+            out["halted_step"] = tr.global_step
+            out["verified_step"] = tr._ckptr.latest_verified_step()
+            gp = tr._goodput.snapshot()
+            out["goodput_replay_s"] = round(
+                gp.seconds.get("restart_replay", 0.0), 4
+            )
+        finally:
+            tr.close()
+        faults.reset()
+
+        out["events"] = events
+        out["detect_steps"] = (
+            out["detect_step"] - SDC_ONSET + 1
+            if out.get("detect_step") is not None
+            else TOTAL_STEPS
+        )
+        node = jm.get_node("worker", lane)
+        out["exit_reason"] = node.exit_reason if node else ""
+        out["quarantined"] = [
+            list(q) for q in jm.quarantined_nodes()
+        ]
+
+        # the next rendezvous world: every rank re-joins, the convicted
+        # rank's join is parked and the frozen world excludes it
+        for rank in range(4):
+            rdzv.join_rendezvous(rank, 1, addr=f"host-{rank}")
+        _, _, world, _ = rdzv.get_comm_world(
+            (lane + 1) % 4
+        )
+        out["world_ranks"] = sorted(world)
+        out["excluded_ranks"] = rdzv.excluded_ranks()
+
+        # run B: the convicted chip is gone (fault disarmed = hardware
+        # replaced); resume from the verified checkpoint and finish
+        losses_b: Dict[int, float] = {}
+        t2 = _make_sdc_trainer(ckpt_dir, seed, _loss_recorder(losses_b))
+        try:
+            out["resumed_step"] = t2.global_step
+            t2.train(TOTAL_STEPS)
+        finally:
+            t2.close()
+
+        flight_dir = os.path.join(workdir, "flight")
+        out["flight_bundle"] = bool(
+            os.path.isdir(flight_dir)
+            and any(
+                "sdc_conviction" in d for d in os.listdir(flight_dir)
+            )
+        )
+
+        resumed_steps = sorted(losses_b)
+        out["loss_bitwise"] = bool(resumed_steps) and all(
+            losses_b[s] == golden.get(s) for s in resumed_steps
+        )
+        out["innocent_convictions"] = sum(
+            1 for c in out.get("convicted", []) if c != lane
+        )
+
+        deadline = time.time() + 10
+        while (
+            _thread_names() != threads_before
+            and time.time() < deadline
+        ):
+            time.sleep(0.1)
+        wedged = [
+            n for n in _thread_names() if n not in threads_before
+        ]
+        out["wedged_threads"] = wedged
+
+        out["ok"] = bool(
+            out.get("convicted") == [lane]
+            and out["innocent_convictions"] == 0
+            and out["detect_steps"] <= 10
+            and out.get("verified_step", -1) >= 0
+            and out.get("halted_step", -1)
+            == out.get("verified_step", -2)
+            and out.get("resumed_step", -1)
+            == out.get("verified_step", -2)
+            and out.get("goodput_replay_s", 0.0) > 0
+            and out.get("exit_reason") == NodeExitReason.SDC_QUARANTINED
+            and lane in out.get("excluded_ranks", [])
+            and lane not in out.get("world_ranks", [lane])
+            and len(out.get("world_ranks", [])) == 3
+            and "sdc_conviction" in events
+            and out["flight_bundle"]
+            and out["loss_bitwise"]
+            and not wedged
+        )
+    finally:
+        _sdc_cleanup()
+        if prev_flight is None:
+            os.environ.pop(obs_flight.ENV_FLIGHT_DIR, None)
+        else:
+            os.environ[obs_flight.ENV_FLIGHT_DIR] = prev_flight
+    return out
+
+
+# ---------------------------------------------------------------------------
 # registry / CLI
 # ---------------------------------------------------------------------------
 SCENARIOS = {
@@ -599,6 +875,7 @@ SCENARIOS = {
     "master_restart_mid_plan": master_restart_mid_plan,
     "brain_outage_mid_plan": brain_outage_mid_plan,
     "serving_crc_retry": serving_crc_retry,
+    "sdc_quarantine": sdc_quarantine,
 }
 
 
